@@ -1,0 +1,19 @@
+"""corrosion-tpu: a TPU-native rebuild of gossip-based distributed state.
+
+Capabilities mirror spacekookie/corrosion (SWIM membership, CRDT changeset
+broadcast, anti-entropy sync, SQLite materialization, HTTP API with streaming
+SQL subscriptions) rebuilt from scratch in two cooperating halves:
+
+- ``corrosion_tpu.sim`` + ``corrosion_tpu.parallel`` + ``corrosion_tpu.ops``:
+  the JAX/XLA/pallas compute path — virtual Corrosion nodes sharded over a
+  ``jax.sharding.Mesh``, with SWIM rounds, broadcast fanout, CRDT merge and
+  anti-entropy as batched kernels.
+- ``corrosion_tpu.agent`` + ``corrosion_tpu.client`` + ``corrosion_tpu.cli``:
+  the host runtime — a real agent with SQLite CRR storage, datagram/stream
+  transport, HTTP API, subscriptions, admin RPC, CLI.
+
+Shared pure logic (version vectors, interval sets, sync-need computation, HLC,
+wire codecs) lives in ``corrosion_tpu.core`` and is used by both halves.
+"""
+
+__version__ = "0.1.0"

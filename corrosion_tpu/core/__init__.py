@@ -1,0 +1,7 @@
+"""Shared pure logic used by both the host agent and the JAX sim engine.
+
+Mirrors the reference's corro-base-types, corro-api-types and the pure parts
+of corro-types (SURVEY.md §2): id newtypes, hybrid logical clock, interval
+sets/maps, value types, change chunking, bookkeeping, sync-need computation,
+wire messages and codec.
+"""

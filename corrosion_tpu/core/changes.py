@@ -1,0 +1,47 @@
+"""Changeset chunking.
+
+Mirrors corro-types/src/change.rs (`ChunkedChanges` :8-114): stream rows of a
+(possibly huge) transaction into chunks of at most ``max_bytes`` estimated
+wire bytes, each tagged with the inclusive seq range it covers, so a single
+10k-row transaction can be broadcast/synced incrementally and reassembled with
+gap tracking on the receiving side.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+from .values import Change
+
+MAX_CHANGES_BYTE_SIZE = 8 * 1024  # change.rs:116
+
+
+def chunk_changes(
+    rows: Iterable[Change],
+    last_seq: int,
+    max_bytes: int = MAX_CHANGES_BYTE_SIZE,
+) -> Iterator[tuple[list[Change], tuple[int, int]]]:
+    """Yield (changes, (seq_start, seq_end)) chunks.
+
+    Seq ranges tile [0, last_seq] contiguously even when rows skip seqs, and
+    the final chunk always extends to ``last_seq`` — matching ChunkedChanges:
+    the receiver tracks which seq ranges it holds, so emitted ranges must
+    cover the whole transaction without holes.
+    """
+    chunk: list[Change] = []
+    chunk_start = 0
+    size = 0
+    for row in rows:
+        chunk.append(row)
+        size += row.estimated_byte_size()
+        if size >= max_bytes:
+            yield chunk, (chunk_start, row.seq)
+            chunk_start = row.seq + 1
+            chunk = []
+            size = 0
+    if chunk or chunk_start <= last_seq:
+        yield chunk, (chunk_start, last_seq)
+
+
+def max_seq(rows: list[Change], default: int = 0) -> int:
+    return max((r.seq for r in rows), default=default)

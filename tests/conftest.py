@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax imports.
+
+Multi-chip hardware is not available in CI; sharding tests run against
+``--xla_force_host_platform_device_count=8`` exactly like the driver's
+multi-chip dry run.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

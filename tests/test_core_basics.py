@@ -1,0 +1,259 @@
+"""Unit tests for core pure types: intervals, HLC, values, chunker, backoff."""
+
+import pytest
+
+from corrosion_tpu.core.changes import chunk_changes
+from corrosion_tpu.core.hlc import (
+    HLC,
+    ClockDriftError,
+    make_ts,
+    ts_from_string,
+    ts_logical,
+    ts_physical_ms,
+    ts_to_string,
+)
+from corrosion_tpu.core.ids import Actor, ActorId
+from corrosion_tpu.core.intervals import RangeMap, RangeSet
+from corrosion_tpu.core.values import (
+    Change,
+    Statement,
+    pack_columns,
+    unpack_columns,
+    value_cmp_key,
+)
+from corrosion_tpu.utils.backoff import Backoff
+
+
+class TestRangeSet:
+    def test_insert_coalesce(self):
+        rs = RangeSet()
+        rs.insert(1, 3)
+        rs.insert(5, 7)
+        assert list(rs) == [(1, 3), (5, 7)]
+        rs.insert(4, 4)  # adjacent on both sides -> merge all
+        assert list(rs) == [(1, 7)]
+
+    def test_insert_overlap(self):
+        rs = RangeSet([(1, 5), (10, 20)])
+        rs.insert(3, 12)
+        assert list(rs) == [(1, 20)]
+
+    def test_contains_and_gaps(self):
+        rs = RangeSet([(1, 3), (7, 9)])
+        assert rs.contains(2) and rs.contains(7)
+        assert not rs.contains(5)
+        assert rs.contains_range(7, 9)
+        assert not rs.contains_range(2, 8)
+        assert list(rs.gaps(0, 12)) == [(0, 0), (4, 6), (10, 12)]
+        assert list(rs.gaps(1, 3)) == []
+
+    def test_remove_splits(self):
+        rs = RangeSet([(1, 10)])
+        rs.remove(4, 6)
+        assert list(rs) == [(1, 3), (7, 10)]
+        rs.remove(0, 2)
+        assert list(rs) == [(3, 3), (7, 10)]
+        rs.remove(3, 100)
+        assert list(rs) == []
+
+    def test_total(self):
+        rs = RangeSet([(1, 3), (10, 10)])
+        assert rs.total() == 4
+
+
+class TestRangeMap:
+    def test_insert_overwrites_overlap(self):
+        rm = RangeMap()
+        rm.insert(1, 10, "a")
+        rm.insert(4, 6, "b")
+        assert list(rm) == [(1, 3, "a"), (4, 6, "b"), (7, 10, "a")]
+
+    def test_coalesce_equal_values(self):
+        rm = RangeMap()
+        rm.insert(1, 3, "a")
+        rm.insert(4, 6, "a")
+        assert list(rm) == [(1, 6, "a")]
+        rm.insert(4, 5, "a")
+        assert list(rm) == [(1, 6, "a")]
+
+    def test_get(self):
+        rm = RangeMap([(1, 5, "x"), (8, 9, "y")])
+        assert rm.get(3) == "x"
+        assert rm.get(6) is None
+        assert rm.get_range(8) == (8, 9, "y")
+
+    def test_overwrite_spanning_multiple(self):
+        rm = RangeMap([(1, 2, "a"), (4, 5, "b"), (7, 8, "c")])
+        rm.insert(2, 7, "z")
+        assert list(rm) == [(1, 1, "a"), (2, 7, "z"), (8, 8, "c")]
+
+    def test_remove(self):
+        rm = RangeMap([(1, 10, "a")])
+        rm.remove(3, 4)
+        assert list(rm) == [(1, 2, "a"), (5, 10, "a")]
+
+
+class TestHLC:
+    def test_monotonic(self):
+        clock = HLC()
+        seen = [clock.new_timestamp() for _ in range(100)]
+        assert seen == sorted(set(seen))
+
+    def test_merge_remote(self):
+        clock = HLC()
+        t0 = clock.new_timestamp()
+        remote = t0 + (50 << 20)  # 50ms ahead: within the 300ms drift bound
+        clock.update_with_timestamp(remote)
+        assert clock.new_timestamp() > remote
+
+    def test_drift_rejected(self):
+        clock = HLC(max_delta_ms=300)
+        way_ahead = make_ts(ts_physical_ms(clock.new_timestamp()) + 10_000)
+        with pytest.raises(ClockDriftError):
+            clock.update_with_timestamp(way_ahead)
+
+    def test_string_roundtrip(self):
+        ts = make_ts(123456789, 42)
+        assert ts_from_string(ts_to_string(ts)) == ts
+        assert ts_logical(ts) == 42
+
+
+class TestValues:
+    def test_pack_roundtrip(self):
+        cases = [
+            (),
+            (None,),
+            (1, -1, 0, 2**40, -(2**40)),
+            (3.14, -0.0),
+            ("hello", "", "日本語"),
+            (b"\x00\xff", b""),
+            (None, 7, 2.5, "x", b"y"),
+        ]
+        for vals in cases:
+            assert unpack_columns(pack_columns(vals)) == vals
+
+    def test_pack_deterministic_key(self):
+        assert pack_columns((1, "a")) == pack_columns((1, "a"))
+        assert pack_columns((1, "a")) != pack_columns(("a", 1))
+
+    def test_value_order(self):
+        ordered = [None, -5, 1.5, 2, "a", "b", b"a"]
+        keys = [value_cmp_key(v) for v in ordered]
+        assert keys == sorted(keys)
+
+    def test_statement_parse_forms(self):
+        assert Statement.parse("SELECT 1").sql == "SELECT 1"
+        s = Statement.parse(["INSERT INTO t VALUES (?)", [1]])
+        assert s.params == [1]
+        s = Statement.parse(["INSERT INTO t VALUES (:a)", {"a": 2}])
+        assert s.named_params == {"a": 2}
+
+
+def _mkchange(seq, val="v"):
+    return Change(
+        table="t",
+        pk=pack_columns((seq,)),
+        cid="c",
+        val=val,
+        col_version=1,
+        db_version=1,
+        seq=seq,
+        site_id=b"\x00" * 16,
+        cl=1,
+    )
+
+
+class TestChunker:
+    def test_single_chunk(self):
+        rows = [_mkchange(i) for i in range(3)]
+        chunks = list(chunk_changes(rows, last_seq=2))
+        assert len(chunks) == 1
+        changes, (lo, hi) = chunks[0]
+        assert len(changes) == 3 and (lo, hi) == (0, 2)
+
+    def test_chunks_tile_seq_space(self):
+        rows = [_mkchange(i, val="x" * 100) for i in range(100)]
+        chunks = list(chunk_changes(rows, last_seq=99, max_bytes=500))
+        # ranges must tile [0, 99] contiguously
+        cursor = 0
+        for _, (lo, hi) in chunks:
+            assert lo == cursor
+            cursor = hi + 1
+        assert cursor == 100
+
+    def test_empty_covers_range(self):
+        chunks = list(chunk_changes([], last_seq=5))
+        assert chunks == [([], (0, 5))]
+
+    def test_sparse_seqs_no_holes(self):
+        rows = [_mkchange(s, val="x" * 300) for s in (0, 5, 9)]
+        chunks = list(chunk_changes(rows, last_seq=9, max_bytes=400))
+        cursor = 0
+        for _, (lo, hi) in chunks:
+            assert lo == cursor
+            cursor = hi + 1
+        assert cursor == 10
+
+
+class TestBackoff:
+    def test_growth_and_cap(self):
+        b = Backoff(min_wait=1, max_wait=8, factor=2, jitter=False, max_retries=6)
+        assert list(b) == [1, 2, 4, 8, 8, 8]
+
+    def test_jitter_bounds(self):
+        b = Backoff(min_wait=1, max_wait=10, factor=2, jitter=True, max_retries=20)
+        for w in b:
+            assert 1 <= w <= 10
+
+
+class TestIds:
+    def test_actor_id(self):
+        a = ActorId.random()
+        assert len(a.bytes) == 16
+        assert ActorId.from_hex(a.hex) == a
+        assert 0 <= a.to_node_index(100) < 100
+
+    def test_actor_renew_wins(self):
+        a = Actor(ActorId.random(), ("127.0.0.1", 1000), ts=5)
+        b = a.renew(ts=6)
+        assert b.wins_over(a) and not a.wins_over(b)
+        assert a.same_node(b)
+
+
+class TestMalformedBlobs:
+    def test_truncated_blob_raises(self):
+        from corrosion_tpu.core.values import MalformedBlobError
+
+        good = pack_columns(("hello world",))
+        with pytest.raises(MalformedBlobError):
+            unpack_columns(good[:-4])
+
+    def test_truncated_varint_and_overflow(self):
+        from corrosion_tpu.core.values import MalformedBlobError
+
+        with pytest.raises(MalformedBlobError):
+            unpack_columns(b"\x01\x80")
+        with pytest.raises(MalformedBlobError):
+            unpack_columns(b"\x03" + b"\x80" * 40 + b"\x01")
+        with pytest.raises(MalformedBlobError):
+            unpack_columns(b"\x02\x00")  # truncated real
+        with pytest.raises(MalformedBlobError):
+            unpack_columns(b"\x09")  # bad tag
+
+    def test_out_of_i64_int_rejected(self):
+        with pytest.raises(ValueError):
+            pack_columns((2**100,))
+        assert unpack_columns(pack_columns((2**63 - 1, -(2**63)))) == (
+            2**63 - 1,
+            -(2**63),
+        )
+
+    def test_statement_malformed_rejected(self):
+        with pytest.raises(ValueError):
+            Statement.parse(["sql", [1], "junk"])
+        with pytest.raises(ValueError):
+            Statement.parse(["sql", 42])
+
+    def test_utf8_byte_size(self):
+        c = _mkchange(0, val="日" * 100)
+        assert c.estimated_byte_size() >= 300
