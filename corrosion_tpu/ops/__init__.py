@@ -1,0 +1,8 @@
+"""Batched JAX/XLA kernels for the cluster-simulation engine.
+
+Each module is the TPU-native equivalent of a pure-logic component of the
+reference (SURVEY.md §2, §7): interval tensors (rangemap), CRDT merge
+(cr-sqlite LWW/causal-length), SWIM membership (foca), gossip fanout and
+anti-entropy sync (corro-agent broadcast/peer). All ops are static-shape,
+jit-safe, and vectorizable over a node/batch axis.
+"""

@@ -1,0 +1,249 @@
+"""Fixed-capacity interval-set tensors.
+
+TPU-native equivalent of the `rangemap` RangeInclusiveSet the reference uses
+for version/seq bookkeeping (reference corro-types/src/agent.rs:945-1052,
+sync.rs:123-246). JAX needs static shapes, so a set of inclusive integer
+ranges is a pair of int32 vectors ``(starts, ends)`` of fixed capacity C,
+sorted ascending by start, disjoint and non-adjacent, with empty slots pushed
+to the back holding the sentinel ``(EMPTY, EMPTY - 1)``.
+
+All functions are pure, jit-safe, and operate on a single set; batch with
+``jax.vmap``. Capacity overflow is resolved by dropping the *smallest*
+interval ("forget coverage"), which is the safe direction for every use in
+this codebase: these sets track data a node *has*, so under-approximating
+coverage only causes an idempotent re-fetch/re-merge (CRDT application is
+idempotent), never data loss. Property tests in tests/test_ops_intervals.py
+check agreement with the host-side ``corrosion_tpu.core.intervals.RangeSet``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Sentinel start for an empty slot: huge so empty slots sort last. Kept two
+# below int32 max so that ``start - 1`` / ``end + 1`` arithmetic never wraps.
+EMPTY = jnp.int32(2**31 - 4)
+_BIG_LEN = jnp.int32(2**31 - 1)
+
+
+class IntervalSet(NamedTuple):
+    """Sorted, coalesced, capacity-bounded set of inclusive int32 ranges."""
+
+    starts: jax.Array  # i32[C]
+    ends: jax.Array  # i32[C]
+
+    @property
+    def capacity(self) -> int:
+        return self.starts.shape[-1]
+
+
+def make(capacity: int) -> IntervalSet:
+    return IntervalSet(
+        starts=jnp.full((capacity,), EMPTY, dtype=jnp.int32),
+        ends=jnp.full((capacity,), EMPTY - 1, dtype=jnp.int32),
+    )
+
+
+def from_ranges(ranges, capacity: int) -> IntervalSet:
+    """Host-side constructor from [(start, end), ...] (not jit-traceable)."""
+    iv = make(capacity)
+    for s, e in ranges:
+        iv = insert(iv, jnp.int32(s), jnp.int32(e))
+    return iv
+
+
+def slot_mask(iv: IntervalSet) -> jax.Array:
+    """bool[C] — which slots hold a real interval."""
+    return iv.starts <= iv.ends
+
+
+def count(iv: IntervalSet) -> jax.Array:
+    return jnp.sum(slot_mask(iv).astype(jnp.int32))
+
+
+def total(iv: IntervalSet) -> jax.Array:
+    """Number of integers covered by the set."""
+    m = slot_mask(iv)
+    return jnp.sum(jnp.where(m, iv.ends - iv.starts + 1, 0))
+
+
+def is_empty(iv: IntervalSet) -> jax.Array:
+    return ~jnp.any(slot_mask(iv))
+
+
+def max_end(iv: IntervalSet) -> jax.Array:
+    """Largest covered integer, or -1 when empty."""
+    m = slot_mask(iv)
+    return jnp.max(jnp.where(m, iv.ends, -1))
+
+
+def min_start(iv: IntervalSet) -> jax.Array:
+    """Smallest covered integer, or EMPTY when empty."""
+    return jnp.min(iv.starts)
+
+
+def contains(iv: IntervalSet, x: jax.Array) -> jax.Array:
+    return jnp.any(slot_mask(iv) & (iv.starts <= x) & (x <= iv.ends))
+
+
+def contains_range(iv: IntervalSet, s: jax.Array, e: jax.Array) -> jax.Array:
+    """True iff [s, e] lies entirely inside one interval of the set."""
+    return jnp.any(slot_mask(iv) & (iv.starts <= s) & (e <= iv.ends))
+
+
+def _sorted_by_start(starts: jax.Array, ends: jax.Array):
+    order = jnp.argsort(starts)
+    return starts[order], ends[order]
+
+
+def _compact(
+    starts: jax.Array, ends: jax.Array, capacity: int, max_extra: int = 1
+) -> IntervalSet:
+    """Sort candidate slots, resolve overflow by dropping smallest intervals.
+
+    ``max_extra`` bounds how far the live count can exceed ``capacity``:
+    insert adds one merged slot, and remove can split at most one interval in
+    two (intervals are disjoint, so only one can span both cut edges) — both
+    are 1. The drop loop unrolls that many times, keeping the jitted kernel
+    small.
+    """
+    valid = starts <= ends
+    starts = jnp.where(valid, starts, EMPTY)
+    ends = jnp.where(valid, ends, EMPTY - 1)
+    for _ in range(max(1, max_extra)):
+        live = starts <= ends
+        overflow = jnp.sum(live.astype(jnp.int32)) > capacity
+        lengths = jnp.where(live, ends - starts + 1, _BIG_LEN)
+        drop = jnp.argmin(lengths)
+        kill = overflow & (jnp.arange(starts.shape[-1]) == drop)
+        starts = jnp.where(kill, EMPTY, starts)
+        ends = jnp.where(kill, EMPTY - 1, ends)
+    starts, ends = _sorted_by_start(starts, ends)
+    return IntervalSet(starts[:capacity], ends[:capacity])
+
+
+@jax.jit
+def insert(iv: IntervalSet, s: jax.Array, e: jax.Array) -> IntervalSet:
+    """Insert [s, e], coalescing overlapping and adjacent intervals.
+
+    Matches RangeSet.insert (core/intervals.py) / rangemap semantics.
+    """
+    s = jnp.int32(s)
+    e = jnp.int32(e)
+    m = slot_mask(iv)
+    # Overlapping or adjacent: start <= e+1 and end >= s-1.
+    touch = m & (iv.starts <= e + 1) & (iv.ends >= s - 1)
+    merged_s = jnp.minimum(s, jnp.min(jnp.where(touch, iv.starts, EMPTY)))
+    merged_e = jnp.maximum(e, jnp.max(jnp.where(touch, iv.ends, -(2**31) + 1)))
+    keep_s = jnp.where(touch, EMPTY, iv.starts)
+    keep_e = jnp.where(touch, EMPTY - 1, iv.ends)
+    cat_s = jnp.concatenate([keep_s, merged_s[None]])
+    cat_e = jnp.concatenate([keep_e, merged_e[None]])
+    return _compact(cat_s, cat_e, iv.capacity)
+
+
+@jax.jit
+def remove(iv: IntervalSet, s: jax.Array, e: jax.Array) -> IntervalSet:
+    """Remove [s, e]; an interval spanning both edges splits in two."""
+    s = jnp.int32(s)
+    e = jnp.int32(e)
+    m = slot_mask(iv)
+    left_s = iv.starts
+    left_e = jnp.minimum(iv.ends, s - 1)
+    lv = m & (left_s <= left_e)
+    right_s = jnp.maximum(iv.starts, e + 1)
+    right_e = iv.ends
+    rv = m & (right_s <= right_e)
+    cat_s = jnp.concatenate(
+        [jnp.where(lv, left_s, EMPTY), jnp.where(rv, right_s, EMPTY)]
+    )
+    cat_e = jnp.concatenate(
+        [jnp.where(lv, left_e, EMPTY - 1), jnp.where(rv, right_e, EMPTY - 1)]
+    )
+    return _compact(cat_s, cat_e, iv.capacity)
+
+
+@jax.jit
+def gaps(iv: IntervalSet, s: jax.Array, e: jax.Array) -> IntervalSet:
+    """Sub-ranges of [s, e] NOT covered by the set (capacity C+1).
+
+    The TPU analogue of RangeSet.gaps — this is what sync-need computation
+    runs on (reference corro-types/src/sync.rs:123-246).
+    """
+    s = jnp.int32(s)
+    e = jnp.int32(e)
+    m = slot_mask(iv)
+    # Clip the set to the window; only intersecting slots participate.
+    inter = m & (iv.starts <= e) & (iv.ends >= s)
+    cs = jnp.where(inter, jnp.maximum(iv.starts, s), EMPTY)
+    ce = jnp.where(inter, jnp.minimum(iv.ends, e), EMPTY - 1)
+    cs, ce = _sorted_by_start(cs, ce)
+    # Gap i sits between clipped slot i-1 and clipped slot i; plus tail gap.
+    c = iv.capacity
+    prev_end = jnp.concatenate([(s - 1)[None], ce])  # [C+1]
+    next_start = jnp.concatenate([cs, (e + 1)[None]])  # [C+1]
+    g_s = prev_end + 1
+    g_e = next_start - 1
+    # Beyond the last clipped slot, prev_end is a sentinel; the tail gap is
+    # handled by pairing the LAST real slot with e+1. Empty clipped slots have
+    # cs=EMPTY which makes interior "gaps" after the run invalid except the
+    # first one (the tail gap), which pairs sentinel prev_end... so compute the
+    # tail explicitly instead: mark pair (i-1 real or i==0, i real or first
+    # empty).
+    n_real = jnp.sum(inter.astype(jnp.int32))
+    idx = jnp.arange(c + 1)
+    pair_ok = idx <= n_real  # gaps before each real slot + one tail gap
+    g_e = jnp.where(idx == n_real, e, g_e)  # tail gap ends at e
+    valid = pair_ok & (g_s <= g_e)
+    out_s, out_e = _sorted_by_start(
+        jnp.where(valid, g_s, EMPTY).astype(jnp.int32),
+        jnp.where(valid, g_e, EMPTY - 1).astype(jnp.int32),
+    )
+    return IntervalSet(out_s, out_e)
+
+
+@jax.jit
+def union(a: IntervalSet, b: IntervalSet) -> IntervalSet:
+    """a ∪ b at a's capacity (scan-inserts each interval of b)."""
+
+    def body(acc, se):
+        s, e = se
+        real = s <= e
+        return jax.lax.cond(
+            real, lambda t: insert(t, s, e), lambda t: t, acc
+        ), None
+
+    out, _ = jax.lax.scan(body, a, (b.starts, b.ends))
+    return out
+
+
+@jax.jit
+def contiguous_watermark(iv: IntervalSet, base: jax.Array) -> jax.Array:
+    """Highest v such that [base, v] is fully covered (or base-1 if none).
+
+    Used for seq-gap tracking: a partial changeset becomes applicable when the
+    watermark reaches last_seq (reference agent.rs:2063-2151).
+    """
+    base = jnp.int32(base)
+    m = slot_mask(iv)
+    covers = m & (iv.starts <= base) & (iv.ends >= base)
+    wm = jnp.max(jnp.where(covers, iv.ends, base - 1))
+    # Follow at most C-1 chained intervals (sorted, so one pass suffices if we
+    # walk slots in order). A scan over sorted slots:
+    def body(w, se):
+        s, e = se
+        w = jnp.where((s <= w + 1) & (e > w), e, w)
+        return w, None
+
+    wm, _ = jax.lax.scan(body, wm, (iv.starts, iv.ends))
+    return wm
+
+
+def to_host(iv: IntervalSet) -> list[tuple[int, int]]:
+    """Materialize as a python list (testing/debug)."""
+    starts = jax.device_get(iv.starts)
+    ends = jax.device_get(iv.ends)
+    return [(int(s), int(e)) for s, e in zip(starts, ends) if s <= e]
