@@ -1,0 +1,170 @@
+"""Batched CRDT merge — the TPU-native replacement for cr-sqlite's C engine.
+
+Semantics mirror cr-sqlite 0.15 as used by the reference
+(/root/reference/doc/crdts.md:11-28, loaded via corro-types/src/sqlite.rs):
+
+- Row liveness is a **causal length** ``cl``: odd = live, even = deleted;
+  merges take the max, so a delete (cl 1→2) beats concurrent updates at cl 1
+  and a re-insert (cl 2→3) beats the delete.
+- Cell values are **LWW registers**: biggest ``col_version`` wins; on a tie
+  the "biggest" value wins. The sim orders values by a precomputed
+  ``value_rank`` (uint32); the host store uses the exact SQLite type/value
+  ordering (corrosion_tpu.core.values.value_cmp_key) — SURVEY.md §7 hard
+  part (c).
+
+A *cell* in the sim is one (table, pk, column) register, identified by a
+dense key index. Merging a batch of changes is a scatter-reduce: a
+lexicographic max over the tuple ``(cl, col_version, value_rank)``, computed
+exactly with three chained uint32 scatter-max passes (no 64-bit packing, so
+it stays in the TPU's native integer width).
+
+All functions are jit-safe and static-shape.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CellState(NamedTuple):
+    """Struct-of-arrays LWW register state for K cells."""
+
+    cl: jax.Array  # u32[K] causal length of the owning row
+    col_version: jax.Array  # u32[K]
+    value_rank: jax.Array  # u32[K] orderable value surrogate
+
+
+class ChangeBatch(NamedTuple):
+    """B changes addressed to dense cell keys.
+
+    Mirrors the fields of a `crsql_changes` row that matter for merge
+    (corro-api-types Change: col_version, cl, val; key stands for
+    (table, pk, cid)). ``mask`` marks live entries so fixed-size batches can
+    carry fewer than B real changes.
+    """
+
+    key: jax.Array  # i32[B] in [0, K)
+    cl: jax.Array  # u32[B]
+    col_version: jax.Array  # u32[B]
+    value_rank: jax.Array  # u32[B]
+    mask: jax.Array  # bool[B]
+
+
+def make_cells(n_cells: int) -> CellState:
+    z = jnp.zeros((n_cells,), dtype=jnp.uint32)
+    return CellState(cl=z, col_version=z, value_rank=z)
+
+
+def _lex_gt(a_cl, a_cv, a_vr, b_cl, b_cv, b_vr):
+    """(a_cl, a_cv, a_vr) > (b_cl, b_cv, b_vr) lexicographically."""
+    return (
+        (a_cl > b_cl)
+        | ((a_cl == b_cl) & (a_cv > b_cv))
+        | ((a_cl == b_cl) & (a_cv == b_cv) & (a_vr > b_vr))
+    )
+
+
+@jax.jit
+def merge_cells(local: CellState, incoming: CellState) -> CellState:
+    """Elementwise merge of two aligned cell states (replica join).
+
+    Idempotent, commutative, associative — the CRDT laws; property-tested in
+    tests/test_ops_crdt.py.
+    """
+    take = _lex_gt(
+        incoming.cl, incoming.col_version, incoming.value_rank,
+        local.cl, local.col_version, local.value_rank,
+    )
+    return CellState(
+        cl=jnp.where(take, incoming.cl, local.cl),
+        col_version=jnp.where(take, incoming.col_version, local.col_version),
+        value_rank=jnp.where(take, incoming.value_rank, local.value_rank),
+    )
+
+
+@jax.jit
+def apply_changes(state: CellState, batch: ChangeBatch) -> CellState:
+    """Scatter-merge a change batch into cell state.
+
+    Exact lexicographic (cl, col_version, value_rank) max per key across the
+    batch AND the current state, via three chained scatter-max passes:
+
+      1. scatter-max cl per key (seeded with current state);
+      2. among entries matching the winning cl, scatter-max col_version;
+      3. among entries matching (cl, col_version), scatter-max value_rank.
+
+    Equivalent to replaying `INSERT INTO crsql_changes` rows through the
+    extension's merge (reference agent.rs:2192-2214), batched.
+    """
+    k = batch.key
+    live = batch.mask
+
+    # Pass 1: causal length.
+    cl1 = state.cl.at[k].max(jnp.where(live, batch.cl, 0))
+    # Pass 2: col_version among cl winners (state participates via seed).
+    state_cv_seed = jnp.where(cl1 == state.cl, state.col_version, 0)
+    in_cl_win = live & (batch.cl == cl1[k])
+    cv1 = state_cv_seed.at[k].max(jnp.where(in_cl_win, batch.col_version, 0))
+    # Pass 3: value_rank among (cl, cv) winners.
+    state_vr_seed = jnp.where(
+        (cl1 == state.cl) & (cv1 == state.col_version), state.value_rank, 0
+    )
+    in_cv_win = in_cl_win & (batch.col_version == cv1[k])
+    vr1 = state_vr_seed.at[k].max(jnp.where(in_cv_win, batch.value_rank, 0))
+
+    return CellState(cl=cl1, col_version=cv1, value_rank=vr1)
+
+
+@jax.jit
+def row_live(state: CellState) -> jax.Array:
+    """bool[K] — causal-length liveness (odd cl = live)."""
+    return (state.cl & 1) == 1
+
+
+def local_write(
+    state: CellState, key: jax.Array, value_rank: jax.Array
+) -> CellState:
+    """A local UPDATE of one cell: bump col_version, keep cl.
+
+    (cr-sqlite bumps the column's version on every local write; the row's cl
+    only moves on delete/re-insert.)
+    """
+    return CellState(
+        cl=state.cl.at[key].max(1),  # writing resurrects nothing; ensures live
+        col_version=state.col_version.at[key].add(1),
+        value_rank=state.value_rank.at[key].set(value_rank),
+    )
+
+
+def local_insert_row(state: CellState, keys: jax.Array) -> CellState:
+    """(Re-)insert a row: bump its cells' cl to the next odd value.
+
+    A re-insert after a delete moves cl even→odd, beating the delete in
+    merges (causal-length resurrection); col_version restarts at 1 in the
+    new causal epoch. An insert onto an already-live row is an upsert: cl
+    stays, and col_version bumps (it must stay monotonic within an epoch or
+    stale remote values would win the LWW compare).
+    """
+    cl = state.cl[keys]
+    resurrect = (cl & 1) == 0
+    new_cl = jnp.where(resurrect, cl + 1, cl)
+    new_cv = jnp.where(resurrect, 1, state.col_version[keys] + 1)
+    return CellState(
+        cl=state.cl.at[keys].set(new_cl),
+        col_version=state.col_version.at[keys].set(new_cv),
+        value_rank=state.value_rank,
+    )
+
+
+def local_delete_row(state: CellState, keys: jax.Array) -> CellState:
+    """Delete a row: bump its cells' cl to the next even value, reset cols."""
+    cl = state.cl[keys]
+    new_cl = jnp.where((cl & 1) == 1, cl + 1, cl)
+    return CellState(
+        cl=state.cl.at[keys].set(new_cl),
+        col_version=state.col_version.at[keys].set(0),
+        value_rank=state.value_rank.at[keys].set(0),
+    )

@@ -228,11 +228,9 @@ def contiguous_watermark(iv: IntervalSet, base: jax.Array) -> jax.Array:
     watermark reaches last_seq (reference agent.rs:2063-2151).
     """
     base = jnp.int32(base)
-    m = slot_mask(iv)
-    covers = m & (iv.starts <= base) & (iv.ends >= base)
-    wm = jnp.max(jnp.where(covers, iv.ends, base - 1))
-    # Follow at most C-1 chained intervals (sorted, so one pass suffices if we
-    # walk slots in order). A scan over sorted slots:
+    wm = base - 1
+    # Walk sorted slots once; each covering-or-adjacent slot extends the
+    # watermark (slots are sorted by start, so one pass suffices).
     def body(w, se):
         s, e = se
         w = jnp.where((s <= w + 1) & (e > w), e, w)

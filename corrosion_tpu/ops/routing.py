@@ -1,0 +1,87 @@
+"""Message-routing primitives shared by the gossip-plane kernels.
+
+The broadcast and membership planes both need the same awkward-on-TPU
+operation: N nodes each emit a variable number of messages addressed to
+arbitrary receivers, and each receiver may only absorb a bounded number K of
+them per round (bounded queues — foca's updates backlog, corro-agent's
+broadcast pending queue). `bounded_intake` implements it with one stable sort
+by receiver plus a prefix-max rank, all static-shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def bounded_intake(
+    recv: jax.Array,
+    valid: jax.Array,
+    payloads: tuple[jax.Array, ...],
+    n_rows: int,
+    k: int,
+) -> tuple[jax.Array, tuple[jax.Array, ...]]:
+    """Route flat messages to per-receiver slots, at most ``k`` per receiver.
+
+    Args:
+      recv: i32[M] receiver row per message.
+      valid: bool[M] live messages.
+      payloads: tuple of [M] arrays to deliver alongside.
+      n_rows: number of receivers N.
+      k: max messages absorbed per receiver per round.
+
+    Returns:
+      (mask[N, k], payloads_out) where payloads_out[i] has shape [N, k];
+      slots beyond each receiver's message count are masked off. Which k
+      messages win when more than k target one receiver is deterministic:
+      lowest flat message index first (stable sort).
+    """
+    m = recv.shape[0]
+    key = jnp.where(valid, recv, n_rows).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    s_key = key[order]
+    idxs = jnp.arange(m)
+    run_first = jnp.where(
+        jnp.concatenate([jnp.array([True]), s_key[1:] != s_key[:-1]]), idxs, 0
+    )
+    run_first = jax.lax.associative_scan(jnp.maximum, run_first)
+    rank = idxs - run_first
+    ok = (s_key < n_rows) & (rank < k)
+    slot = jnp.where(ok, s_key * k + rank, n_rows * k)
+
+    mask = (
+        jnp.zeros((n_rows * k,), dtype=bool)
+        .at[slot]
+        .set(ok, mode="drop")
+        .reshape(n_rows, k)
+    )
+    outs = []
+    for p in payloads:
+        sp = p[order]
+        zero = jnp.zeros((n_rows * k,), dtype=p.dtype)
+        outs.append(
+            zero.at[slot].set(jnp.where(ok, sp, 0), mode="drop").reshape(n_rows, k)
+        )
+    return mask, tuple(outs)
+
+
+def rebuild_bounded_queue(
+    cand_valid: jax.Array,
+    cand_prio: jax.Array,
+    payloads: tuple[jax.Array, ...],
+    capacity: int,
+) -> tuple[jax.Array, tuple[jax.Array, ...]]:
+    """Keep the ``capacity`` highest-priority candidates per row.
+
+    cand_valid/cand_prio: [N, C]; payloads: tuple of [N, C]. Returns
+    (mask[N, capacity], payloads[N, capacity]) sorted by descending priority
+    (invalid candidates sort last regardless of priority). Priorities must be
+    int32-safe.
+    """
+    neg_inf = jnp.int32(-(2**31) + 1)
+    prio = jnp.where(cand_valid, cand_prio.astype(jnp.int32), neg_inf)
+    order = jnp.argsort(-prio, axis=1, stable=True)[:, :capacity]
+    take = jnp.take_along_axis
+    mask = take(cand_valid, order, axis=1)
+    outs = tuple(take(p, order, axis=1) for p in payloads)
+    return mask, outs

@@ -1,0 +1,335 @@
+"""Batched SWIM membership kernel — the TPU-native replacement for foca.
+
+The reference drives the external `foca` SWIM library from a runtime loop
+(corro-agent/src/broadcast/mod.rs:116-568) with WAN-tuned config
+(`make_foca_config`, mod.rs:704-713): probe rounds, suspect→down timers,
+incarnation refutation, and bounded piggyback dissemination of membership
+updates (`updates_backlog`). Identity renewal on being declared down
+(corro-types/src/actor.rs:169-194) maps to an incarnation bump here.
+
+This module simulates N virtual nodes in bulk-synchronous rounds. One round ≈
+one SWIM protocol period. Design choices that keep it TPU-shaped:
+
+- A membership *belief* is packed into one uint32: ``inc << 2 | severity``
+  with severity 0=alive, 1=suspect, 2=down. SWIM's merge rule (higher
+  incarnation wins; same incarnation → worse state wins) is then exactly
+  ``max`` of the packed value, so dissemination is a single scatter-max.
+- Dissemination is *bounded*, like foca's updates backlog: each node keeps a
+  small queue of (target, packed, tx_left) updates and gossips them to
+  ``gossip_fanout`` random peers per round; received entries that change the
+  receiver's view re-enter its queue with a fresh transmission budget.
+- Only the original suspector runs the suspect→down timer (bounded per-node
+  timer table); the resulting "down" update disseminates epidemically.
+- A node's own row entry ``view[j, j]`` doubles as its refutation mailbox:
+  when gossip lands a suspect/down belief about j at j's current incarnation,
+  j bumps its incarnation and gossips the refutation.
+
+All shapes are static; the only O(N²) state is the packed view itself.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.ops import routing
+
+SEV_ALIVE = 0
+SEV_SUSPECT = 1
+SEV_DOWN = 2
+
+
+def pack(inc: jax.Array, sev) -> jax.Array:
+    return (inc.astype(jnp.uint32) << 2) | jnp.uint32(sev)
+
+
+def packed_inc(p: jax.Array) -> jax.Array:
+    return p >> 2
+
+
+def packed_sev(p: jax.Array) -> jax.Array:
+    return p & 3
+
+
+@dataclass(frozen=True)
+class SwimConfig:
+    """Static round-model parameters (mirrors foca Config::new_wan intent)."""
+
+    n_nodes: int
+    suspect_rounds: int = 3  # suspect→down after this many rounds
+    gossip_fanout: int = 3  # peers receiving our updates each round (num_indirect_probes)
+    max_transmissions: int = 6  # per-update retransmission budget
+    backlog: int = 16  # updates queue capacity (foca updates_backlog)
+    timers: int = 8  # own-suspicion timer slots
+    probe_tries: int = 4  # rejection-sampling tries for probe target
+    loss_prob: float = 0.0  # modeled probe/ack loss
+
+
+class SwimState(NamedTuple):
+    view: jax.Array  # u32[N, N] packed beliefs; row i = node i's view
+    incarnation: jax.Array  # u32[N] own incarnation
+    alive: jax.Array  # bool[N] ground-truth process liveness (churn input)
+    # own suspect→down timers
+    susp_target: jax.Array  # i32[N, S] (-1 = empty)
+    susp_inc: jax.Array  # u32[N, S]
+    susp_started: jax.Array  # i32[N, S]
+    # updates backlog (piggyback dissemination queue)
+    upd_target: jax.Array  # i32[N, U] (-1 = empty)
+    upd_packed: jax.Array  # u32[N, U]
+    upd_tx: jax.Array  # i32[N, U] transmissions left
+
+
+def init_state(cfg: SwimConfig) -> SwimState:
+    n, s, u = cfg.n_nodes, cfg.timers, cfg.backlog
+    view = jnp.zeros((n, n), dtype=jnp.uint32)  # everyone alive @ inc 0
+    return SwimState(
+        view=view,
+        incarnation=jnp.zeros((n,), dtype=jnp.uint32),
+        alive=jnp.ones((n,), dtype=bool),
+        susp_target=jnp.full((n, s), -1, dtype=jnp.int32),
+        susp_inc=jnp.zeros((n, s), dtype=jnp.uint32),
+        susp_started=jnp.zeros((n, s), dtype=jnp.int32),
+        upd_target=jnp.full((n, u), -1, dtype=jnp.int32),
+        upd_packed=jnp.zeros((n, u), dtype=jnp.uint32),
+        upd_tx=jnp.zeros((n, u), dtype=jnp.int32),
+    )
+
+
+def _merge_scatter(view: jax.Array, recv: jax.Array, tgt: jax.Array,
+                   packed: jax.Array, valid: jax.Array) -> jax.Array:
+    """view[recv, tgt] = max(view[recv, tgt], packed) where valid."""
+    n = view.shape[0]
+    flat = view.reshape(-1)
+    idx = jnp.where(valid, recv * n + tgt, 0)
+    val = jnp.where(valid, packed, 0)
+    return flat.at[idx].max(val).reshape(view.shape)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def swim_round(state: SwimState, rng: jax.Array, round_idx: jax.Array,
+               cfg: SwimConfig) -> SwimState:
+    """One bulk-synchronous SWIM protocol period for all N nodes."""
+    n = cfg.n_nodes
+    nodes = jnp.arange(n)
+    k_probe, k_loss, k_goss = jax.random.split(rng, 3)
+    view = state.view
+    alive = state.alive
+    inc_self = state.incarnation
+
+    # ---- update candidates accumulated this round (per node) ----------------
+    # Each node can emit: 1 probe observation, up to S timer expiries,
+    # 1 refutation, and re-gossip of received changes. They are gathered into
+    # one candidate pool and the backlog rebuilt by priority at the end.
+    cand_tgt = []
+    cand_pkd = []
+    cand_tx = []
+    cand_ok = []
+
+    # ---- 1. probe ----------------------------------------------------------
+    # Rejection-sample a probe target != self not believed down.
+    tries = jax.random.randint(k_probe, (cfg.probe_tries, n), 0, n)
+
+    def pick(carry, t):
+        chosen = carry
+        sev_t = packed_sev(view[nodes, t])
+        ok = (t != nodes) & (sev_t < SEV_DOWN) & (chosen < 0)
+        return jnp.where(ok, t, chosen), None
+
+    probe_tgt, _ = jax.lax.scan(pick, jnp.full((n,), -1, jnp.int32), tries)
+    has_probe = (probe_tgt >= 0) & alive
+    pt = jnp.maximum(probe_tgt, 0)
+    lost = jax.random.uniform(k_loss, (n,)) < cfg.loss_prob
+    ack = has_probe & alive[pt] & ~lost
+    # Ack carries the target's current incarnation → learn alive@inc.
+    ack_pkd = pack(inc_self[pt], SEV_ALIVE)
+    # Failure → suspect at the incarnation we currently believe.
+    known = view[nodes, pt]
+    susp_pkd = pack(packed_inc(known), SEV_SUSPECT)
+    probe_pkd = jnp.where(ack, ack_pkd, susp_pkd)
+    probe_new = probe_pkd > known
+    view = _merge_scatter(view, nodes, pt, probe_pkd, has_probe)
+    cand_tgt.append(pt[:, None])
+    cand_pkd.append(probe_pkd[:, None])
+    cand_tx.append(jnp.full((n, 1), cfg.max_transmissions, jnp.int32))
+    cand_ok.append((has_probe & probe_new)[:, None])
+
+    # New suspicion → start a timer in a free/oldest slot (ring by started).
+    new_susp = has_probe & ~ack & probe_new
+    slot_empty = state.susp_target < 0
+    slot_score = jnp.where(slot_empty, -(2**30), state.susp_started)
+    slot = jnp.argmin(slot_score, axis=1)  # empty first, else oldest
+    susp_target = state.susp_target.at[nodes, slot].set(
+        jnp.where(new_susp, pt, state.susp_target[nodes, slot]))
+    susp_inc = state.susp_inc.at[nodes, slot].set(
+        jnp.where(new_susp, packed_inc(known), state.susp_inc[nodes, slot]))
+    susp_started = state.susp_started.at[nodes, slot].set(
+        jnp.where(new_susp, round_idx, state.susp_started[nodes, slot]))
+
+    # ---- 2. suspect→down timer expiry --------------------------------------
+    active = susp_target >= 0
+    expired = active & (round_idx - susp_started >= cfg.suspect_rounds)
+    exp_tgt = jnp.maximum(susp_target, 0)
+    down_pkd = pack(susp_inc, SEV_DOWN)
+    # Only fire if we still believe suspect at that incarnation (a refutation
+    # or ack may have raised the packed belief past it meanwhile).
+    still = view[nodes[:, None], exp_tgt] < down_pkd
+    fire = expired & still & alive[:, None]
+    view = _merge_scatter(
+        view,
+        jnp.broadcast_to(nodes[:, None], exp_tgt.shape),
+        exp_tgt, down_pkd, fire,
+    )
+    cand_tgt.append(exp_tgt)
+    cand_pkd.append(down_pkd)
+    cand_tx.append(jnp.full(exp_tgt.shape, cfg.max_transmissions, jnp.int32))
+    cand_ok.append(fire)
+    # Clear expired slots.
+    susp_target = jnp.where(expired, -1, susp_target)
+
+    # ---- 3. gossip dissemination (bounded piggyback) -----------------------
+    sendable = (state.upd_target >= 0) & (state.upd_tx > 0) & alive[:, None]
+    g_tgts = jax.random.randint(k_goss, (n, cfg.gossip_fanout), 0, n)
+    # A message (sender, fanout g, slot u): receiver merges entry.
+    recv = jnp.repeat(g_tgts[:, :, None], cfg.backlog, axis=2)  # [N, G, U]
+    tgt = jnp.broadcast_to(state.upd_target[:, None, :], recv.shape)
+    pkd = jnp.broadcast_to(state.upd_packed[:, None, :], recv.shape)
+    ok = (
+        jnp.broadcast_to(sendable[:, None, :], recv.shape)
+        & (recv != jnp.arange(n)[:, None, None])  # not to self
+        & alive[recv]  # dead receivers drop datagrams
+    )
+    pre = view  # receiver's view before this merge, for change detection
+    view = _merge_scatter(
+        view, recv.reshape(-1), jnp.maximum(tgt, 0).reshape(-1),
+        pkd.reshape(-1), ok.reshape(-1))
+    upd_tx = jnp.where(sendable, state.upd_tx - 1, state.upd_tx)
+
+    # Received entries that raised the receiver's belief re-enter the
+    # receiver's backlog (bounded intake, like foca's updates queue): a
+    # message (r, t, p) changed r's view iff p > pre[r, t].
+    flat_recv = recv.reshape(-1)
+    flat_tgt = jnp.maximum(tgt, 0).reshape(-1)
+    flat_pkd = pkd.reshape(-1)
+    changed = ok.reshape(-1) & (flat_pkd > pre[flat_recv, flat_tgt])
+    R = cfg.gossip_fanout * 2  # re-gossip intake cap per round
+    in_mask, (pool_tgt, pool_pkd) = routing.bounded_intake(
+        flat_recv, changed, (flat_tgt, flat_pkd), n, R)
+    cand_tgt.append(jnp.where(in_mask, pool_tgt, -1))
+    cand_pkd.append(pool_pkd)
+    cand_tx.append(jnp.full((n, R), cfg.max_transmissions, jnp.int32))
+    cand_ok.append(in_mask)
+
+    # ---- 4. refutation -----------------------------------------------------
+    self_belief = view[nodes, nodes]
+    refute = alive & (packed_sev(self_belief) >= SEV_SUSPECT) & (
+        packed_inc(self_belief) >= inc_self)
+    new_inc = jnp.where(refute, packed_inc(self_belief) + 1, inc_self)
+    refute_pkd = pack(new_inc, SEV_ALIVE)
+    view = _merge_scatter(view, nodes, nodes, refute_pkd, refute)
+    cand_tgt.append(nodes[:, None].astype(jnp.int32))
+    cand_pkd.append(refute_pkd[:, None])
+    cand_tx.append(jnp.full((n, 1), cfg.max_transmissions, jnp.int32))
+    cand_ok.append(refute[:, None])
+
+    # ---- 5. rebuild backlog by priority ------------------------------------
+    cand_tgt.append(state.upd_target)
+    cand_pkd.append(state.upd_packed)
+    cand_tx.append(upd_tx)
+    cand_ok.append((state.upd_target >= 0) & (upd_tx > 0))
+
+    ct = jnp.concatenate(cand_tgt, axis=1)
+    cp = jnp.concatenate(cand_pkd, axis=1)
+    cx = jnp.concatenate(cand_tx, axis=1)
+    co = jnp.concatenate(cand_ok, axis=1)
+    # Priority: highest remaining tx budget first (freshest); ties broken by
+    # position (stable sort), favoring this round's local observations.
+    keep, (upd_target, upd_packed, upd_tx2) = routing.rebuild_bounded_queue(
+        co, cx, (ct, cp, cx), cfg.backlog)
+    upd_target = jnp.where(keep, upd_target, -1)
+
+    return SwimState(
+        view=view,
+        incarnation=new_inc,
+        alive=alive,
+        susp_target=susp_target,
+        susp_inc=susp_inc,
+        susp_started=susp_started,
+        upd_target=upd_target,
+        upd_packed=upd_packed,
+        upd_tx=upd_tx2,
+    )
+
+
+def apply_churn(
+    state: SwimState,
+    kill: jax.Array,
+    revive: jax.Array,
+    rng: jax.Array | None = None,
+    max_transmissions: int = 6,
+) -> SwimState:
+    """Ground-truth churn between rounds.
+
+    ``kill``/``revive`` are bool[N]. A revived node renews its identity —
+    incarnation bump, alive self-belief, and a self-announce queued — the
+    analogue of Actor::renew auto-rejoin (actor.rs:169-194). When ``rng`` is
+    given, each revived node also bootstrap-pulls the full membership view of
+    one random alive peer, modeling the state transfer a SWIM announce gets
+    from its seed (foca feeds joiners the member list; without this a
+    rejoiner would have to re-probe every dead peer itself).
+    """
+    alive = (state.alive & ~kill) | revive
+    inc = jnp.where(revive, state.incarnation + 1, state.incarnation)
+    n = state.view.shape[0]
+    nodes = jnp.arange(n)
+    self_pkd = pack(inc, SEV_ALIVE)
+    view = _merge_scatter(state.view, nodes, nodes, self_pkd, revive)
+    if rng is not None:
+        # Random alive, non-revived seed per node (fallback: self → no-op).
+        cand = jax.random.randint(rng, (4, n), 0, n)
+
+        def pick(carry, t):
+            ok = alive[t] & ~revive[t] & (carry < 0)
+            return jnp.where(ok, t, carry), None
+
+        seed, _ = jax.lax.scan(pick, jnp.full((n,), -1, jnp.int32), cand)
+        seed = jnp.where(seed < 0, nodes, seed)
+        pulled = jnp.maximum(view, view[seed])
+        view = jnp.where(revive[:, None], pulled, view)
+    # Queue the announce in slot of lowest priority (slot 0 after rebuilds is
+    # highest; use the last slot).
+    last = state.upd_target.shape[1] - 1
+    upd_target = state.upd_target.at[:, last].set(
+        jnp.where(revive, nodes.astype(jnp.int32), state.upd_target[:, last]))
+    upd_packed = state.upd_packed.at[:, last].set(
+        jnp.where(revive, self_pkd, state.upd_packed[:, last]))
+    upd_tx = state.upd_tx.at[:, last].set(
+        jnp.where(revive, max_transmissions, state.upd_tx[:, last]))
+    return state._replace(
+        alive=alive, incarnation=inc, view=view,
+        upd_target=upd_target, upd_packed=upd_packed, upd_tx=upd_tx)
+
+
+def mismatches(state: SwimState) -> jax.Array:
+    """Exact count of (live observer, peer) beliefs that contradict truth.
+
+    0 == the cluster has converged on the membership ground truth.
+    """
+    n = state.view.shape[0]
+    believed_up = packed_sev(state.view) < SEV_DOWN
+    truth = state.alive[None, :]
+    obs = state.alive[:, None] & (jnp.arange(n)[None, :] != jnp.arange(n)[:, None])
+    return jnp.sum((believed_up != truth) & obs)
+
+
+def accuracy(state: SwimState) -> jax.Array:
+    """Approximate fraction of correct beliefs (f32; use mismatches() for
+    exact convergence checks — XLA f32 division is reciprocal-based and
+    rounds even x/x slightly below 1)."""
+    n = state.view.shape[0]
+    obs = state.alive[:, None] & (jnp.arange(n)[None, :] != jnp.arange(n)[:, None])
+    total = jnp.maximum(jnp.sum(obs), 1)
+    return 1.0 - mismatches(state) / total

@@ -1,0 +1,150 @@
+"""CRDT merge kernel tests: laws + agreement with a host-side model.
+
+The host model folds changes one at a time with the documented rule
+(doc/crdts.md: biggest col_version wins, tie -> biggest value; causal length
+max governs row liveness). The batched scatter kernel must agree regardless
+of batch order — that's the convergence guarantee the reference gets from
+cr-sqlite.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops import crdt
+
+
+def host_merge_one(state, key, tup):
+    """Fold one (cl, cv, vr) change into dict state by lexicographic max."""
+    cur = state.get(key, (0, 0, 0))
+    state[key] = max(cur, tup)
+
+
+def to_host(cells: crdt.CellState):
+    cl, cv, vr = map(np.asarray, cells)
+    return {
+        i: (int(cl[i]), int(cv[i]), int(vr[i]))
+        for i in range(len(cl))
+        if (cl[i], cv[i], vr[i]) != (0, 0, 0)
+    }
+
+
+def rand_state(rng, k):
+    return crdt.CellState(
+        cl=jnp.asarray(rng.integers(0, 5, k), dtype=jnp.uint32),
+        col_version=jnp.asarray(rng.integers(0, 10, k), dtype=jnp.uint32),
+        value_rank=jnp.asarray(rng.integers(0, 100, k), dtype=jnp.uint32),
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_merge_laws(seed):
+    rng = np.random.default_rng(seed)
+    k = 64
+    a, b, c = (rand_state(rng, k) for _ in range(3))
+    m = crdt.merge_cells
+    # idempotence
+    assert to_host(m(a, a)) == to_host(a)
+    # commutativity
+    assert to_host(m(a, b)) == to_host(m(b, a))
+    # associativity
+    assert to_host(m(m(a, b), c)) == to_host(m(a, m(b, c)))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_apply_changes_matches_host_fold(seed):
+    rng = np.random.default_rng(10 + seed)
+    k, b = 32, 200
+    state = rand_state(rng, k)
+    keys = rng.integers(0, k, b)
+    cls = rng.integers(0, 4, b)
+    cvs = rng.integers(0, 8, b)
+    vrs = rng.integers(0, 50, b)
+    mask = rng.random(b) < 0.9
+
+    host = to_host(state)
+    for i in range(b):
+        if mask[i]:
+            host_merge_one(host, int(keys[i]), (int(cls[i]), int(cvs[i]), int(vrs[i])))
+    host = {kk: v for kk, v in host.items() if v != (0, 0, 0)}
+
+    batch = crdt.ChangeBatch(
+        key=jnp.asarray(keys, dtype=jnp.int32),
+        cl=jnp.asarray(cls, dtype=jnp.uint32),
+        col_version=jnp.asarray(cvs, dtype=jnp.uint32),
+        value_rank=jnp.asarray(vrs, dtype=jnp.uint32),
+        mask=jnp.asarray(mask),
+    )
+    out = crdt.apply_changes(state, batch)
+    assert to_host(out) == host
+
+
+def test_apply_changes_batch_order_invariant():
+    rng = np.random.default_rng(99)
+    k, b = 16, 64
+    state = crdt.make_cells(k)
+    keys = rng.integers(0, k, b)
+    cls = rng.integers(1, 4, b)
+    cvs = rng.integers(1, 6, b)
+    vrs = rng.integers(0, 30, b)
+    perm = rng.permutation(b)
+
+    def run(order):
+        batch = crdt.ChangeBatch(
+            key=jnp.asarray(keys[order], dtype=jnp.int32),
+            cl=jnp.asarray(cls[order], dtype=jnp.uint32),
+            col_version=jnp.asarray(cvs[order], dtype=jnp.uint32),
+            value_rank=jnp.asarray(vrs[order], dtype=jnp.uint32),
+            mask=jnp.ones(b, dtype=bool),
+        )
+        return to_host(crdt.apply_changes(state, batch))
+
+    assert run(np.arange(b)) == run(perm)
+
+
+def test_causal_length_delete_beats_concurrent_update():
+    # Row cells live at cl=1. Replica A deletes (cl=2); replica B updates
+    # (cl=1, higher col_version). Delete must win on both after exchange.
+    base = crdt.CellState(
+        cl=jnp.asarray([1], dtype=jnp.uint32),
+        col_version=jnp.asarray([3], dtype=jnp.uint32),
+        value_rank=jnp.asarray([7], dtype=jnp.uint32),
+    )
+    a = crdt.local_delete_row(base, jnp.asarray([0]))
+    b = crdt.local_write(base, jnp.asarray(0), jnp.asarray(42, dtype=jnp.uint32))
+    ab = crdt.merge_cells(a, b)
+    ba = crdt.merge_cells(b, a)
+    assert not bool(crdt.row_live(ab)[0])
+    assert to_host(ab) == to_host(ba)
+    # Re-insert resurrects over the delete.
+    c = crdt.local_insert_row(ab, jnp.asarray([0]))
+    merged = crdt.merge_cells(ab, c)
+    assert bool(crdt.row_live(merged)[0])
+
+
+def test_upsert_on_live_row_keeps_lww_monotonic():
+    # Insert onto an already-live row must NOT rewind col_version: a stale
+    # remote value would otherwise win the merge.
+    base = crdt.CellState(
+        cl=jnp.asarray([1], dtype=jnp.uint32),
+        col_version=jnp.asarray([5], dtype=jnp.uint32),
+        value_rank=jnp.asarray([9], dtype=jnp.uint32),
+    )
+    upserted = crdt.local_insert_row(base, jnp.asarray([0]))
+    assert int(upserted.cl[0]) == 1  # still the same causal epoch
+    assert int(upserted.col_version[0]) == 6  # bumped, not reset
+    stale_remote = base._replace(col_version=jnp.asarray([3], dtype=jnp.uint32))
+    merged = crdt.merge_cells(upserted, stale_remote)
+    assert int(merged.col_version[0]) == 6, "stale remote must lose"
+
+
+def test_lww_tiebreak_on_value_rank():
+    a = crdt.CellState(
+        cl=jnp.asarray([1], dtype=jnp.uint32),
+        col_version=jnp.asarray([5], dtype=jnp.uint32),
+        value_rank=jnp.asarray([10], dtype=jnp.uint32),
+    )
+    b = a._replace(value_rank=jnp.asarray([20], dtype=jnp.uint32))
+    out = crdt.merge_cells(a, b)
+    assert int(out.value_rank[0]) == 20  # biggest value wins the tie

@@ -1,0 +1,127 @@
+"""Data-plane kernel tests: broadcast dissemination + anti-entropy sync.
+
+Scenarios mirror the reference's integration tests (SURVEY.md §4):
+insert_rows_and_gossip (write → cluster-wide visibility), large_tx_sync
+(late joiner catches up via sync), and partition healing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu.ops import gossip
+
+
+def mk(n, regions=None, writers=None, **kw):
+    regions = regions or [n]
+    writers = writers if writers is not None else list(range(n))
+    cfg = gossip.GossipConfig(n_nodes=n, n_writers=len(writers), **kw)
+    topo = gossip.make_topology(regions, writers)
+    data = gossip.init_data(cfg)
+    return cfg, topo, data
+
+
+def no_partition(regions=1):
+    return jnp.zeros((regions, regions), dtype=bool)
+
+
+def run(cfg, topo, data, rounds, writes_fn=None, alive=None, part=None,
+        seed=0, start=0, sync=True):
+    n = cfg.n_nodes
+    alive = jnp.ones(n, bool) if alive is None else alive
+    part = no_partition(int(jnp.max(topo.region)) + 1) if part is None else part
+    key = jax.random.PRNGKey(seed)
+    for r in range(start, start + rounds):
+        key, k1, k2 = jax.random.split(key, 3)
+        w = writes_fn(r) if writes_fn else jnp.zeros(cfg.n_writers, jnp.uint32)
+        data, _ = gossip.broadcast_round(data, topo, alive, part, w, k1, cfg)
+        if sync:
+            data, _ = gossip.sync_round(data, topo, alive, part, jnp.int32(r), k2, cfg)
+    return data
+
+
+def test_single_write_reaches_everyone():
+    cfg, topo, data = mk(12)
+    one = jnp.zeros(12, jnp.uint32).at[3].set(1)
+    data = run(cfg, topo, data, 1, writes_fn=lambda r: one)
+    assert int(data.head[3]) == 1
+    data = run(cfg, topo, data, 15, start=1)
+    # Everyone holds version 1 of writer 3.
+    assert bool((data.contig[:, 3] >= 1).all())
+
+
+def test_burst_stays_in_order_and_converges():
+    cfg, topo, data = mk(10, max_writes_per_round=4)
+    burst = jnp.zeros(10, jnp.uint32).at[0].set(4)
+    data = run(cfg, topo, data, 3, writes_fn=lambda r: burst)  # 12 versions
+    assert int(data.head[0]) == 12
+    data = run(cfg, topo, data, 25, start=3)
+    assert bool((data.contig[:, 0] == 12).all())
+    # Invariants: contig <= seen <= head.
+    assert bool((data.contig <= data.seen).all())
+    assert bool((data.seen[:, 0] <= data.head[0]).all())
+
+
+def test_broadcast_only_no_sync_mostly_converges():
+    cfg, topo, data = mk(10)
+    one = jnp.zeros(10, jnp.uint32).at[2].set(1)
+    data = run(cfg, topo, data, 1, writes_fn=lambda r: one, sync=False)
+    data = run(cfg, topo, data, 20, start=1, sync=False)
+    # Epidemic fanout alone should reach everyone without loss.
+    assert bool((data.contig[:, 2] >= 1).all())
+
+
+def test_late_joiner_catches_up_via_sync():
+    # Node 9 is down while writer 0 commits 40 versions; on revival,
+    # anti-entropy (not broadcast — tx budgets are exhausted) catches it up.
+    cfg, topo, data = mk(10, sync_interval=4, sync_budget=32, sync_chunk=32)
+    alive = jnp.ones(10, bool).at[9].set(False)
+    w = jnp.zeros(10, jnp.uint32).at[0].set(2)
+    data = run(cfg, topo, data, 20, writes_fn=lambda r: w, alive=alive)
+    assert int(data.head[0]) == 40
+    assert int(data.contig[9, 0]) == 0
+    data = run(cfg, topo, data, 30, start=20)
+    assert int(data.contig[9, 0]) == 40, "late joiner must fully catch up"
+
+
+def test_partition_blocks_then_heals():
+    # Two regions; cut the link; writes in region 0 stay invisible to
+    # region 1 until the partition heals (config 5's WAN scenario).
+    cfg, topo, data = mk(12, regions=[6, 6], sync_interval=3)
+    cut = jnp.array([[False, True], [True, False]])
+    w = jnp.zeros(12, jnp.uint32).at[1].set(1)
+    data = run(cfg, topo, data, 12, writes_fn=lambda r: w if r < 5 else jnp.zeros(12, jnp.uint32), part=cut)
+    assert int(data.head[1]) == 5
+    assert bool((data.contig[:6, 1] == 5).all()), "region 0 converges internally"
+    assert int(jnp.max(data.contig[6:, 1])) == 0, "partition blocks region 1"
+    data = run(cfg, topo, data, 25, start=12)  # healed
+    assert bool((data.contig[6:, 1] == 5).all()), "heal lets region 1 catch up"
+
+
+def test_sync_budget_caps_transfer():
+    cfg, topo, data = mk(4, sync_interval=1, sync_budget=8, sync_chunk=8,
+                         fanout_near=0, fanout_far=0)  # sync only
+    w = jnp.zeros(4, jnp.uint32).at[0].set(4)
+    # 10 rounds x 4 writes = 40 versions, no broadcast fanout at all.
+    data = run(cfg, topo, data, 10, writes_fn=lambda r: w)
+    # Per sync session a node can gain at most 8 versions of writer 0.
+    # After enough rounds everyone still converges.
+    data = run(cfg, topo, data, 30, start=10)
+    assert bool((data.contig[:, 0] == 40).all())
+
+
+def test_loss_is_healed():
+    cfg, topo, data = mk(10, loss_prob=0.4, sync_interval=5)
+    w = jnp.zeros(10, jnp.uint32).at[4].set(1)
+    data = run(cfg, topo, data, 10, writes_fn=lambda r: w)
+    data = run(cfg, topo, data, 40, start=10)
+    assert bool((data.contig[:, 4] == 10).all())
+
+
+def test_visibility_helper():
+    cfg, topo, data = mk(6)
+    one = jnp.zeros(6, jnp.uint32).at[0].set(1)
+    data = run(cfg, topo, data, 12, writes_fn=lambda r: one if r == 0 else jnp.zeros(6, jnp.uint32))
+    vis = gossip.visibility(data, jnp.array([0]), jnp.array([1], dtype=jnp.uint32))
+    assert vis.shape == (1, 6)
+    assert bool(vis.all())

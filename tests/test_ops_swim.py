@@ -1,0 +1,87 @@
+"""SWIM kernel behavior tests.
+
+Drives the batched membership kernel the way the reference's stress/churn
+scenarios drive foca (SURVEY.md §4): kill nodes, assert the cluster converges
+to the truth within a bounded number of protocol periods; revive them and
+assert refutation/rejoin works via incarnation bumps.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu.ops import swim
+
+
+def run_rounds(state, cfg, start, count, seed=0):
+    key = jax.random.PRNGKey(seed)
+    for r in range(start, start + count):
+        key, sub = jax.random.split(key)
+        state = swim.swim_round(state, sub, jnp.int32(r), cfg)
+    return state
+
+
+def test_stable_cluster_stays_accurate():
+    cfg = swim.SwimConfig(n_nodes=16)
+    state = swim.init_state(cfg)
+    state = run_rounds(state, cfg, 0, 10)
+    assert int(swim.mismatches(state)) == 0
+    # Nobody should have bumped incarnation in a quiet cluster.
+    assert int(jnp.max(state.incarnation)) == 0
+
+
+def test_dead_node_detected_and_spread():
+    cfg = swim.SwimConfig(n_nodes=24, suspect_rounds=2, gossip_fanout=3)
+    state = swim.init_state(cfg)
+    state = run_rounds(state, cfg, 0, 4)
+    kill = jnp.zeros(24, bool).at[5].set(True)
+    state = swim.apply_churn(state, kill, jnp.zeros(24, bool))
+    # Probe interval ~1 round, suspect->down 2 rounds, dissemination ~log N:
+    # give it 30 rounds to be safe, then everyone must know node 5 is down.
+    state = run_rounds(state, cfg, 4, 30, seed=1)
+    sev = swim.packed_sev(state.view[:, 5])
+    live = np.asarray(state.alive)
+    believed_down = np.asarray(sev == swim.SEV_DOWN)
+    assert believed_down[live].all(), "all live nodes must see node 5 as down"
+    assert int(swim.mismatches(state)) == 0
+
+
+def test_revived_node_rejoins_with_bumped_incarnation():
+    cfg = swim.SwimConfig(n_nodes=16, suspect_rounds=2)
+    state = swim.init_state(cfg)
+    kill = jnp.zeros(16, bool).at[3].set(True)
+    state = swim.apply_churn(state, kill, jnp.zeros(16, bool))
+    state = run_rounds(state, cfg, 0, 25, seed=2)
+    assert bool((swim.packed_sev(state.view[:, 3]) == swim.SEV_DOWN)[0])
+    # Revive: identity renews (incarnation bump) and the cluster re-learns it.
+    revive = jnp.zeros(16, bool).at[3].set(True)
+    state = swim.apply_churn(state, jnp.zeros(16, bool), revive)
+    assert int(state.incarnation[3]) == 1
+    state = run_rounds(state, cfg, 25, 30, seed=3)
+    sev = swim.packed_sev(state.view[:, 3])
+    live = np.asarray(state.alive)
+    assert np.asarray(sev < swim.SEV_DOWN)[live].all(), "rejoin must spread"
+    assert int(swim.mismatches(state)) == 0
+
+
+def test_false_suspicion_refuted_under_loss():
+    # With packet loss, live nodes get suspected; refutation must keep the
+    # cluster converged on the truth (accuracy returns to 1 in calm rounds).
+    cfg = swim.SwimConfig(n_nodes=16, suspect_rounds=4, loss_prob=0.3)
+    state = swim.init_state(cfg)
+    state = run_rounds(state, cfg, 0, 20, seed=4)
+    calm = swim.SwimConfig(n_nodes=16, suspect_rounds=4, loss_prob=0.0)
+    state = run_rounds(state, calm, 20, 20, seed=5)
+    assert int(swim.mismatches(state)) == 0
+    assert bool(state.alive.all())
+
+
+def test_view_merge_is_scatter_max():
+    # The packed encoding must give SWIM's merge rule by plain max.
+    a = swim.pack(jnp.uint32(2), swim.SEV_ALIVE)
+    s = swim.pack(jnp.uint32(2), swim.SEV_SUSPECT)
+    d = swim.pack(jnp.uint32(1), swim.SEV_DOWN)
+    assert int(jnp.maximum(a, s)) == int(s)  # same inc: worse state wins
+    assert int(jnp.maximum(s, d)) == int(s)  # higher inc beats old down
+    a3 = swim.pack(jnp.uint32(3), swim.SEV_ALIVE)
+    assert int(jnp.maximum(a3, s)) == int(a3)  # refutation wins
