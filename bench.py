@@ -1,0 +1,77 @@
+"""Headline benchmark: cluster-wide change propagation throughput.
+
+Runs BASELINE config 4 (10k-node concurrent-writer CRDT merge storm) on the
+available accelerator and reports how many change-version applications per
+second the simulated cluster sustains (broadcast deliveries + anti-entropy
+replay across all nodes).
+
+vs_baseline: the only throughput number the reference publishes is the
+2-node quick-start log excerpt, ≈156 changes/s (BASELINE.md; reference
+doc/quick-start.md:119). The ratio is our simulated cluster-wide
+apply throughput over that single-link figure.
+
+Prints exactly one JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    from corrosion_tpu import models
+    from corrosion_tpu.sim import simulate, visibility_latencies
+
+    if on_accel:
+        n, rounds = 10_000, 120
+    else:  # CPU smoke fallback so the script stays runnable anywhere
+        n, rounds = 512, 60
+    cfg, topo, sched = models.merge_10k(n=n, rounds=rounds, samples=256)
+
+    t0 = time.perf_counter()
+    final, curves = simulate(cfg, topo, sched, seed=0)
+    jax.block_until_ready(final.data.contig)
+    compile_and_run = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    final, curves = simulate(cfg, topo, sched, seed=1)
+    jax.block_until_ready(final.data.contig)
+    wall = time.perf_counter() - t1
+
+    applied = float(curves["applied_broadcast"].astype(np.float64).sum()
+                    + curves["applied_sync"].astype(np.float64).sum())
+    throughput = applied / wall
+    lat = visibility_latencies(final, sched, cfg)
+    heads = np.asarray(final.data.head, dtype=np.float64)
+    contig = np.asarray(final.data.contig, dtype=np.float64)
+    converged = bool((contig == heads[None, :]).all())
+
+    print(
+        f"[bench] platform={platform} nodes={n} rounds={rounds} "
+        f"wall={wall:.3f}s (first run incl. compile {compile_and_run:.1f}s) "
+        f"applied={applied:.0f} converged={converged} "
+        f"vis p50={lat['p50_s']:.2f}s p99={lat['p99_s']:.2f}s "
+        f"unseen={lat['unseen']}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "change_propagation_throughput",
+                "value": round(throughput, 1),
+                "unit": "changes/s",
+                "vs_baseline": round(throughput / 156.0, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

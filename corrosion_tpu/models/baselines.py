@@ -1,0 +1,180 @@
+"""Builders for the five BASELINE.md scenarios.
+
+The knobs mirror the reference's protocol constants (BASELINE.md): broadcast
+flush tick 500 ms == 1 round, sync backoff 1-15 s → sync_interval ~8 rounds
+(jittered per node), fanout ~ ring-0 eager + num_indirect_probes random,
+retransmissions ~ foca max_transmissions for the cluster size.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from corrosion_tpu.ops.gossip import GossipConfig, make_topology
+from corrosion_tpu.ops.swim import SwimConfig
+from corrosion_tpu.sim.engine import ClusterConfig, Schedule
+
+
+def _max_tx(n: int) -> int:
+    # foca scales retransmissions ~ log2(cluster size) + margin.
+    return max(4, int(math.ceil(math.log2(max(n, 2)))) + 2)
+
+
+def _cfg(n, writers, regions=None, **gossip_kw) -> tuple[ClusterConfig, object]:
+    regions = regions or [n]
+    g = GossipConfig(
+        n_nodes=n,
+        n_writers=len(writers),
+        max_transmissions=_max_tx(n),
+        **gossip_kw,
+    )
+    s = SwimConfig(
+        n_nodes=n,
+        max_transmissions=_max_tx(n),
+        suspect_rounds=3,
+        gossip_fanout=3,
+    )
+    topo = make_topology(regions, writers)
+    return ClusterConfig(swim=s, gossip=g), topo
+
+
+def three_node(n_inserts: int = 1000, samples: int = 256):
+    """Config 1: 3-node local cluster, single-table schema, 1k INSERTs.
+
+    All three nodes write round-robin, 4 versions per writer per round, then
+    the run drains until convergence (like integration-tests' baseline).
+    """
+    cfg, topo = _cfg(3, writers=[0, 1, 2], sync_interval=4)
+    per_round = 3 * 4
+    write_rounds = (n_inserts + per_round - 1) // per_round
+    drain = 30
+    writes = np.zeros((write_rounds + drain, 3), np.uint32)
+    writes[:write_rounds, :] = 4
+    # Trim the tail so exactly n_inserts versions commit.
+    extra = write_rounds * per_round - n_inserts
+    w = 2
+    r = write_rounds - 1
+    while extra > 0:
+        take = min(extra, 4)
+        writes[r, w] -= take
+        extra -= take
+        w -= 1
+        if w < 0:
+            w, r = 2, r - 1
+    sched = Schedule(writes=writes).make_samples(samples)
+    return cfg, topo, sched
+
+
+def churn_32(rounds: int = 400, samples: int = 128, seed: int = 1):
+    """Config 2: 32-node membership churn storm (join/leave/suspect).
+
+    A third of the cluster flaps on a staggered cadence while a light write
+    load measures visibility impact. The metric of record is the
+    `mismatches` curve (SWIM convergence time after each churn event).
+    """
+    n = 32
+    cfg, topo = _cfg(n, writers=list(range(n)), sync_interval=8)
+    rng = np.random.default_rng(seed)
+    writes = np.zeros((rounds, n), np.uint32)
+    write_mask = rng.random((rounds, n)) < 0.02
+    writes[write_mask] = 1
+    kill = np.zeros((rounds, n), bool)
+    revive = np.zeros((rounds, n), bool)
+    flappers = rng.choice(n, size=10, replace=False)
+    for i, node in enumerate(flappers):
+        down_at = 40 + i * 25
+        up_at = down_at + 60
+        if down_at < rounds:
+            kill[down_at, node] = True
+        if up_at < rounds:
+            revive[up_at, node] = True
+    # No writes from currently-dead writers (the engine masks this too, but
+    # keeping the schedule honest makes sample bookkeeping exact).
+    dead = np.zeros(n, bool)
+    for r in range(rounds):
+        dead |= kill[r]
+        dead &= ~revive[r]
+        writes[r, dead] = 0
+    sched = Schedule(writes=writes, kill=kill, revive=revive).make_samples(samples)
+    return cfg, topo, sched
+
+
+def anti_entropy_1k(n: int = 1000, burst: int = 2000, samples: int = 256):
+    """Config 3: 1k-node anti-entropy: a burst of versions from a few hot
+    writers overwhelms broadcast retransmission budgets; convergence happens
+    through version-vector diff + budgeted sync replay."""
+    writers = list(range(16))
+    cfg, topo = _cfg(
+        n,
+        writers=writers,
+        regions=[n // 4] * 4,
+        sync_interval=8,
+        sync_budget=256,
+        sync_chunk=64,
+        queue=16,
+    )
+    per_round = len(writers) * 4
+    burst_rounds = (burst + per_round - 1) // per_round
+    drain = 120
+    writes = np.zeros((burst_rounds + drain, len(writers)), np.uint32)
+    writes[:burst_rounds, :] = 4
+    sched = Schedule(writes=writes).make_samples(samples)
+    return cfg, topo, sched
+
+
+def merge_10k(n: int = 10_000, rounds: int = 120, samples: int = 256,
+              seed: int = 3):
+    """Config 4: 10k nodes, everyone writes concurrently (LWW merge storm).
+
+    Writes are sparse per round (Poisson-ish 1% of writers/round) so the
+    broadcast plane stays in its operating regime; the CRDT cell merge for
+    the resulting change batches is benchmarked by ops.crdt.apply_changes
+    (bench.py runs it on the same write volume).
+    """
+    writers = list(range(n))
+    cfg, topo = _cfg(
+        n,
+        writers=writers,
+        regions=[n // 8] * 8,
+        sync_interval=10,
+        sync_budget=512,
+        sync_chunk=32,
+    )
+    rng = np.random.default_rng(seed)
+    writes = (rng.random((rounds, n)) < 0.01).astype(np.uint32)
+    writes[rounds - 40 :, :] = 0  # drain tail
+    sched = Schedule(writes=writes).make_samples(samples)
+    return cfg, topo, sched
+
+
+def wan_100k(n: int = 100_000, n_regions: int = 20, n_writers: int = 512,
+             rounds: int = 240, samples: int = 128, seed: int = 4):
+    """Config 5: 100k-node partitioned WAN topology.
+
+    20 regions; writers spread across regions; mid-run a region pair is cut
+    off for 60 rounds and must catch up after healing. Node axis is meant to
+    be sharded over a mesh (see corrosion_tpu.parallel)."""
+    rng = np.random.default_rng(seed)
+    region_size = n // n_regions
+    writers = sorted(rng.choice(n, size=n_writers, replace=False).tolist())
+    cfg, topo = _cfg(
+        n,
+        writers=writers,
+        regions=[region_size] * n_regions,
+        sync_interval=12,
+        sync_budget=512,
+        sync_chunk=64,
+        fanout_near=2,
+        fanout_far=1,
+    )
+    writes = (rng.random((rounds, n_writers)) < 0.05).astype(np.uint32)
+    writes[rounds - 80 :, :] = 0
+    partition = np.zeros((rounds, n_regions, n_regions), bool)
+    cut_a, cut_b = 0, 1
+    partition[60:120, cut_a, :] = True
+    partition[60:120, :, cut_a] = True
+    partition[60:120, cut_a, cut_a] = False
+    sched = Schedule(writes=writes, partition=partition).make_samples(samples)
+    return cfg, topo, sched

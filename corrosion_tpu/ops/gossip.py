@@ -178,37 +178,60 @@ def broadcast_round(
     )
     lost = jax.random.uniform(k_loss, (n, f, q_cap)) < cfg.loss_prob
 
-    # ---- 3. slot-ordered delivery -----------------------------------------
-    intake_recv, intake_w, intake_v, intake_tx, intake_ok = [], [], [], [], []
-    n_msgs = jnp.int32(0)
-    for slot in range(q_cap):
-        ew = data.q_writer[:, slot]  # i32[N]
-        ev = data.q_ver[:, slot]
-        msg_ok = link_ok & (ew[:, None] >= 0) & ~lost[:, :, slot]
-        n_msgs = n_msgs + jnp.sum(msg_ok)
-        rw = jnp.maximum(ew, 0)[:, None]  # writer per message [N, 1]
-        cur = contig[recv, rw]  # [N, F]
-        prom = msg_ok & (ev[:, None] == cur + 1)
-        contig = contig.at[recv, rw].max(jnp.where(prom, ev[:, None], 0))
-        seen = seen.at[recv, rw].max(jnp.where(msg_ok, ev[:, None], 0))
-        intake_recv.append(recv.reshape(-1))
-        intake_w.append(jnp.broadcast_to(rw, (n, f)).reshape(-1))
-        intake_v.append(jnp.broadcast_to(ev[:, None], (n, f)).reshape(-1))
-        intake_tx.append(
-            jnp.broadcast_to(data.q_tx[:, slot][:, None] - 1, (n, f)).reshape(-1)
-        )
-        intake_ok.append(prom.reshape(-1))
+    # ---- 3. delivery (one sorted pass over all messages) -------------------
+    # Message (sender, slot, fanout) → flat [M = N*Q*F]. A message is
+    # (recv, writer, version, tx). Promotion must respect version order, so
+    # instead of scanning queue slots with one serialized scatter each (slow:
+    # TPU scatters serialize per update), sort messages by (recv·W + writer,
+    # version) and find, per (recv, writer) segment, the longest contiguous
+    # version run starting at contig+1 — including runs stitched across
+    # senders — then apply with a single scatter-max.
+    m_recv = jnp.repeat(recv[:, None, :], q_cap, axis=1).reshape(-1)  # [M]
+    m_w = jnp.repeat(data.q_writer[:, :, None], f, axis=2).reshape(-1)
+    m_v = jnp.repeat(data.q_ver[:, :, None], f, axis=2).reshape(-1)
+    m_tx = jnp.repeat(data.q_tx[:, :, None], f, axis=2).reshape(-1)
+    m_ok = (
+        jnp.repeat(link_ok[:, None, :], q_cap, axis=1).reshape(-1)
+        & (m_w >= 0)
+        & ~lost.reshape(-1)
+    )
+    n_msgs = jnp.sum(m_ok)
+
+    rw = m_recv * w_count + jnp.maximum(m_w, 0)  # flat (recv, writer) key
+    rw = jnp.where(m_ok, rw, n * w_count)  # invalid → sentinel segment
+    # Sort by version, then stably by segment key → segments of ascending v.
+    order1 = jnp.argsort(m_v.astype(jnp.int32), stable=True)
+    rw1, v1, tx1 = rw[order1], m_v[order1], m_tx[order1]
+    order2 = jnp.argsort(rw1, stable=True)
+    rw2, v2, tx2 = rw1[order2], v1[order2], tx1[order2]
+    valid2 = rw2 < n * w_count
+
+    seg_start = jnp.concatenate([jnp.array([True]), rw2[1:] != rw2[:-1]])
+    base = contig.reshape(-1)[jnp.minimum(rw2, n * w_count - 1)]
+    prev_v = jnp.concatenate([jnp.zeros((1,), v2.dtype), v2[:-1]])
+    ok_link = jnp.where(seg_start, v2 <= base + 1, v2 <= prev_v + 1)
+    run = routing.segmented_prefix_and(ok_link & valid2, seg_start)
+    # Applied = delivered versions on an unbroken run from contig+1.
+    applied_v = jnp.where(run & valid2, v2, 0)
+    contig = (
+        contig.reshape(-1)
+        .at[jnp.where(valid2, rw2, 0)]
+        .max(jnp.where(valid2, applied_v, 0))
+        .reshape(n, w_count)
+    )
+    seen = (
+        seen.reshape(-1)
+        .at[jnp.where(valid2, rw2, 0)]
+        .max(jnp.where(valid2, v2, 0))
+        .reshape(n, w_count)
+    )
 
     # ---- 4. rebroadcast intake (epidemic requeue) --------------------------
     k_in = cfg.fanout * 2  # bounded intake per receiver per round
     in_mask, (in_w, in_v, in_tx) = routing.bounded_intake(
-        jnp.concatenate(intake_recv),
-        jnp.concatenate(intake_ok) & (jnp.concatenate(intake_tx) > 0),
-        (
-            jnp.concatenate(intake_w),
-            jnp.concatenate(intake_v),
-            jnp.concatenate(intake_tx),
-        ),
+        rw2 // w_count,
+        run & valid2 & (tx2 > 1),
+        (rw2 % w_count, v2, tx2 - 1),
         n,
         k_in,
     )

@@ -65,6 +65,23 @@ def bounded_intake(
     return mask, tuple(outs)
 
 
+def segmented_prefix_and(flags: jax.Array, seg_start: jax.Array) -> jax.Array:
+    """Per-segment running AND of ``flags`` (segments marked by seg_start).
+
+    out[i] = AND of flags[j] for j from the segment's first element to i.
+    Classic segmented-scan combine, associative:
+      (f1, s1) ⊕ (f2, s2) = (f2 if s2 else f1 & f2, s1 | s2)
+    """
+
+    def combine(a, b):
+        f1, s1 = a
+        f2, s2 = b
+        return jnp.where(s2, f2, f1 & f2), s1 | s2
+
+    out, _ = jax.lax.associative_scan(combine, (flags, seg_start))
+    return out
+
+
 def rebuild_bounded_queue(
     cand_valid: jax.Array,
     cand_prio: jax.Array,

@@ -1,0 +1,15 @@
+"""Device-mesh sharding for the cluster simulation.
+
+The simulation's parallelism axis is the *virtual node* dimension (SURVEY.md
+§2 P1: every Corrosion node holds full state — here each TPU core hosts a
+shard of virtual nodes). All O(N) and O(N·N)/O(N·W) state is sharded along
+its node-row axis; writer heads and schedules stay replicated. Cross-shard
+gossip deliveries become XLA collectives inserted automatically at the
+scatter boundaries (all-to-all-shaped traffic riding ICI).
+"""
+
+from corrosion_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    shard_cluster_state,
+    shard_topology,
+)

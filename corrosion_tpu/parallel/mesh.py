@@ -1,0 +1,84 @@
+"""Mesh construction + NamedSharding placement for ClusterState/Topology.
+
+Placement policy (1-D mesh over axis "nodes"):
+
+- per-node vectors (alive, incarnation, region, …):        P("nodes")
+- node-major matrices (SWIM view, contig, seen, queues):   P("nodes", None)
+- visibility samples [S, N]:                               P(None, "nodes")
+- writer-indexed vectors (head, writer_nodes) + scalars:   replicated
+
+The SWIM view's column axis and the data plane's writer axis stay
+unsharded: gossip scatters address arbitrary (row, col) pairs, so sharding
+rows makes each delivery a cross-shard send exactly once (the all-to-all the
+reference does over QUIC, here over ICI), while the column gather stays
+local. XLA partitions the scatter/gather ops and inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from corrosion_tpu.ops.gossip import DataState, Topology
+from corrosion_tpu.ops.swim import SwimState
+from corrosion_tpu.sim.engine import ClusterState
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "nodes") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def _put(x, mesh: Mesh, spec: P):
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def shard_topology(topo: Topology, mesh: Mesh, axis: str = "nodes") -> Topology:
+    n = P(axis)
+    r = P()  # replicated
+    return Topology(
+        region=_put(topo.region, mesh, n),
+        region_start=_put(topo.region_start, mesh, n),
+        region_size=_put(topo.region_size, mesh, n),
+        writer_nodes=_put(topo.writer_nodes, mesh, r),
+        writer_of_node=_put(topo.writer_of_node, mesh, n),
+        sync_phase=_put(topo.sync_phase, mesh, n),
+    )
+
+
+def shard_cluster_state(
+    state: ClusterState, mesh: Mesh, axis: str = "nodes"
+) -> ClusterState:
+    row = P(axis, None)
+    vec = P(axis)
+    rep = P()
+    sw: SwimState = state.swim
+    sw = SwimState(
+        view=_put(sw.view, mesh, row),
+        incarnation=_put(sw.incarnation, mesh, vec),
+        alive=_put(sw.alive, mesh, vec),
+        susp_target=_put(sw.susp_target, mesh, row),
+        susp_inc=_put(sw.susp_inc, mesh, row),
+        susp_started=_put(sw.susp_started, mesh, row),
+        upd_target=_put(sw.upd_target, mesh, row),
+        upd_packed=_put(sw.upd_packed, mesh, row),
+        upd_tx=_put(sw.upd_tx, mesh, row),
+    )
+    d: DataState = state.data
+    d = DataState(
+        head=_put(d.head, mesh, rep),
+        contig=_put(d.contig, mesh, row),
+        seen=_put(d.seen, mesh, row),
+        q_writer=_put(d.q_writer, mesh, row),
+        q_ver=_put(d.q_ver, mesh, row),
+        q_tx=_put(d.q_tx, mesh, row),
+    )
+    return ClusterState(
+        swim=sw,
+        data=d,
+        round=_put(state.round, mesh, rep),
+        vis_round=_put(state.vis_round, mesh, P(None, axis)),
+    )

@@ -1,0 +1,18 @@
+"""Cluster-simulation engine: composes the membership (SWIM), data
+(broadcast + anti-entropy), and CRDT-merge kernels into a single jitted
+round step, scanned over a scripted workload, sharded over a device mesh.
+
+This is the "flagship model" of the framework: a whole-Corrosion-cluster
+forward step (SURVEY.md north star). One simulated round ≈ one broadcast
+flush tick (500 ms in the reference, broadcast/mod.rs:373).
+"""
+
+from corrosion_tpu.sim.engine import (  # noqa: F401
+    ClusterConfig,
+    ClusterState,
+    Schedule,
+    cluster_round,
+    init_cluster,
+    simulate,
+    visibility_latencies,
+)

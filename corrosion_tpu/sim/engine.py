@@ -1,0 +1,246 @@
+"""Whole-cluster simulation engine.
+
+Composes the three gossip planes the reference runs as concurrent async loops
+(SURVEY.md §3: SWIM runtime loop, broadcast loop, sync loop) into one
+bulk-synchronous `cluster_round`, then `lax.scan`s it over a scripted
+workload. The scripted-schedule shape mirrors the reference's integration
+tests (SURVEY.md §4 stress_test: fire statements at agents, then poll for
+cluster-wide convergence) — writes per (round, writer), churn kill/revive
+masks, and region partition masks.
+
+Round model: one round ≈ the broadcast flush tick (500 ms,
+broadcast/mod.rs:373); the SWIM probe and sync cadences are expressed in
+rounds (SwimConfig / GossipConfig.sync_interval). `round_ms` converts
+round-count latencies into wall-clock-equivalent seconds for BASELINE
+comparisons.
+
+Change-visibility metric: sampled writes (writer, version, commit round) are
+tracked to first-visibility round per node — exact p50/p99 over samples, the
+reference's headline "how fast is a write visible cluster-wide" question
+(README.md:12).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu.ops import gossip as gossip_ops
+from corrosion_tpu.ops import swim as swim_ops
+from corrosion_tpu.ops.gossip import DataState, GossipConfig, Topology
+from corrosion_tpu.ops.swim import SwimConfig, SwimState
+
+
+@dataclass(frozen=True)
+class ClusterConfig:
+    swim: SwimConfig
+    gossip: GossipConfig
+    round_ms: float = 500.0  # simulated wall-clock per round
+
+    @property
+    def n_nodes(self) -> int:
+        return self.gossip.n_nodes
+
+
+class ClusterState(NamedTuple):
+    swim: SwimState
+    data: DataState
+    round: jax.Array  # i32
+    vis_round: jax.Array  # i32[S, N] first round sample s visible at node, -1
+
+
+@dataclass
+class Schedule:
+    """Scripted workload for a run of ``rounds`` rounds.
+
+    writes: u8/u32[rounds, W] versions committed per writer per round.
+    kill/revive: optional bool[rounds, N] churn masks.
+    partition: optional bool[rounds, R, R] region link cuts.
+    samples: (writer[S], version[S], round[S]) — writes whose visibility is
+      tracked. ``make_samples`` derives them from ``writes``.
+    """
+
+    writes: np.ndarray
+    kill: np.ndarray | None = None
+    revive: np.ndarray | None = None
+    partition: np.ndarray | None = None
+    sample_writer: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    sample_ver: np.ndarray = field(default_factory=lambda: np.zeros(0, np.uint32))
+    sample_round: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+
+    @property
+    def rounds(self) -> int:
+        return self.writes.shape[0]
+
+    def make_samples(self, cap: int = 256) -> "Schedule":
+        """Sample up to ``cap`` committed writes, evenly over the schedule."""
+        rs, ws = np.nonzero(self.writes)
+        if len(rs) == 0:
+            return self
+        heads = np.zeros(self.writes.shape[1], np.uint32)
+        trip = []  # (writer, version, round) per committed version
+        for r, w in zip(rs, ws):
+            n = int(self.writes[r, w])
+            for j in range(n):
+                heads[w] += 1
+                trip.append((w, heads[w], r))
+        idx = np.linspace(0, len(trip) - 1, min(cap, len(trip))).astype(int)
+        sel = [trip[i] for i in idx]
+        self.sample_writer = np.array([s[0] for s in sel], np.int32)
+        self.sample_ver = np.array([s[1] for s in sel], np.uint32)
+        self.sample_round = np.array([s[2] for s in sel], np.int32)
+        return self
+
+
+def init_cluster(cfg: ClusterConfig, n_samples: int) -> ClusterState:
+    return ClusterState(
+        swim=swim_ops.init_state(cfg.swim),
+        data=gossip_ops.init_data(cfg.gossip),
+        round=jnp.int32(0),
+        vis_round=jnp.full((n_samples, cfg.n_nodes), -1, jnp.int32),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "has_churn"))
+def cluster_round(
+    state: ClusterState,
+    topo: Topology,
+    writes: jax.Array,  # u32[W]
+    partition: jax.Array,  # bool[R, R]
+    kill: jax.Array,  # bool[N] (ignored when has_churn=False)
+    revive: jax.Array,
+    sample_writer: jax.Array,  # i32[S]
+    sample_ver: jax.Array,  # u32[S]
+    sample_round: jax.Array,  # i32[S]
+    rng: jax.Array,
+    cfg: ClusterConfig,
+    has_churn: bool,
+) -> tuple[ClusterState, dict]:
+    k_churn, k_bcast, k_swim, k_sync = jax.random.split(rng, 4)
+    sw = state.swim
+    if has_churn:
+        sw = swim_ops.apply_churn(
+            sw, kill, revive, k_churn, cfg.swim.max_transmissions
+        )
+    alive = sw.alive
+
+    data, bstats = gossip_ops.broadcast_round(
+        state.data, topo, alive, partition, writes, k_bcast, cfg.gossip
+    )
+    sw = swim_ops.swim_round(sw, k_swim, state.round, cfg.swim)
+    data, sstats = gossip_ops.sync_round(
+        data, topo, alive, partition, state.round, k_sync, cfg.gossip
+    )
+
+    # Visibility tracking for sampled writes that have been committed.
+    active = state.round >= sample_round  # [S]
+    vis_now = gossip_ops.visibility(data, sample_writer, sample_ver)  # [S, N]
+    vis_round = jnp.where(
+        (state.vis_round < 0) & vis_now & active[:, None],
+        state.round,
+        state.vis_round,
+    )
+
+    stats = {
+        "mismatches": swim_ops.mismatches(sw),
+        "need": gossip_ops.total_need(data),
+        "applied_broadcast": bstats["applied_broadcast"],
+        "applied_sync": sstats["applied_sync"],
+        "msgs": bstats["msgs"],
+        "sessions": sstats["sessions"],
+    }
+    return (
+        ClusterState(
+            swim=sw, data=data, round=state.round + 1, vis_round=vis_round
+        ),
+        stats,
+    )
+
+
+def simulate(
+    cfg: ClusterConfig,
+    topo: Topology,
+    schedule: Schedule,
+    seed: int = 0,
+    state: ClusterState | None = None,
+) -> tuple[ClusterState, dict]:
+    """Scan `cluster_round` over the schedule. Returns final state + per-round
+    metric curves (numpy arrays of length schedule.rounds)."""
+    n = cfg.n_nodes
+    n_regions = int(np.asarray(topo.region).max()) + 1
+    has_churn = schedule.kill is not None or schedule.revive is not None
+    rounds = schedule.rounds
+
+    writes = jnp.asarray(schedule.writes, dtype=jnp.uint32)
+    if has_churn:
+        zeros_n = np.zeros((rounds, n), dtype=bool)
+        kill = jnp.asarray(
+            schedule.kill if schedule.kill is not None else zeros_n
+        )
+        revive = jnp.asarray(
+            schedule.revive if schedule.revive is not None else zeros_n
+        )
+    else:
+        # Dummy 1-wide masks: cluster_round skips churn entirely, and this
+        # avoids materializing rounds x N host arrays for churn-free runs.
+        kill = revive = jnp.zeros((rounds, 1), dtype=bool)
+    if schedule.partition is not None:
+        partition = jnp.asarray(schedule.partition)
+    else:
+        partition = jnp.zeros((rounds, n_regions, n_regions), dtype=bool)
+
+    s_writer = jnp.asarray(schedule.sample_writer)
+    s_ver = jnp.asarray(schedule.sample_ver)
+    s_round = jnp.asarray(schedule.sample_round)
+    if state is None:
+        state = init_cluster(cfg, len(schedule.sample_writer))
+    base_key = jax.random.PRNGKey(seed)
+
+    @jax.jit
+    def body(carry, xs):
+        w, p, kl, rv, r = xs
+        key = jax.random.fold_in(base_key, r)
+        new_state, stats = cluster_round(
+            carry, topo, w, p, kl, rv, s_writer, s_ver, s_round, key, cfg,
+            has_churn,
+        )
+        return new_state, stats
+
+    xs = (writes, partition, kill, revive, jnp.arange(rounds, dtype=jnp.int32))
+    final, curves = jax.lax.scan(body, state, xs)
+    curves = {k: np.asarray(v) for k, v in curves.items()}
+    return final, curves
+
+
+def visibility_latencies(
+    final: ClusterState, schedule: Schedule, cfg: ClusterConfig,
+    alive_only: bool = True,
+) -> dict:
+    """p50/p99/mean change-visibility latency (seconds) over sampled writes.
+
+    A (sample, node) pair that never became visible counts as +inf — if any
+    exist, ``unseen`` reports them and the percentiles are taken over seen
+    pairs only (callers should treat unseen > 0 as non-convergence).
+    """
+    vis = np.asarray(final.vis_round)  # [S, N]
+    if vis.size == 0:
+        return {"p50_s": float("nan"), "p99_s": float("nan"),
+                "mean_s": float("nan"), "unseen": 0, "pairs": 0}
+    alive = np.asarray(final.swim.alive)
+    if alive_only:
+        vis = vis[:, alive]
+    lat_rounds = vis - schedule.sample_round[:, None]
+    seen = vis >= 0
+    lat = lat_rounds[seen].astype(np.float64) * (cfg.round_ms / 1000.0)
+    return {
+        "p50_s": float(np.percentile(lat, 50)) if lat.size else float("nan"),
+        "p99_s": float(np.percentile(lat, 99)) if lat.size else float("nan"),
+        "mean_s": float(lat.mean()) if lat.size else float("nan"),
+        "unseen": int((~seen).sum()),
+        "pairs": int(seen.size),
+    }

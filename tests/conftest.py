@@ -1,15 +1,20 @@
-"""Test configuration: force an 8-device virtual CPU mesh before jax imports.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding tests run against
 ``--xla_force_host_platform_device_count=8`` exactly like the driver's
-multi-chip dry run.
+multi-chip dry run. The environment's sitecustomize grabs the real TPU chip
+(platform "axon") at interpreter start, so env vars alone are not enough —
+the platform is overridden via jax.config before any backend is touched.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
