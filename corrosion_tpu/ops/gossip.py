@@ -51,7 +51,16 @@ class GossipConfig:
     loss_prob: float = 0.0
     sync_interval: int = 10  # rounds between a node's sync sessions
     sync_budget: int = 256  # versions transferred per session (total)
-    sync_chunk: int = 64  # versions per writer per session (chunk cap)
+    sync_chunk: int = 64  # versions per writer per peer (chunk cap)
+    sync_peers: int = 3  # peers pulled from per session (ref: 3-10, agent.rs:84)
+    sync_candidates: int = 8  # candidate peers scored by need per session
+
+    def __post_init__(self):
+        if self.sync_peers > self.sync_candidates:
+            raise ValueError(
+                f"sync_peers ({self.sync_peers}) must be <= "
+                f"sync_candidates ({self.sync_candidates})"
+            )
 
     @property
     def fanout(self) -> int:
@@ -164,83 +173,100 @@ def broadcast_round(
     new_writer = jnp.broadcast_to(topo.writer_of_node[:, None], (n, mw))
 
     # ---- 2. fanout target selection ---------------------------------------
-    near = topo.region_start[:, None] + jax.random.randint(
-        k_near, (n, cfg.fanout_near), 0, 1 << 30
-    ) % jnp.maximum(topo.region_size[:, None], 1)
-    far = jax.random.randint(k_far, (n, cfg.fanout_far), 0, n)
-    recv = jnp.concatenate([near, far], axis=1)  # i32[N, F]
     f = cfg.fanout
-    link_ok = (
-        ~partition[topo.region[:, None], topo.region[recv]]
-        & alive[:, None]
-        & alive[recv]
-        & (recv != nodes[:, None])
-    )
-    lost = jax.random.uniform(k_loss, (n, f, q_cap)) < cfg.loss_prob
+    if f > 0:
+        near = topo.region_start[:, None] + jax.random.randint(
+            k_near, (n, cfg.fanout_near), 0, 1 << 30
+        ) % jnp.maximum(topo.region_size[:, None], 1)
+        far = jax.random.randint(k_far, (n, cfg.fanout_far), 0, n)
+        recv = jnp.concatenate([near, far], axis=1)  # i32[N, F]
+        link_ok = (
+            ~partition[topo.region[:, None], topo.region[recv]]
+            & alive[:, None]
+            & alive[recv]
+            & (recv != nodes[:, None])
+        )
+        lost = jax.random.uniform(k_loss, (n, f, q_cap)) < cfg.loss_prob
 
-    # ---- 3. delivery (one sorted pass over all messages) -------------------
-    # Message (sender, slot, fanout) → flat [M = N*Q*F]. A message is
-    # (recv, writer, version, tx). Promotion must respect version order, so
-    # instead of scanning queue slots with one serialized scatter each (slow:
-    # TPU scatters serialize per update), sort messages by (recv·W + writer,
-    # version) and find, per (recv, writer) segment, the longest contiguous
-    # version run starting at contig+1 — including runs stitched across
-    # senders — then apply with a single scatter-max.
-    m_recv = jnp.repeat(recv[:, None, :], q_cap, axis=1).reshape(-1)  # [M]
-    m_w = jnp.repeat(data.q_writer[:, :, None], f, axis=2).reshape(-1)
-    m_v = jnp.repeat(data.q_ver[:, :, None], f, axis=2).reshape(-1)
-    m_tx = jnp.repeat(data.q_tx[:, :, None], f, axis=2).reshape(-1)
-    m_ok = (
-        jnp.repeat(link_ok[:, None, :], q_cap, axis=1).reshape(-1)
-        & (m_w >= 0)
-        & ~lost.reshape(-1)
-    )
-    n_msgs = jnp.sum(m_ok)
+        # ---- 3. delivery (one sorted pass over all messages) ---------------
+        # Message (sender, slot, fanout) → flat [M = N*Q*F]. A message is
+        # (recv, writer, version, tx). Promotion must respect version order,
+        # so instead of scanning queue slots with one serialized scatter each
+        # (slow: TPU scatters serialize per update), sort messages by
+        # (recv·W + writer, version) and find, per (recv, writer) segment,
+        # the longest contiguous version run starting at contig+1 — including
+        # runs stitched across senders — then apply with one scatter-max.
+        m_recv = jnp.repeat(recv[:, None, :], q_cap, axis=1).reshape(-1)
+        m_w = jnp.repeat(data.q_writer[:, :, None], f, axis=2).reshape(-1)
+        m_v = jnp.repeat(data.q_ver[:, :, None], f, axis=2).reshape(-1)
+        m_tx = jnp.repeat(data.q_tx[:, :, None], f, axis=2).reshape(-1)
+        m_ok = (
+            jnp.repeat(link_ok[:, None, :], q_cap, axis=1).reshape(-1)
+            & (m_w >= 0)
+            & ~lost.reshape(-1)
+        )
+        n_msgs = jnp.sum(m_ok)
 
-    rw = m_recv * w_count + jnp.maximum(m_w, 0)  # flat (recv, writer) key
-    rw = jnp.where(m_ok, rw, n * w_count)  # invalid → sentinel segment
-    # Sort by version, then stably by segment key → segments of ascending v.
-    order1 = jnp.argsort(m_v.astype(jnp.int32), stable=True)
-    rw1, v1, tx1 = rw[order1], m_v[order1], m_tx[order1]
-    order2 = jnp.argsort(rw1, stable=True)
-    rw2, v2, tx2 = rw1[order2], v1[order2], tx1[order2]
-    valid2 = rw2 < n * w_count
+        rw = m_recv * w_count + jnp.maximum(m_w, 0)  # flat (recv, writer) key
+        rw = jnp.where(m_ok, rw, n * w_count)  # invalid → sentinel segment
+        # Sort by version, then stably by segment key → ascending-v segments.
+        order1 = jnp.argsort(m_v.astype(jnp.int32), stable=True)
+        rw1, v1, tx1 = rw[order1], m_v[order1], m_tx[order1]
+        order2 = jnp.argsort(rw1, stable=True)
+        rw2, v2, tx2 = rw1[order2], v1[order2], tx1[order2]
+        valid2 = rw2 < n * w_count
 
-    seg_start = jnp.concatenate([jnp.array([True]), rw2[1:] != rw2[:-1]])
-    base = contig.reshape(-1)[jnp.minimum(rw2, n * w_count - 1)]
-    prev_v = jnp.concatenate([jnp.zeros((1,), v2.dtype), v2[:-1]])
-    ok_link = jnp.where(seg_start, v2 <= base + 1, v2 <= prev_v + 1)
-    run = routing.segmented_prefix_and(ok_link & valid2, seg_start)
-    # Applied = delivered versions on an unbroken run from contig+1.
-    applied_v = jnp.where(run & valid2, v2, 0)
-    contig = (
-        contig.reshape(-1)
-        .at[jnp.where(valid2, rw2, 0)]
-        .max(jnp.where(valid2, applied_v, 0))
-        .reshape(n, w_count)
-    )
-    seen = (
-        seen.reshape(-1)
-        .at[jnp.where(valid2, rw2, 0)]
-        .max(jnp.where(valid2, v2, 0))
-        .reshape(n, w_count)
-    )
+        seg_start = jnp.concatenate([jnp.array([True]), rw2[1:] != rw2[:-1]])
+        base = contig.reshape(-1)[jnp.minimum(rw2, n * w_count - 1)]
+        prev_v = jnp.concatenate([jnp.zeros((1,), v2.dtype), v2[:-1]])
+        # A message extends the run when it lands at or below one past the
+        # better of (previous message in segment, already-held watermark):
+        # a stale retransmission ahead of v=contig+1 must not break the
+        # chain (v <= prev_v + 1 alone would — the prev can lag base).
+        ok_link = jnp.where(
+            seg_start,
+            v2 <= base + 1,
+            v2 <= jnp.maximum(prev_v, base) + 1,
+        )
+        run = routing.segmented_prefix_and(ok_link & valid2, seg_start)
+        # Applied = delivered versions on an unbroken run from contig+1.
+        applied_v = jnp.where(run & valid2, v2, 0)
+        contig = (
+            contig.reshape(-1)
+            .at[jnp.where(valid2, rw2, 0)]
+            .max(jnp.where(valid2, applied_v, 0))
+            .reshape(n, w_count)
+        )
+        seen = (
+            seen.reshape(-1)
+            .at[jnp.where(valid2, rw2, 0)]
+            .max(jnp.where(valid2, v2, 0))
+            .reshape(n, w_count)
+        )
 
-    # ---- 4. rebroadcast intake (epidemic requeue) --------------------------
-    k_in = cfg.fanout * 2  # bounded intake per receiver per round
-    in_mask, (in_w, in_v, in_tx) = routing.bounded_intake(
-        rw2 // w_count,
-        run & valid2 & (tx2 > 1),
-        (rw2 % w_count, v2, tx2 - 1),
-        n,
-        k_in,
-    )
+        # ---- 4. rebroadcast intake (epidemic requeue) ----------------------
+        k_in = cfg.fanout * 2  # bounded intake per receiver per round
+        in_mask, (in_w, in_v, in_tx) = routing.bounded_intake(
+            rw2 // w_count,
+            run & valid2 & (tx2 > 1),
+            (rw2 % w_count, v2, tx2 - 1),
+            n,
+            k_in,
+        )
+        sent_any = jnp.any(link_ok, axis=1)
+    else:
+        # Sync-only configuration: no fanout, no delivery, budgets retained.
+        n_msgs = jnp.uint32(0)
+        in_mask = jnp.zeros((n, 0), dtype=bool)
+        in_w = jnp.zeros((n, 0), jnp.int32)
+        in_v = jnp.zeros((n, 0), jnp.uint32)
+        in_tx = jnp.zeros((n, 0), jnp.int32)
+        sent_any = jnp.zeros((n,), dtype=bool)
 
     # ---- 5. queue rebuild (oldest versions first, like the FIFO buffer) ----
     # An entry's tx budget burns only when the sender actually reached at
     # least one peer this round (dead/fully-partitioned senders keep their
     # budget, matching the membership plane's sendable gating).
-    sent_any = jnp.any(link_ok, axis=1)
     old_tx = jnp.where(
         (data.q_writer >= 0) & sent_any[:, None], data.q_tx - 1,
         jnp.where(data.q_writer >= 0, data.q_tx, 0),
@@ -302,35 +328,92 @@ def sync_round(
     rng: jax.Array,
     cfg: GossipConfig,
 ) -> tuple[DataState, dict]:
-    """Anti-entropy pull sessions for nodes whose jittered timer is due."""
+    """Anti-entropy pull sessions for nodes whose jittered timer is due.
+
+    Need-aware multi-peer selection, mirroring the reference's sync peer
+    choice (corro-agent/src/agent.rs:2383-2423): score ``sync_candidates``
+    sampled peers (half ring-0/same-region, half cluster-wide) by how many
+    versions they hold that we lack (need desc), tie-break toward ring 0
+    (ring asc), and pull from the top ``sync_peers`` under one shared
+    session budget — the reference's 3-10 peers ordered by need.
+    """
     n = cfg.n_nodes
     nodes = jnp.arange(n)
-    k_peer = rng
+    k_near, k_far = jax.random.split(rng)
     due = alive & (
         (round_idx + topo.sync_phase) % jnp.int32(cfg.sync_interval) == 0
     )
-    peer = jax.random.randint(k_peer, (n,), 0, n)
-    ok = (
-        due
-        & alive[peer]
-        & (peer != nodes)
-        & ~partition[topo.region, topo.region[peer]]
+
+    # Candidate sample: same-region ("ring 0") and uniform far peers.
+    c_near = cfg.sync_candidates // 2
+    c_far = cfg.sync_candidates - c_near
+    near = topo.region_start[:, None] + jax.random.randint(
+        k_near, (n, c_near), 0, 1 << 30
+    ) % jnp.maximum(topo.region_size[:, None], 1)
+    far = jax.random.randint(k_far, (n, c_far), 0, n)
+    cand = jnp.concatenate([near, far], axis=1)  # i32[N, C]
+    ok_c = (
+        due[:, None]
+        & alive[cand]
+        & (cand != nodes[:, None])
+        & ~partition[topo.region[:, None], topo.region[cand]]
     )
-    p_contig = data.contig[peer]  # [N, W] server's watermarks
-    p_seen = data.seen[peer]
-    deficit = jnp.where(
-        ok[:, None], (p_contig - jnp.minimum(p_contig, data.contig)), 0
-    ).astype(jnp.uint32)
-    per_w = jnp.minimum(deficit, jnp.uint32(cfg.sync_chunk)).astype(jnp.int32)
-    cum = jnp.cumsum(per_w, axis=1)
-    budget = jnp.int32(cfg.sync_budget)
-    grant = jnp.clip(budget - (cum - per_w), 0, per_w).astype(jnp.uint32)
-    contig = data.contig + grant
-    seen = jnp.maximum(data.seen, jnp.where(ok[:, None], p_seen, 0))
+
+    # Exact per-candidate need (versions the candidate holds that we lack),
+    # computed one candidate column at a time to keep the transient at
+    # [N, W] instead of [N, C, W].
+    c_count = cfg.sync_candidates
+    need_cols = []
+    for c in range(c_count):
+        cc = data.contig[cand[:, c]]  # [N, W]
+        need_cols.append(
+            jnp.sum(
+                (cc - jnp.minimum(cc, data.contig)).astype(jnp.uint32),
+                axis=-1,
+                dtype=jnp.int32,
+            )
+        )
+    defc = jnp.stack(need_cols, axis=1)  # i32[N, C]
+
+    same_region = topo.region[cand] == topo.region[:, None]
+    # Candidates are sampled with replacement; mask duplicate columns so a
+    # single peer cannot occupy several of the top slots (and soak up
+    # sync_peers x chunk from one source).
+    dup = jnp.zeros_like(ok_c)
+    for i in range(1, c_count):
+        dup = dup.at[:, i].set(
+            jnp.any(cand[:, :i] == cand[:, i : i + 1], axis=1)
+        )
+    # need desc, ring asc: scale need so the ring bonus only breaks ties.
+    score = jnp.where(ok_c & ~dup & (defc > 0), defc * 2 + same_region, -1)
+    order = jnp.argsort(-score, axis=1, stable=True)[:, : cfg.sync_peers]
+    sel = jnp.take_along_axis(cand, order, axis=1)  # i32[N, S]
+    sel_ok = jnp.take_along_axis(score, order, axis=1) > 0
+
+    # Pull from selected peers in need order under one shared budget.
+    contig = data.contig
+    seen = data.seen
+    budget_left = jnp.full((n,), cfg.sync_budget, jnp.int32)
+    for s in range(cfg.sync_peers):
+        p = sel[:, s]
+        ok_s = sel_ok[:, s]
+        p_contig = data.contig[p]  # [N, W]
+        deficit = (p_contig - jnp.minimum(p_contig, contig)).astype(jnp.uint32)
+        per_w = jnp.minimum(deficit, jnp.uint32(cfg.sync_chunk)).astype(jnp.int32)
+        per_w = jnp.where(ok_s[:, None], per_w, 0)
+        cum = jnp.cumsum(per_w, axis=1)
+        grant = jnp.clip(
+            budget_left[:, None] - (cum - per_w), 0, per_w
+        ).astype(jnp.uint32)
+        contig = contig + grant
+        budget_left = budget_left - jnp.sum(grant, axis=1, dtype=jnp.int32)
+        seen = jnp.maximum(seen, jnp.where(ok_s[:, None], data.seen[p], 0))
     seen = jnp.maximum(seen, contig)
     stats = {
-        "applied_sync": jnp.sum(grant, dtype=jnp.uint32),
-        "sessions": jnp.sum(ok),
+        "applied_sync": jnp.sum(contig - data.contig, dtype=jnp.uint32),
+        # Due nodes with at least one reachable candidate (whether or not
+        # any need was found) — matches the pre-multi-peer meaning.
+        "sessions": jnp.sum(jnp.any(ok_c, axis=1)),
     }
     return data._replace(contig=contig, seen=seen), stats
 
