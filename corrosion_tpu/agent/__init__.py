@@ -1,0 +1,9 @@
+"""Host agent: the product surface around the TPU kernels.
+
+Python/SQLite equivalent of the reference's corro-agent + corro-types host
+runtime: a CRDT SQLite store (cr-sqlite's role), version bookkeeping, gossip
+broadcast + anti-entropy sync over a TCP transport, an HTTP API with
+streaming subscriptions, and the background loops that tie them together.
+"""
+
+from corrosion_tpu.agent.store import Store, StoreError, SchemaError  # noqa: F401

@@ -1,0 +1,623 @@
+"""CRDT SQLite store — the host-side replacement for cr-sqlite's C engine.
+
+The reference vendors cr-sqlite as a prebuilt C extension
+(corro-types/src/sqlite.rs:20-26) providing per-table clock tables, the
+`crsql_changes` virtual table, and LWW + causal-length merge
+(doc/crdts.md:11-28). This module implements the same replication contract
+natively over stock SQLite:
+
+- ``apply_schema`` marks user tables as CRRs: a ``{t}__crdt_rows`` causal-
+  length table, a ``{t}__crdt_clock`` per-cell version table, and AFTER
+  INSERT/UPDATE/DELETE triggers that record every local cell write into the
+  ``__crdt_changes`` log (the `crsql_changes` analogue) with
+  (col_version, db_version, seq, site_id, cl).
+- ``execute_transaction`` wraps user statements with db_version/seq
+  allocation, mirroring the write path of api_v1_transactions
+  (corro-agent/src/api/public/mod.rs:33-142: crsql_next_db_version, MAX(seq),
+  read-back of the changeset).
+- ``apply_changes`` merges remote changes with exact cr-sqlite precedence:
+  causal length first (bigger cl wins; even = deleted), then col_version,
+  then value order (`value_cmp_key` — "biggest value wins",
+  doc/crdts.md:15-16). Equivalent to `INSERT INTO crsql_changes` per change
+  (agent.rs:2192-2214) and returns the applied count
+  (`crsql_rows_impacted`, agent.rs:2215-2231).
+
+The merge math itself also exists as the batched TPU kernel (ops/crdt.py);
+this store materializes per-node state for the product surface (queries,
+subscriptions) and the in-process cluster tests.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from dataclasses import dataclass
+
+from corrosion_tpu.core.values import (
+    Change,
+    Statement,
+    ExecResult,
+    SqliteValue,
+    pack_columns,
+    unpack_columns,
+    value_cmp_key,
+)
+
+
+class StoreError(Exception):
+    pass
+
+
+class SchemaError(StoreError):
+    pass
+
+
+INTERNAL_PREFIXES = ("__corro_", "__crdt_", "sqlite_")
+
+
+@dataclass(frozen=True)
+class TableInfo:
+    name: str
+    pk_cols: tuple[str, ...]
+    data_cols: tuple[str, ...]
+    create_sql: str
+
+
+def _q(ident: str) -> str:
+    """Quote an SQL identifier."""
+    return '"' + ident.replace('"', '""') + '"'
+
+
+class Store:
+    """One node's materialized database + CRDT change tracking.
+
+    Thread-safety: a single writer lock serializes write transactions (the
+    SplitPool's one-writer discipline, corro-types/src/agent.rs:353-547);
+    reads open no transaction and SQLite WAL lets them proceed.
+    """
+
+    def __init__(self, path: str, site_id: bytes) -> None:
+        if len(site_id) != 16:
+            raise StoreError("site_id must be 16 bytes")
+        self.path = path
+        self.site_id = site_id
+        self._write_lock = threading.Lock()
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.conn.execute("PRAGMA synchronous=NORMAL")
+        # setup_conn pragmas (corro-types/src/sqlite.rs:107-118)
+        self.conn.create_function("corro_pack", -1, _sql_pack, deterministic=True)
+        self._tables: dict[str, TableInfo] = {}
+        self._migrate()
+        self._load_schema()
+
+    def close(self) -> None:
+        self.conn.close()
+
+    # -- internal tables (migrate framework, sqlite.rs:120-168) -------------
+
+    def _migrate(self) -> None:
+        c = self.conn
+        with self._write_lock, c:
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS __corro_meta "
+                "(key TEXT PRIMARY KEY, value) WITHOUT ROWID"
+            )
+            for k, v in (
+                ("db_version", 0),
+                ("seq", -1),
+                ("apply_remote", 0),
+            ):
+                c.execute(
+                    "INSERT OR IGNORE INTO __corro_meta VALUES (?, ?)", (k, v)
+                )
+            c.execute(
+                "INSERT OR IGNORE INTO __corro_meta VALUES ('site_id', ?)",
+                (self.site_id,),
+            )
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS __crdt_changes ("
+                " tbl TEXT NOT NULL, pk BLOB NOT NULL, cid TEXT NOT NULL,"
+                " val, col_version INTEGER NOT NULL,"
+                " db_version INTEGER NOT NULL, seq INTEGER NOT NULL,"
+                " site_id BLOB NOT NULL, cl INTEGER NOT NULL)"
+            )
+            c.execute(
+                "CREATE INDEX IF NOT EXISTS __crdt_changes_site_dbv"
+                " ON __crdt_changes (site_id, db_version, seq)"
+            )
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS __corro_schema ("
+                " tbl_name TEXT PRIMARY KEY, create_sql TEXT NOT NULL"
+                ") WITHOUT ROWID"
+            )
+            # Replication bookkeeping persisted for restart rehydration
+            # (agent.rs:147-268; tables at corro-types/src/agent.rs:232-314).
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS __corro_bookkeeping ("
+                " actor_id BLOB NOT NULL, start_version INTEGER NOT NULL,"
+                " end_version INTEGER, db_version INTEGER,"
+                " last_seq INTEGER, ts INTEGER,"
+                " PRIMARY KEY (actor_id, start_version)) WITHOUT ROWID"
+            )
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS __corro_buffered_changes ("
+                " actor_id BLOB NOT NULL, version INTEGER NOT NULL,"
+                " tbl TEXT NOT NULL, pk BLOB NOT NULL, cid TEXT NOT NULL,"
+                " val, col_version INTEGER NOT NULL,"
+                " db_version INTEGER NOT NULL, seq INTEGER NOT NULL,"
+                " site_id BLOB NOT NULL, cl INTEGER NOT NULL,"
+                " PRIMARY KEY (actor_id, version, seq)) WITHOUT ROWID"
+            )
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS __corro_seq_bookkeeping ("
+                " actor_id BLOB NOT NULL, version INTEGER NOT NULL,"
+                " start_seq INTEGER NOT NULL, end_seq INTEGER NOT NULL,"
+                " last_seq INTEGER NOT NULL, ts INTEGER NOT NULL,"
+                " PRIMARY KEY (actor_id, version, start_seq)) WITHOUT ROWID"
+            )
+
+    def _load_schema(self) -> None:
+        for name, sql in self.conn.execute(
+            "SELECT tbl_name, create_sql FROM __corro_schema"
+        ):
+            self._tables[name] = self._introspect(name, sql)
+
+    # -- schema management (schema.rs apply_schema, :266-628) ----------------
+
+    def _introspect(self, name: str, create_sql: str) -> TableInfo:
+        rows = list(self.conn.execute(f"PRAGMA table_info({_q(name)})"))
+        pk = tuple(r[1] for r in sorted(rows, key=lambda r: r[5]) if r[5] > 0)
+        data = tuple(r[1] for r in rows if r[5] == 0)
+        if not pk:
+            raise SchemaError(
+                f"table {name} has no primary key — every CRR needs one "
+                "(schema.rs requires non-null PKs)"
+            )
+        return TableInfo(name=name, pk_cols=pk, data_cols=data, create_sql=create_sql)
+
+    def apply_schema(self, schema_sql: str) -> list[str]:
+        """Parse DDL, diff vs the current schema, apply additive changes and
+        CRR-ify new tables. Destructive changes (dropped tables/columns,
+        changed PKs) are rejected (schema.rs:266-628 forbids them).
+
+        Returns the list of new/changed table names.
+        """
+        tmp = sqlite3.connect(":memory:")
+        try:
+            tmp.executescript(schema_sql)
+            desired: dict[str, str] = {
+                name: sql
+                for name, sql in tmp.execute(
+                    "SELECT name, sql FROM sqlite_master"
+                    " WHERE type='table' AND name NOT LIKE 'sqlite_%'"
+                )
+            }
+        except sqlite3.Error as e:
+            raise SchemaError(f"bad schema sql: {e}") from e
+        finally:
+            tmp.close()
+
+        changed: list[str] = []
+        for name in self._tables:
+            if name not in desired:
+                raise SchemaError(f"cannot drop table {name} (destructive)")
+
+        with self._write_lock, self.conn as c:
+            for name, sql in desired.items():
+                if name.startswith(INTERNAL_PREFIXES):
+                    raise SchemaError(f"reserved table name {name}")
+                if name not in self._tables:
+                    c.execute(sql)
+                    info = self._introspect(name, sql)
+                    self._create_crr(c, info)
+                    c.execute(
+                        "INSERT OR REPLACE INTO __corro_schema VALUES (?, ?)",
+                        (name, sql),
+                    )
+                    self._tables[name] = info
+                    changed.append(name)
+                else:
+                    old = self._tables[name]
+                    new_info = self._desired_info(sql)
+                    if new_info.pk_cols != old.pk_cols:
+                        raise SchemaError(
+                            f"cannot change primary key of {name}"
+                        )
+                    dropped = set(old.data_cols) - set(new_info.data_cols)
+                    if dropped:
+                        raise SchemaError(
+                            f"cannot drop columns {sorted(dropped)} of {name}"
+                        )
+                    added = [
+                        col for col in new_info.data_cols
+                        if col not in old.data_cols
+                    ]
+                    if added:
+                        for col in added:
+                            col_def = self._column_def(sql, col)
+                            c.execute(
+                                f"ALTER TABLE {_q(name)} ADD COLUMN {col_def}"
+                            )
+                        info = self._introspect(name, sql)
+                        self._drop_triggers(c, old)
+                        self._create_triggers(c, info)
+                        c.execute(
+                            "UPDATE __corro_schema SET create_sql=? WHERE tbl_name=?",
+                            (sql, name),
+                        )
+                        self._tables[name] = info
+                        changed.append(name)
+        return changed
+
+    def _desired_info(self, create_sql: str) -> TableInfo:
+        tmp = sqlite3.connect(":memory:")
+        try:
+            tmp.execute(create_sql)
+            rows = list(
+                tmp.execute(
+                    "PRAGMA table_info("
+                    + _q(next(iter(
+                        n for (n,) in tmp.execute(
+                            "SELECT name FROM sqlite_master WHERE type='table'"
+                        )
+                    )))
+                    + ")"
+                )
+            )
+        finally:
+            tmp.close()
+        pk = tuple(r[1] for r in sorted(rows, key=lambda r: r[5]) if r[5] > 0)
+        data = tuple(r[1] for r in rows if r[5] == 0)
+        return TableInfo(name="", pk_cols=pk, data_cols=data, create_sql=create_sql)
+
+    @staticmethod
+    def _column_def(create_sql: str, col: str) -> str:
+        """Extract a column definition from CREATE TABLE sql (best effort:
+        name + type only, constraints beyond DEFAULT are not carried)."""
+        tmp = sqlite3.connect(":memory:")
+        try:
+            tmp.execute(create_sql)
+            (tbl,) = next(
+                iter(tmp.execute("SELECT name FROM sqlite_master WHERE type='table'"))
+            ),
+            for r in tmp.execute(f'PRAGMA table_info("{tbl[0]}")'):
+                if r[1] == col:
+                    type_ = r[2] or ""
+                    dflt = f" DEFAULT {r[4]}" if r[4] is not None else ""
+                    return f"{_q(col)} {type_}{dflt}"
+        finally:
+            tmp.close()
+        raise SchemaError(f"column {col} not found")
+
+    # -- CRR machinery (crsql_as_crr analogue) -------------------------------
+
+    def _create_crr(self, c: sqlite3.Connection, info: TableInfo) -> None:
+        t = info.name
+        c.execute(
+            f"CREATE TABLE IF NOT EXISTS {_q(t + '__crdt_rows')} ("
+            " pk BLOB PRIMARY KEY, cl INTEGER NOT NULL) WITHOUT ROWID"
+        )
+        c.execute(
+            f"CREATE TABLE IF NOT EXISTS {_q(t + '__crdt_clock')} ("
+            " pk BLOB NOT NULL, cid TEXT NOT NULL,"
+            " col_version INTEGER NOT NULL, db_version INTEGER NOT NULL,"
+            " seq INTEGER NOT NULL, site_id BLOB,"
+            " PRIMARY KEY (pk, cid)) WITHOUT ROWID"
+        )
+        self._create_triggers(c, info)
+
+    def _drop_triggers(self, c: sqlite3.Connection, info: TableInfo) -> None:
+        t = info.name
+        for suffix in (
+            ["ins", "del"] + [f"upd_{col}" for col in info.data_cols]
+        ):
+            c.execute(f"DROP TRIGGER IF EXISTS {_q(t + '__crdt_' + suffix)}")
+
+    def _create_triggers(self, c: sqlite3.Connection, info: TableInfo) -> None:
+        t = info.name
+        pk_expr = "corro_pack(" + ", ".join(
+            f"NEW.{_q(col)}" for col in info.pk_cols
+        ) + ")"
+        old_pk_expr = "corro_pack(" + ", ".join(
+            f"OLD.{_q(col)}" for col in info.pk_cols
+        ) + ")"
+        dbv = "(SELECT value FROM __corro_meta WHERE key='db_version')"
+        seq = "(SELECT value FROM __corro_meta WHERE key='seq')"
+        local_guard = (
+            "WHEN (SELECT value FROM __corro_meta WHERE key='apply_remote') = 0"
+        )
+        rows_t = _q(t + "__crdt_rows")
+        clock_t = _q(t + "__crdt_clock")
+
+        def cell_sql(col: str, new_pk: str) -> str:
+            qc = _q(col)
+            return (
+                "UPDATE __corro_meta SET value = value + 1 WHERE key='seq';\n"
+                f"INSERT INTO {clock_t} (pk, cid, col_version, db_version, seq, site_id)"
+                f" VALUES ({new_pk}, '{col}', 1, {dbv}, {seq}, NULL)"
+                " ON CONFLICT (pk, cid) DO UPDATE SET"
+                "  col_version = col_version + 1,"
+                "  db_version = excluded.db_version,"
+                "  seq = excluded.seq, site_id = NULL;\n"
+                "INSERT INTO __crdt_changes"
+                " (tbl, pk, cid, val, col_version, db_version, seq, site_id, cl)"
+                f" SELECT '{t}', {new_pk}, '{col}', NEW.{qc},"
+                f"  (SELECT col_version FROM {clock_t} WHERE pk = {new_pk} AND cid = '{col}'),"
+                f"  {dbv}, {seq},"
+                "  (SELECT value FROM __corro_meta WHERE key='site_id'),"
+                f"  (SELECT cl FROM {rows_t} WHERE pk = {new_pk});\n"
+            )
+
+        # INSERT: resurrect-or-create the row's causal length, then record
+        # every data column (or a pk-only marker).
+        body = (
+            f"INSERT INTO {rows_t} (pk, cl) VALUES ({pk_expr}, 1)"
+            " ON CONFLICT (pk) DO UPDATE SET"
+            "  cl = CASE WHEN cl % 2 = 0 THEN cl + 1 ELSE cl END;\n"
+        )
+        if info.data_cols:
+            for col in info.data_cols:
+                body += cell_sql(col, pk_expr)
+        else:
+            body += (
+                "UPDATE __corro_meta SET value = value + 1 WHERE key='seq';\n"
+                "INSERT INTO __crdt_changes"
+                " (tbl, pk, cid, val, col_version, db_version, seq, site_id, cl)"
+                f" SELECT '{t}', {pk_expr}, '{Change.PKONLY_CID}', NULL, 1,"
+                f" {dbv}, {seq},"
+                " (SELECT value FROM __corro_meta WHERE key='site_id'),"
+                f" (SELECT cl FROM {rows_t} WHERE pk = {pk_expr});\n"
+            )
+        c.execute(
+            f"CREATE TRIGGER {_q(t + '__crdt_ins')} AFTER INSERT ON {_q(t)}"
+            f" {local_guard} BEGIN\n{body}END"
+        )
+
+        # UPDATE: one trigger per data column, firing only on real change.
+        for col in info.data_cols:
+            qc = _q(col)
+            c.execute(
+                f"CREATE TRIGGER {_q(t + '__crdt_upd_' + col)}"
+                f" AFTER UPDATE OF {qc} ON {_q(t)}"
+                f" {local_guard} AND (NEW.{qc} IS NOT OLD.{qc})"
+                f" BEGIN\n{cell_sql(col, pk_expr)}END"
+            )
+
+        # DELETE: causal length goes even, clock clears, sentinel change.
+        c.execute(
+            f"CREATE TRIGGER {_q(t + '__crdt_del')} AFTER DELETE ON {_q(t)}"
+            f" {local_guard} BEGIN\n"
+            f"UPDATE {rows_t} SET cl = cl + 1 WHERE pk = {old_pk_expr} AND cl % 2 = 1;\n"
+            f"DELETE FROM {clock_t} WHERE pk = {old_pk_expr};\n"
+            "UPDATE __corro_meta SET value = value + 1 WHERE key='seq';\n"
+            "INSERT INTO __crdt_changes"
+            " (tbl, pk, cid, val, col_version, db_version, seq, site_id, cl)"
+            f" SELECT '{t}', {old_pk_expr}, '{Change.DELETE_CID}', NULL, 1,"
+            f" {dbv}, {seq},"
+            " (SELECT value FROM __corro_meta WHERE key='site_id'),"
+            f" (SELECT cl FROM {rows_t} WHERE pk = {old_pk_expr});\n"
+            "END"
+        )
+
+    # -- reads ---------------------------------------------------------------
+
+    def query(self, stmt: Statement) -> tuple[list[str], list[tuple]]:
+        cur = self.conn.execute(stmt.sql, stmt.params())
+        cols = [d[0] for d in cur.description] if cur.description else []
+        return cols, cur.fetchall()
+
+    def db_version(self) -> int:
+        (v,) = self.conn.execute(
+            "SELECT value FROM __corro_meta WHERE key='db_version'"
+        ).fetchone()
+        return v
+
+    def tables(self) -> dict[str, TableInfo]:
+        return dict(self._tables)
+
+    # -- local writes (make_broadcastable_changes, public/mod.rs:33-191) -----
+
+    def execute_transaction(
+        self, statements: list[Statement]
+    ) -> tuple[list[ExecResult], int, int, list[Change]]:
+        """Run statements in one write txn; allocate a db_version; read back
+        the changeset. Returns (results, db_version, last_seq, changes);
+        db_version is 0 and changes empty when nothing was recorded."""
+        c = self.conn
+        with self._write_lock:
+            try:
+                c.execute("BEGIN IMMEDIATE")
+                c.execute(
+                    "UPDATE __corro_meta SET value = value + 1"
+                    " WHERE key='db_version'"
+                )
+                c.execute("UPDATE __corro_meta SET value = -1 WHERE key='seq'")
+                dbv = self.db_version()
+                results = []
+                for st in statements:
+                    cur = c.execute(st.sql, st.params())
+                    results.append(
+                        ExecResult(rows_affected=max(cur.rowcount, 0))
+                    )
+                changes = self._read_changes(dbv)
+                if not changes:
+                    # No CRR rows touched: give the db_version back
+                    # (the has_changes check, public/mod.rs:67-80).
+                    c.execute(
+                        "UPDATE __corro_meta SET value = value - 1"
+                        " WHERE key='db_version'"
+                    )
+                    dbv = 0
+                c.execute("COMMIT")
+            except Exception:
+                c.execute("ROLLBACK")
+                raise
+        last_seq = max((ch.seq for ch in changes), default=0)
+        return results, dbv, last_seq, changes
+
+    def _read_changes(self, dbv: int) -> list[Change]:
+        rows = self.conn.execute(
+            "SELECT tbl, pk, cid, val, col_version, db_version, seq, site_id, cl"
+            " FROM __crdt_changes WHERE db_version = ? AND site_id = ?"
+            " ORDER BY seq",
+            (dbv, self.site_id),
+        ).fetchall()
+        return [Change.from_tuple(r) for r in rows]
+
+    def changes_for(
+        self, site_id: bytes, db_version: int,
+        seqs: tuple[int, int] | None = None,
+    ) -> list[Change]:
+        """Serve a changeset for sync (handle_known_version's read,
+        peer.rs:358-562), optionally restricted to a seq range."""
+        sql = (
+            "SELECT tbl, pk, cid, val, col_version, db_version, seq, site_id, cl"
+            " FROM __crdt_changes WHERE site_id = ? AND db_version = ?"
+        )
+        args: list = [site_id, db_version]
+        if seqs is not None:
+            sql += " AND seq BETWEEN ? AND ?"
+            args += [seqs[0], seqs[1]]
+        sql += " ORDER BY seq"
+        return [
+            Change.from_tuple(r) for r in self.conn.execute(sql, args).fetchall()
+        ]
+
+    # -- remote merge (process_multiple_changes, agent.rs:1809-2060) ---------
+
+    def apply_changes(self, changes: list[Change]) -> int:
+        """Merge remote changes in one txn; returns the applied count."""
+        c = self.conn
+        applied = 0
+        with self._write_lock:
+            try:
+                c.execute("BEGIN IMMEDIATE")
+                c.execute(
+                    "UPDATE __corro_meta SET value = 1 WHERE key='apply_remote'"
+                )
+                for ch in changes:
+                    if self._apply_one(c, ch):
+                        applied += 1
+                c.execute("COMMIT")
+            except Exception:
+                c.execute("ROLLBACK")
+                raise
+            finally:
+                c.execute(
+                    "UPDATE __corro_meta SET value = 0 WHERE key='apply_remote'"
+                )
+        return applied
+
+    def _apply_one(self, c: sqlite3.Connection, ch: Change) -> bool:
+        info = self._tables.get(ch.table)
+        if info is None:
+            return False  # unknown table (schema lag): drop, sync re-serves
+        rows_t = _q(ch.table + "__crdt_rows")
+        clock_t = _q(ch.table + "__crdt_clock")
+        row = c.execute(
+            f"SELECT cl FROM {rows_t} WHERE pk = ?", (ch.pk,)
+        ).fetchone()
+        local_cl = row[0] if row else 0
+
+        if ch.cl < local_cl:
+            return False  # stale causal epoch
+        if ch.cl > local_cl:
+            # Adopt the newer epoch.
+            c.execute(
+                f"INSERT INTO {rows_t} (pk, cl) VALUES (?, ?)"
+                " ON CONFLICT (pk) DO UPDATE SET cl = excluded.cl",
+                (ch.pk, ch.cl),
+            )
+            c.execute(f"DELETE FROM {clock_t} WHERE pk = ?", (ch.pk,))
+            if ch.cl % 2 == 0:
+                self._delete_row(c, info, ch.pk)
+            else:
+                self._ensure_row(c, info, ch.pk)
+            self._log_change(c, ch)
+            if ch.cl % 2 == 0 or ch.cid in (
+                Change.DELETE_CID, Change.PKONLY_CID,
+            ):
+                return True
+            # fall through: apply the cell in the fresh epoch
+        else:
+            if ch.cl % 2 == 0:
+                return False  # duplicate delete
+            if ch.cid == Change.DELETE_CID:
+                return False  # delete sentinel for an epoch we've superseded
+            if ch.cid == Change.PKONLY_CID:
+                self._ensure_row(c, info, ch.pk)
+                self._log_change(c, ch)
+                return True
+
+        if ch.cid not in info.data_cols:
+            return False  # column we don't know (additive schema lag)
+
+        prev = c.execute(
+            f"SELECT col_version FROM {clock_t} WHERE pk = ? AND cid = ?",
+            (ch.pk, ch.cid),
+        ).fetchone()
+        if prev is not None:
+            local_cv = prev[0]
+            if ch.col_version < local_cv:
+                return False
+            if ch.col_version == local_cv:
+                local_val = self._cell_value(c, info, ch.pk, ch.cid)
+                if value_cmp_key(ch.val) <= value_cmp_key(local_val):
+                    return False  # we win or tie exactly (idempotent)
+        self._ensure_row(c, info, ch.pk)
+        c.execute(
+            f"UPDATE {_q(info.name)} SET {_q(ch.cid)} = ? WHERE "
+            + " AND ".join(f"{_q(k)} = ?" for k in info.pk_cols),
+            (ch.val, *unpack_columns(ch.pk)),
+        )
+        c.execute(
+            f"INSERT INTO {clock_t} (pk, cid, col_version, db_version, seq, site_id)"
+            " VALUES (?, ?, ?, ?, ?, ?)"
+            " ON CONFLICT (pk, cid) DO UPDATE SET"
+            "  col_version = excluded.col_version,"
+            "  db_version = excluded.db_version,"
+            "  seq = excluded.seq, site_id = excluded.site_id",
+            (ch.pk, ch.cid, ch.col_version, ch.db_version, ch.seq, ch.site_id),
+        )
+        self._log_change(c, ch)
+        return True
+
+    def _log_change(self, c: sqlite3.Connection, ch: Change) -> None:
+        # Keep the winning change re-servable for third-party sync
+        # (the crsql_changes vtab serves merged state by (site, db_version)).
+        c.execute(
+            "INSERT INTO __crdt_changes"
+            " (tbl, pk, cid, val, col_version, db_version, seq, site_id, cl)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            ch.to_tuple(),
+        )
+
+    def _ensure_row(self, c: sqlite3.Connection, info: TableInfo, pk: bytes) -> None:
+        cols = ", ".join(_q(k) for k in info.pk_cols)
+        ph = ", ".join("?" for _ in info.pk_cols)
+        c.execute(
+            f"INSERT OR IGNORE INTO {_q(info.name)} ({cols}) VALUES ({ph})",
+            unpack_columns(pk),
+        )
+
+    def _delete_row(self, c: sqlite3.Connection, info: TableInfo, pk: bytes) -> None:
+        c.execute(
+            f"DELETE FROM {_q(info.name)} WHERE "
+            + " AND ".join(f"{_q(k)} = ?" for k in info.pk_cols),
+            unpack_columns(pk),
+        )
+
+    def _cell_value(
+        self, c: sqlite3.Connection, info: TableInfo, pk: bytes, cid: str
+    ) -> SqliteValue:
+        row = c.execute(
+            f"SELECT {_q(cid)} FROM {_q(info.name)} WHERE "
+            + " AND ".join(f"{_q(k)} = ?" for k in info.pk_cols),
+            unpack_columns(pk),
+        ).fetchone()
+        return row[0] if row else None
+
+
+def _sql_pack(*values: SqliteValue) -> bytes:
+    return pack_columns(values)

@@ -46,7 +46,7 @@ def three_node(n_inserts: int = 1000, samples: int = 256):
     All three nodes write round-robin, 4 versions per writer per round, then
     the run drains until convergence (like integration-tests' baseline).
     """
-    cfg, topo = _cfg(3, writers=[0, 1, 2], sync_interval=4)
+    cfg, topo = _cfg(3, writers=[0, 1, 2], sync_interval=4, n_cells=256)
     per_round = 3 * 4
     write_rounds = (n_inserts + per_round - 1) // per_round
     drain = 30
@@ -75,7 +75,7 @@ def churn_32(rounds: int = 400, samples: int = 128, seed: int = 1):
     `mismatches` curve (SWIM convergence time after each churn event).
     """
     n = 32
-    cfg, topo = _cfg(n, writers=list(range(n)), sync_interval=8)
+    cfg, topo = _cfg(n, writers=list(range(n)), sync_interval=8, n_cells=256)
     rng = np.random.default_rng(seed)
     writes = np.zeros((rounds, n), np.uint32)
     write_mask = rng.random((rounds, n)) < 0.02
@@ -114,6 +114,7 @@ def anti_entropy_1k(n: int = 1000, burst: int = 2000, samples: int = 256):
         sync_budget=256,
         sync_chunk=64,
         queue=16,
+        n_cells=512,
     )
     per_round = len(writers) * 4
     burst_rounds = (burst + per_round - 1) // per_round
@@ -129,9 +130,10 @@ def merge_10k(n: int = 10_000, rounds: int = 120, samples: int = 256,
     """Config 4: 10k nodes, everyone writes concurrently (LWW merge storm).
 
     Writes are sparse per round (Poisson-ish 1% of writers/round) so the
-    broadcast plane stays in its operating regime; the CRDT cell merge for
-    the resulting change batches is benchmarked by ops.crdt.apply_changes
-    (bench.py runs it on the same write volume).
+    broadcast plane stays in its operating regime. The CRDT cell plane is
+    live (n_cells > 0): every applied version scatter-merges its derived
+    (cl, col_version, value_rank) rows into the receiving node's registers,
+    so convergence here is over merged cell state, not just watermarks.
     """
     writers = list(range(n))
     cfg, topo = _cfg(
@@ -141,6 +143,8 @@ def merge_10k(n: int = 10_000, rounds: int = 120, samples: int = 256,
         sync_interval=10,
         sync_budget=512,
         sync_chunk=32,
+        n_cells=1024,
+        cells_per_write=2,
     )
     rng = np.random.default_rng(seed)
     writes = (rng.random((rounds, n)) < 0.01).astype(np.uint32)
@@ -168,6 +172,7 @@ def wan_100k(n: int = 100_000, n_regions: int = 20, n_writers: int = 512,
         sync_chunk=64,
         fanout_near=2,
         fanout_far=1,
+        n_cells=256,
     )
     writes = (rng.random((rounds, n_writers)) < 0.05).astype(np.uint32)
     writes[rounds - 80 :, :] = 0

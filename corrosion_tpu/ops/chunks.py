@@ -1,0 +1,177 @@
+"""Seq-granular chunk dissemination + partial-version buffering kernel.
+
+The reference streams a large transaction as <=8 KiB chunks tagged with
+inclusive seq ranges (corro-types/src/change.rs:8-116), buffers out-of-order
+chunks with gap tracking until the version is complete
+(corro-agent/src/agent.rs:2063-2151, 1667-1806), and lets anti-entropy
+request individual missing seq ranges (`SyncNeedV1::Partial`,
+corro-types/src/sync.rs:248-266).
+
+This kernel is the batched TPU equivalent for S concurrent large
+transactions ("streams", each a (writer, version) pair): per (node, stream)
+coverage is a fixed-capacity interval tensor (ops.intervals); chunks gossip
+epidemically as random covered sub-ranges; due nodes run partial-need sync —
+compute their seq gaps, request up to ``gap_requests`` of them from a peer,
+and insert what the peer can grant under a per-session seq budget. A stream
+is *applied* at a node once its contiguous watermark reaches ``last_seq``
+(the gap-free condition that triggers process_fully_buffered_changes in the
+reference).
+
+The main data plane (ops.gossip) tracks whole versions — matching the
+reference, where seq state exists only while a version is partial and
+collapses once applied.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.ops import intervals, routing
+from corrosion_tpu.ops.intervals import IntervalSet
+
+
+@dataclass(frozen=True)
+class ChunkConfig:
+    n_nodes: int
+    n_streams: int  # concurrent large transactions
+    cap: int = 16  # interval slots per (node, stream)
+    chunk_len: int = 256  # seqs per gossiped chunk (~8 KiB / row bytes)
+    fanout: int = 3
+    k_in: int = 6  # bounded chunk intake per (node, stream) per round
+    loss_prob: float = 0.0
+    sync_interval: int = 5
+    gap_requests: int = 4  # partial-need ranges requested per session
+    sync_seq_budget: int = 4096  # seqs granted per session
+
+    @property
+    def rows(self) -> int:
+        return self.n_nodes * self.n_streams
+
+
+class ChunkState(NamedTuple):
+    have: IntervalSet  # starts/ends i32[N*S, C] seq coverage per (node, stream)
+
+
+def init_chunks(cfg: ChunkConfig, origin: jax.Array, last_seq: jax.Array) -> ChunkState:
+    """Origin node of each stream starts with full coverage [0, last_seq]."""
+    iv = IntervalSet(
+        starts=jnp.full((cfg.rows, cfg.cap), intervals.EMPTY, jnp.int32),
+        ends=jnp.full((cfg.rows, cfg.cap), intervals.EMPTY - 1, jnp.int32),
+    )
+    rows = origin * cfg.n_streams + jnp.arange(cfg.n_streams)
+    starts = iv.starts.at[rows, 0].set(0)
+    ends = iv.ends.at[rows, 0].set(last_seq.astype(jnp.int32))
+    return ChunkState(have=IntervalSet(starts=starts, ends=ends))
+
+
+def _select(mask, new, old):
+    """Per-row select over vmapped IntervalSets."""
+    return jax.tree.map(
+        lambda a, b: jnp.where(mask[:, None], a, b), new, old
+    )
+
+
+_v_insert = jax.vmap(intervals.insert)
+_v_gaps = jax.vmap(intervals.gaps)
+_v_watermark = jax.vmap(intervals.contiguous_watermark)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def chunk_round(
+    state: ChunkState,
+    last_seq: jax.Array,  # i32[S]
+    alive: jax.Array,  # bool[N]
+    round_idx: jax.Array,
+    rng: jax.Array,
+    cfg: ChunkConfig,
+) -> tuple[ChunkState, dict]:
+    n, s_count, f = cfg.n_nodes, cfg.n_streams, cfg.fanout
+    rows = cfg.rows
+    have = state.have
+    k_tgt, k_slot, k_pos, k_loss, k_peer = jax.random.split(rng, 5)
+
+    row_node = jnp.arange(rows) // s_count
+    row_stream = jnp.arange(rows) % s_count
+    row_last = last_seq[row_stream]
+    live = intervals.slot_mask(have)  # bool[rows, C]
+    has_any = jnp.any(live, axis=1)  # bool[rows]
+
+    # ---- 1. epidemic chunk send: random covered sub-range to f targets ----
+    tgt = jax.random.randint(k_tgt, (rows, f), 0, n)  # receiver node
+    u = jax.random.uniform(k_slot, (rows, f, cfg.cap))
+    scores = jnp.where(live[:, None, :], u, -1.0)
+    slot = jnp.argmax(scores, axis=-1)  # [rows, f]
+    ss = jnp.take_along_axis(have.starts, slot, axis=1)
+    se = jnp.take_along_axis(have.ends, slot, axis=1)
+    span = jnp.maximum(se - ss + 1, 1)
+    pos = ss + jax.random.randint(k_pos, (rows, f), 0, 1 << 30) % span
+    ce = jnp.minimum(pos + cfg.chunk_len - 1, se)
+    lost = jax.random.uniform(k_loss, (rows, f)) < cfg.loss_prob
+    ok = (
+        has_any[:, None]
+        & alive[row_node][:, None]
+        & alive[tgt]
+        & (tgt != row_node[:, None])
+        & ~lost
+    )
+
+    m_row = (tgt * s_count + row_stream[:, None]).reshape(-1)
+    in_mask, (in_s, in_e) = routing.bounded_intake(
+        m_row, ok.reshape(-1), (pos.reshape(-1), ce.reshape(-1)), rows, cfg.k_in
+    )
+    for j in range(cfg.k_in):
+        inserted = _v_insert(have, in_s[:, j], in_e[:, j])
+        have = _select(in_mask[:, j], inserted, have)
+
+    # ---- 2. partial-need sync (SyncNeedV1::Partial analogue) --------------
+    phase = (row_node * jnp.int32(40503)) % jnp.int32(cfg.sync_interval)
+    due = (
+        alive[row_node]
+        & ((round_idx + phase) % jnp.int32(cfg.sync_interval) == 0)
+    )
+    peer = jax.random.randint(k_peer, (n,), 0, n)
+    peer_ok = alive[peer] & (peer != jnp.arange(n))
+    p_row = peer[row_node] * s_count + row_stream
+    gaps = _v_gaps(have, jnp.zeros((rows,), jnp.int32), row_last)
+    ps, pe = have.starts[p_row], have.ends[p_row]
+    p_live = ps <= pe
+    budget_left = jnp.full((rows,), cfg.sync_seq_budget, jnp.int32)
+    granted = jnp.zeros((rows,), jnp.int32)
+    for g in range(cfg.gap_requests):
+        gs, ge = gaps.starts[:, g], gaps.ends[:, g]
+        valid_gap = gs <= ge
+        overlap = p_live & (ps <= ge[:, None]) & (pe >= gs[:, None])
+        any_ov = jnp.any(overlap, axis=1)
+        idx = jnp.argmax(overlap, axis=1)
+        g_s = jnp.maximum(gs, jnp.take_along_axis(ps, idx[:, None], axis=1)[:, 0])
+        g_e = jnp.minimum(ge, jnp.take_along_axis(pe, idx[:, None], axis=1)[:, 0])
+        g_e = jnp.minimum(g_e, g_s + budget_left - 1)
+        ok_g = due & peer_ok[row_node] & valid_gap & any_ov & (budget_left > 0)
+        inserted = _v_insert(have, g_s, g_e)
+        have = _select(ok_g, inserted, have)
+        got = jnp.where(ok_g, g_e - g_s + 1, 0)
+        budget_left -= got
+        granted += got
+
+    new_state = ChunkState(have=have)
+    stats = {
+        "chunks_sent": jnp.sum(ok, dtype=jnp.uint32),
+        "seqs_granted": jnp.sum(granted, dtype=jnp.uint32),
+        "applied_nodes": jnp.sum(
+            applied_mask(new_state, last_seq, cfg), dtype=jnp.uint32
+        ),
+    }
+    return new_state, stats
+
+
+def applied_mask(state: ChunkState, last_seq: jax.Array, cfg: ChunkConfig) -> jax.Array:
+    """bool[N, S]: stream fully reassembled (gap-free to last_seq) per node."""
+    rows = cfg.rows
+    row_last = last_seq[jnp.arange(rows) % cfg.n_streams]
+    wm = _v_watermark(state.have, jnp.zeros((rows,), jnp.int32))
+    return (wm >= row_last).reshape(cfg.n_nodes, cfg.n_streams)

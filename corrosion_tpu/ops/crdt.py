@@ -58,6 +58,46 @@ def make_cells(n_cells: int) -> CellState:
     return CellState(cl=z, col_version=z, value_rank=z)
 
 
+def _mix(h: jax.Array) -> jax.Array:
+    """murmur3-style avalanche over uint32 (deterministic value hashing)."""
+    h = h.astype(jnp.uint32)
+    h ^= h >> 16
+    h *= jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    h *= jnp.uint32(0xC2B2AE35)
+    h ^= h >> 16
+    return h
+
+
+def derive_change(
+    writer: jax.Array,
+    version: jax.Array,
+    slot: jax.Array,
+    n_cells: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Deterministic change content for (writer, version, cell-slot).
+
+    In the reference a changeset's rows are a pure function of
+    (site_id, version) — the writer's committed transaction (read back from
+    `crsql_changes` at broadcast time, public/mod.rs:128-142). The sim keeps
+    that property: a version id IS its payload, so any replica applying
+    (w, v) derives identical (key, cl, col_version, value_rank) rows and the
+    scatter-merge is replay-order independent.
+
+    ~1/16 of writes are row deletes (even causal length) so causal-length
+    precedence is exercised alongside LWW.
+    """
+    w = writer.astype(jnp.uint32)
+    v = version.astype(jnp.uint32)
+    j = slot.astype(jnp.uint32)
+    h = _mix(w * jnp.uint32(2654435761) + v * jnp.uint32(40503) + j * jnp.uint32(2246822519))
+    key = (h % jnp.uint32(n_cells)).astype(jnp.int32)
+    cl = jnp.where(h % 16 == 0, jnp.uint32(2), jnp.uint32(1))
+    col_version = v
+    value_rank = _mix(h + jnp.uint32(0x9E3779B9))
+    return key, cl, col_version, value_rank
+
+
 def _lex_gt(a_cl, a_cv, a_vr, b_cl, b_cv, b_vr):
     """(a_cl, a_cv, a_vr) > (b_cl, b_cv, b_vr) lexicographically."""
     return (

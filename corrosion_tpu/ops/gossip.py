@@ -36,7 +36,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from corrosion_tpu.ops import routing
+from corrosion_tpu.ops import crdt, routing
 
 
 @dataclass(frozen=True)
@@ -54,6 +54,11 @@ class GossipConfig:
     sync_chunk: int = 64  # versions per writer per peer (chunk cap)
     sync_peers: int = 3  # peers pulled from per session (ref: 3-10, agent.rs:84)
     sync_candidates: int = 8  # candidate peers scored by need per session
+    # CRDT cell plane: per-node LWW/causal-length registers that every
+    # applied version scatter-merges into (0 = plane disabled). The global
+    # cell key space has n_cells keys; each write touches cells_per_write.
+    n_cells: int = 0
+    cells_per_write: int = 1
 
     def __post_init__(self):
         if self.sync_peers > self.sync_candidates:
@@ -116,6 +121,7 @@ class DataState(NamedTuple):
     q_writer: jax.Array  # i32[N, Q] (-1 = empty)
     q_ver: jax.Array  # u32[N, Q]
     q_tx: jax.Array  # i32[N, Q] transmissions left
+    cells: crdt.CellState  # u32[N * K] x3 per-node registers (K=0: disabled)
 
 
 def init_data(cfg: GossipConfig) -> DataState:
@@ -127,7 +133,37 @@ def init_data(cfg: GossipConfig) -> DataState:
         q_writer=jnp.full((n, q), -1, jnp.int32),
         q_ver=jnp.zeros((n, q), jnp.uint32),
         q_tx=jnp.zeros((n, q), jnp.int32),
+        cells=crdt.make_cells(n * cfg.n_cells),
     )
+
+
+def _merge_versions(
+    cells: crdt.CellState,
+    node: jax.Array,  # i32[M] receiving node per applied version
+    writer: jax.Array,  # [M] writer id per applied version
+    version: jax.Array,  # u32[M]
+    mask: jax.Array,  # bool[M]
+    cfg: GossipConfig,
+) -> tuple[crdt.CellState, jax.Array]:
+    """Scatter-merge the derived cell changes of applied versions.
+
+    The sim analogue of replaying `INSERT INTO crsql_changes` rows for each
+    applied changeset (reference agent.rs:2192-2214): every (node, writer,
+    version) triple expands to cells_per_write derived rows merged into the
+    node's register shard. Idempotent, so stale re-deliveries are harmless.
+    """
+    k = cfg.n_cells
+    n_merges = jnp.sum(mask, dtype=jnp.uint32) * cfg.cells_per_write
+    for j in range(cfg.cells_per_write):
+        key, cl, cv, vr = crdt.derive_change(
+            writer, version, jnp.uint32(j), k
+        )
+        flat = jnp.where(mask, node * k + key, 0)
+        batch = crdt.ChangeBatch(
+            key=flat, cl=cl, col_version=cv, value_rank=vr, mask=mask
+        )
+        cells = crdt.apply_changes(cells, batch)
+    return cells, n_merges
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -171,6 +207,21 @@ def broadcast_round(
     new_ver = head_old_n[:, None] + 1 + jnp.arange(mw, dtype=jnp.uint32)[None, :]
     new_valid = (jnp.arange(mw)[None, :] < nw[:, None]) & alive[:, None]
     new_writer = jnp.broadcast_to(topo.writer_of_node[:, None], (n, mw))
+
+    cells = data.cells
+    n_merges = jnp.uint32(0)
+    if cfg.n_cells > 0:
+        # The writer materializes its own commit (the local-write txn path,
+        # public/mod.rs:60-123).
+        cells, m = _merge_versions(
+            cells,
+            jnp.repeat(nodes, mw),
+            jnp.maximum(new_writer, 0).reshape(-1),
+            new_ver.reshape(-1),
+            new_valid.reshape(-1),
+            cfg,
+        )
+        n_merges += m
 
     # ---- 2. fanout target selection ---------------------------------------
     f = cfg.fanout
@@ -244,6 +295,18 @@ def broadcast_round(
             .reshape(n, w_count)
         )
 
+        if cfg.n_cells > 0:
+            # Receivers materialize every message on the applied run.
+            cells, m = _merge_versions(
+                cells,
+                rw2 // w_count,
+                (rw2 % w_count).astype(jnp.uint32),
+                v2,
+                run & valid2,
+                cfg,
+            )
+            n_merges += m
+
         # ---- 4. rebroadcast intake (epidemic requeue) ----------------------
         k_in = cfg.fanout * 2  # bounded intake per receiver per round
         in_mask, (in_w, in_v, in_tx) = routing.bounded_intake(
@@ -304,6 +367,7 @@ def broadcast_round(
             (contig - contig_before).astype(jnp.uint32), dtype=jnp.uint32
         ),
         "msgs": n_msgs,
+        "cell_merges": n_merges,
     }
     return (
         DataState(
@@ -313,6 +377,7 @@ def broadcast_round(
             q_writer=q_writer,
             q_ver=q_ver,
             q_tx=q_tx,
+            cells=cells,
         ),
         stats,
     )
@@ -363,6 +428,7 @@ def sync_round(
     # computed one candidate column at a time to keep the transient at
     # [N, W] instead of [N, C, W].
     c_count = cfg.sync_candidates
+    seen = data.seen
     need_cols = []
     for c in range(c_count):
         cc = data.contig[cand[:, c]]  # [N, W]
@@ -372,6 +438,12 @@ def sync_round(
                 axis=-1,
                 dtype=jnp.int32,
             )
+        )
+        # Scoring reads the candidate's state — that digest also carries its
+        # heads, so adopt them (the reference learns heads from every
+        # SyncState exchange, not only from peers it pulls from).
+        seen = jnp.maximum(
+            seen, jnp.where(ok_c[:, c, None], data.seen[cand[:, c]], 0)
         )
     defc = jnp.stack(need_cols, axis=1)  # i32[N, C]
 
@@ -392,7 +464,6 @@ def sync_round(
 
     # Pull from selected peers in need order under one shared budget.
     contig = data.contig
-    seen = data.seen
     budget_left = jnp.full((n,), cfg.sync_budget, jnp.int32)
     for s in range(cfg.sync_peers):
         p = sel[:, s]
@@ -407,15 +478,101 @@ def sync_round(
         ).astype(jnp.uint32)
         contig = contig + grant
         budget_left = budget_left - jnp.sum(grant, axis=1, dtype=jnp.int32)
-        seen = jnp.maximum(seen, jnp.where(ok_s[:, None], data.seen[p], 0))
     seen = jnp.maximum(seen, contig)
+
+    cells = data.cells
+    n_merges = jnp.uint32(0)
+    if cfg.n_cells > 0:
+        # Materialize every granted version: enumerate the per-(node, writer)
+        # grant ranges into flat (node, writer, version) triples — the
+        # changeset replay the server streams in the reference
+        # (peer.rs:610-666) — and scatter-merge their derived cells.
+        gr = (contig - data.contig).astype(jnp.int32)  # [N, W]
+        cum = jnp.cumsum(gr, axis=1)  # [N, W]
+        total = cum[:, -1]  # [N] <= sync_budget
+        e = jnp.arange(cfg.sync_budget, dtype=jnp.int32)  # [B]
+        w_idx = jax.vmap(
+            lambda c: jnp.searchsorted(c, e, side="right")
+        )(cum)  # [N, B] writer owning granted unit e
+        w_idx = jnp.minimum(w_idx, cfg.n_writers - 1)
+        prev = jnp.where(
+            w_idx > 0,
+            jnp.take_along_axis(cum, jnp.maximum(w_idx - 1, 0), axis=1),
+            0,
+        )
+        ver = (
+            jnp.take_along_axis(data.contig, w_idx, axis=1)
+            + 1
+            + (e[None, :] - prev).astype(jnp.uint32)
+        )
+        mask = e[None, :] < total[:, None]  # [N, B]
+        cells, n_merges = _merge_versions(
+            cells,
+            jnp.repeat(nodes, cfg.sync_budget),
+            w_idx.reshape(-1).astype(jnp.uint32),
+            ver.reshape(-1),
+            mask.reshape(-1),
+            cfg,
+        )
+
     stats = {
         "applied_sync": jnp.sum(contig - data.contig, dtype=jnp.uint32),
         # Due nodes with at least one reachable candidate (whether or not
         # any need was found) — matches the pre-multi-peer meaning.
         "sessions": jnp.sum(jnp.any(ok_c, axis=1)),
+        "cell_merges": n_merges,
     }
-    return data._replace(contig=contig, seen=seen), stats
+    return data._replace(contig=contig, seen=seen, cells=cells), stats
+
+
+def node_cells(data: DataState, cfg: GossipConfig) -> crdt.CellState:
+    """View the flat cell plane as per-node [N, K] register arrays."""
+    n, k = cfg.n_nodes, cfg.n_cells
+    return crdt.CellState(
+        cl=data.cells.cl.reshape(n, k),
+        col_version=data.cells.col_version.reshape(n, k),
+        value_rank=data.cells.value_rank.reshape(n, k),
+    )
+
+
+def cells_agree(data: DataState, cfg: GossipConfig) -> jax.Array:
+    """True iff every node's merged cell state is identical (CRDT
+    convergence over actual register contents, not watermarks)."""
+    pc = node_cells(data, cfg)
+    return (
+        jnp.all(pc.cl == pc.cl[:1])
+        & jnp.all(pc.col_version == pc.col_version[:1])
+        & jnp.all(pc.value_rank == pc.value_rank[:1])
+    )
+
+
+def serial_merge_reference(
+    head, cfg: GossipConfig
+) -> crdt.CellState:
+    """Ground truth: merge every committed version (w, v<=head[w]) into one
+    fresh cell state — the order-independent serial merge that all replicas
+    must converge to. Host-side (numpy loop), for tests/bench validation."""
+    import numpy as np
+
+    head = np.asarray(head)
+    state = crdt.make_cells(cfg.n_cells)
+    ws, vs = [], []
+    for w, h in enumerate(head):
+        for v in range(1, int(h) + 1):
+            ws.append(w)
+            vs.append(v)
+    if not ws:
+        return state
+    ws = jnp.asarray(np.array(ws, np.uint32))
+    vs = jnp.asarray(np.array(vs, np.uint32))
+    mask = jnp.ones(ws.shape, bool)
+    for j in range(cfg.cells_per_write):
+        key, cl, cv, vr = crdt.derive_change(ws, vs, jnp.uint32(j), cfg.n_cells)
+        state = crdt.apply_changes(
+            state,
+            crdt.ChangeBatch(key=key, cl=cl, col_version=cv, value_rank=vr, mask=mask),
+        )
+    return state
 
 
 def total_need(data: DataState) -> jax.Array:

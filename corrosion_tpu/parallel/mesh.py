@@ -75,6 +75,9 @@ def shard_cluster_state(
         q_writer=_put(d.q_writer, mesh, row),
         q_ver=_put(d.q_ver, mesh, row),
         q_tx=_put(d.q_tx, mesh, row),
+        # Cell plane is node-major flat [N * K]: sharding the single axis
+        # splits it on node boundaries (K divides each shard when N does).
+        cells=jax.tree.map(lambda a: _put(a, mesh, vec), d.cells),
     )
     return ClusterState(
         swim=sw,

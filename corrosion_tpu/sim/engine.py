@@ -153,6 +153,7 @@ def cluster_round(
         "applied_sync": sstats["applied_sync"],
         "msgs": bstats["msgs"],
         "sessions": sstats["sessions"],
+        "cell_merges": bstats["cell_merges"] + sstats["cell_merges"],
     }
     return (
         ClusterState(

@@ -1,0 +1,142 @@
+"""BookedVersions + compute_available_needs against the reference's vectors.
+
+test_compute_available_needs_reference_vectors is a direct translation of the
+reference's own unit test (corro-types/src/sync.rs:376-491), stage by stage.
+insert_many cases mirror agent.rs:1009-1047 and the in-tree compaction test
+(agent.rs:3224 test_in_memory_versions_compaction's bookkeeping steps).
+"""
+
+import pytest
+
+from corrosion_tpu.core.bookkeeping import (
+    CLEARED,
+    Bookie,
+    BookedVersions,
+    Current,
+    FullNeed,
+    Partial,
+    PartialNeed,
+    SyncState,
+    generate_sync,
+)
+from corrosion_tpu.core.intervals import RangeSet
+
+
+A1 = "actor-1"
+
+
+def test_compute_available_needs_reference_vectors():
+    our = SyncState(actor_id="us")
+    our.heads[A1] = 10
+    other = SyncState(actor_id="them")
+    other.heads[A1] = 13
+
+    # Stage 1: head gap only (sync.rs:385-400).
+    assert our.compute_available_needs(other) == {A1: [FullNeed(11, 13)]}
+
+    # Stage 2: full needs [2,5] and [7,7] (sync.rs:402-426).
+    our.need.setdefault(A1, []).append((2, 5))
+    our.need.setdefault(A1, []).append((7, 7))
+    assert our.compute_available_needs(other) == {
+        A1: [FullNeed(2, 5), FullNeed(7, 7), FullNeed(11, 13)]
+    }
+
+    # Stage 3: our partial v9 seqs [100,120],[130,132] (sync.rs:428-458).
+    our.partial_need[A1] = {9: [(100, 120), (130, 132)]}
+    assert our.compute_available_needs(other) == {
+        A1: [
+            FullNeed(2, 5),
+            FullNeed(7, 7),
+            PartialNeed(9, [(100, 120), (130, 132)]),
+            FullNeed(11, 13),
+        ]
+    }
+
+    # Stage 4: they're partial on v9 too — their partial_need lists THEIR
+    # gaps [100,110],[130,130] — so we request only the overlap of our gaps
+    # with what they actually hold (sync.rs:460-489).
+    other.partial_need[A1] = {9: [(100, 110), (130, 130)]}
+    assert our.compute_available_needs(other) == {
+        A1: [
+            FullNeed(2, 5),
+            FullNeed(7, 7),
+            PartialNeed(9, [(111, 120), (131, 132)]),
+            FullNeed(11, 13),
+        ]
+    }
+
+
+def test_zero_head_and_self_are_skipped():
+    our = SyncState(actor_id="us")
+    other = SyncState(actor_id="them")
+    other.heads["us"] = 5  # our own id: skipped (sync.rs:129)
+    other.heads[A1] = 0  # zero head: skipped (sync.rs:132-135)
+    assert our.compute_available_needs(other) == {}
+
+
+def test_insert_many_tracks_gaps_as_sync_need():
+    bv = BookedVersions()
+    bv.insert_many(1, 1, Current(db_version=1, last_seq=10, ts=0))
+    assert list(bv.sync_need()) == []
+    assert bv.last() == 1
+    # Jump to version 5: versions 2..=5's start are needed (agent.rs:1038-43:
+    # (old_last+1)..=start inserted, then the inserted range removed).
+    bv.insert_many(5, 5, Current(db_version=2, last_seq=3, ts=0))
+    assert list(bv.sync_need()) == [(2, 4)]
+    assert bv.last() == 5
+    bv.insert_many(3, 3, CLEARED)
+    assert list(bv.sync_need()) == [(2, 2), (4, 4)]
+    bv.insert_many(2, 2, Current(db_version=3, last_seq=0, ts=0))
+    bv.insert_many(4, 4, Current(db_version=4, last_seq=0, ts=0))
+    assert list(bv.sync_need()) == []
+
+
+def test_insert_cleared_range_purges_current_and_partials():
+    bv = BookedVersions()
+    bv.insert(1, Current(db_version=1, last_seq=0, ts=0))
+    bv.insert(2, Partial(seqs=RangeSet([(0, 3)]), last_seq=9, ts=0))
+    bv.insert(3, Current(db_version=2, last_seq=0, ts=0))
+    bv.insert_many(1, 3, CLEARED)
+    assert bv.current == {}
+    assert bv.partials == {}
+    assert list(bv.cleared) == [(1, 3)]
+    assert bv.contains_all((1, 3))
+
+
+def test_partial_promotion_to_current():
+    bv = BookedVersions()
+    p = Partial(seqs=RangeSet([(0, 5)]), last_seq=9, ts=0)
+    bv.insert(4, p)
+    assert not p.is_complete()
+    assert p.gaps() == [(6, 9)]
+    assert bv.contains(4, (0, 5))
+    assert not bv.contains(4, (0, 9))
+    p.seqs.insert(6, 9)
+    assert p.is_complete()
+    bv.insert(4, Current(db_version=7, last_seq=9, ts=0))
+    assert 4 not in bv.partials
+    assert bv.contains(4, (0, 9))
+
+
+def test_generate_sync_shape():
+    bookie = Bookie()
+    bv = bookie.for_actor(A1)
+    bv.insert(1, Current(db_version=1, last_seq=0, ts=0))
+    bv.insert(5, Current(db_version=2, last_seq=0, ts=0))
+    bv.insert(7, Partial(seqs=RangeSet([(0, 2)]), last_seq=9, ts=0))
+    state = generate_sync(bookie, "me")
+    assert state.heads[A1] == 7
+    assert state.need[A1] == [(2, 4), (6, 6)]
+    assert state.partial_need[A1] == {7: [(3, 9)]}
+    assert state.need_len_for_actor(A1) == 5
+    # Round-trip: a fresh node computes needs against us.
+    empty = SyncState(actor_id="newbie")
+    needs = empty.compute_available_needs(state)
+    assert needs == {A1: [FullNeed(1, 7)]}
+
+
+def test_need_len_counts_partials_as_chunks():
+    s = SyncState(actor_id="x")
+    s.need["a"] = [(1, 10)]
+    s.partial_need["a"] = {3: [(0, 99)]}
+    assert s.need_len() == 10 + 100 // 50

@@ -69,5 +69,5 @@ def test_metrics_curves_shape():
     cfg, topo, sched = models.three_node(n_inserts=48, samples=16)
     final, curves = simulate(cfg, topo, sched)
     for k in ("mismatches", "need", "applied_broadcast", "applied_sync",
-              "msgs", "sessions"):
+              "msgs", "sessions", "cell_merges"):
         assert curves[k].shape == (sched.rounds,), k
