@@ -1,0 +1,560 @@
+"""Agent: setup/run, change-ingest pipeline, background loops.
+
+The host counterpart of corro-agent/src/agent.rs (setup :105-336, run
+:354-970): owns the Store, the Bookie, the HLC, the transport, SWIM
+membership, the broadcast pending queue, and the sync loop; exposes the
+write path used by the HTTP API (make_broadcastable_changes,
+api/public/mod.rs:33-191) and the ingest path for remote changesets
+(process_multiple_changes, agent.rs:1809-2060) including partial-version
+buffering (process_incomplete_version :2063-2151,
+process_fully_buffered_changes :1667-1806).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import time
+from dataclasses import dataclass, field
+
+from corrosion_tpu.agent.membership import Members, Swim
+from corrosion_tpu.agent.store import Store
+from corrosion_tpu.agent.transport import Session, Transport
+from corrosion_tpu.core.bookkeeping import (
+    Bookie,
+    CLEARED,
+    Current,
+    FullNeed,
+    Partial,
+    PartialNeed,
+    generate_sync,
+)
+from corrosion_tpu.core.changes import chunk_changes
+from corrosion_tpu.core.hlc import HLC
+from corrosion_tpu.core.intervals import RangeSet
+from corrosion_tpu.core.values import Change, ExecResponse, ExecResult, Statement
+from corrosion_tpu.utils.spawn import TaskRegistry
+from corrosion_tpu.utils.tripwire import Tripwire
+
+
+@dataclass
+class AgentConfig:
+    data_dir: str
+    gossip_host: str = "127.0.0.1"
+    gossip_port: int = 0
+    api_host: str = "127.0.0.1"
+    api_port: int = 0
+    bootstrap: list[tuple[str, int]] = field(default_factory=list)
+    schema_sql: str = ""
+    probe_interval: float = 0.25
+    broadcast_interval: float = 0.05  # flush tick (500 ms in the reference)
+    sync_interval: float = 0.5  # backoff floor 1 s in the reference
+    fanout: int = 3  # num_indirect_probes analogue
+    max_transmissions: int = 4
+    sync_peers: int = 3  # 3-10 by need desc / ring asc (agent.rs:2383-2423)
+    ingest_batch: int = 1000  # handle_changes batching (agent.rs:2450-2518)
+    ingest_linger: float = 0.05
+
+
+@dataclass
+class PendingBroadcast:
+    """An entry in the broadcast pending queue (broadcast/mod.rs:716-738)."""
+
+    frame: dict
+    tx_left: int
+
+
+class Agent:
+    def __init__(self, cfg: AgentConfig) -> None:
+        self.cfg = cfg
+        os.makedirs(cfg.data_dir, exist_ok=True)
+        site_id = os.urandom(16)
+        self.store = Store(os.path.join(cfg.data_dir, "state.db"), site_id)
+        self.actor_id = self.store.site_id.hex()
+        self.bookie = Bookie()
+        self.hlc = HLC()
+        self.transport = Transport()
+        self.members = Members(self.actor_id)
+        self.tasks = TaskRegistry()
+        self.tripwire = Tripwire()
+        self.gossip_addr: tuple[str, int] | None = None
+        self.api_addr: tuple[str, int] | None = None
+        self.swim: Swim | None = None
+        self._pending: list[PendingBroadcast] = []
+        self._ingest: asyncio.Queue = asyncio.Queue(maxsize=4096)
+        self._addr_of: dict[str, tuple[str, int]] = {}
+        self._api_server = None
+        self.subs = None  # SubsManager, attached by api/subs wiring
+        self._rehydrate()
+        if cfg.schema_sql:
+            self.store.apply_schema(cfg.schema_sql)
+
+    # -- setup (agent.rs:105-336) -------------------------------------------
+
+    def _rehydrate(self) -> None:
+        """Rebuild BookedVersions from __corro_bookkeeping +
+        __corro_seq_bookkeeping (agent.rs:147-268)."""
+        for actor, sv, ev, dbv, last_seq, ts in self.store.conn.execute(
+            "SELECT actor_id, start_version, end_version, db_version,"
+            " last_seq, ts FROM __corro_bookkeeping"
+        ):
+            booked = self.bookie.for_actor(bytes(actor).hex())
+            if dbv is None:
+                booked.insert_many(sv, ev if ev is not None else sv, CLEARED)
+            else:
+                booked.insert(
+                    sv, Current(db_version=dbv, last_seq=last_seq, ts=ts or 0)
+                )
+        for actor, ver, ss, es, last_seq, ts in self.store.conn.execute(
+            "SELECT actor_id, version, start_seq, end_seq, last_seq, ts"
+            " FROM __corro_seq_bookkeeping"
+        ):
+            booked = self.bookie.for_actor(bytes(actor).hex())
+            known = booked.get(ver)
+            if isinstance(known, Partial):
+                known.seqs.insert(ss, es)
+            else:
+                booked.insert(
+                    ver,
+                    Partial(seqs=RangeSet([(ss, es)]), last_seq=last_seq, ts=ts),
+                )
+
+    async def start(self) -> None:
+        self.gossip_addr = await self.transport.serve(
+            self.cfg.gossip_host, self.cfg.gossip_port, self._on_gossip
+        )
+        self.swim = Swim(
+            self.members,
+            self.gossip_addr,
+            self.transport.send_frame,
+            probe_interval=self.cfg.probe_interval,
+            max_transmissions=self.cfg.max_transmissions,
+        )
+        from corrosion_tpu.agent.api import serve_api
+
+        self.api_addr = await serve_api(self)
+        self.tasks.spawn(self._swim_loop(), name="swim_loop")
+        self.tasks.spawn(self._broadcast_loop(), name="broadcast_loop")
+        self.tasks.spawn(self._ingest_loop(), name="handle_changes")
+        self.tasks.spawn(self._sync_loop(), name="sync_loop")
+        for addr in self.cfg.bootstrap:
+            await self.swim.announce(tuple(addr))
+
+    async def stop(self) -> None:
+        self.tripwire.trip()
+        await self.tasks.cancel_all()
+        await self.tasks.wait_for_all_pending_handles(cap=5.0)
+        self.transport.close()
+        if self._api_server is not None:
+            self._api_server.close()
+        self.store.close()
+
+    # -- write path (make_broadcastable_changes) ------------------------------
+
+    def execute(self, statements: list[Statement]) -> ExecResponse:
+        t0 = time.monotonic()
+        results, dbv, last_seq, changes = self.store.execute_transaction(
+            statements
+        )
+        if dbv and changes:
+            ts = self.hlc.new_timestamp()
+            booked = self.bookie.for_actor(self.actor_id)
+            version = (booked.last() or 0) + 1
+            booked.insert(
+                version, Current(db_version=dbv, last_seq=last_seq, ts=ts)
+            )
+            self._persist_bookkeeping(
+                self.actor_id, version, dbv, last_seq, ts
+            )
+            if self.subs is not None:
+                self.subs.match_changes(changes)
+            # Chunk and queue for dissemination (public/mod.rs:128-187).
+            for chunk, (s, e) in chunk_changes(changes, last_seq):
+                self._queue_broadcast(
+                    self._changeset_frame(
+                        self.actor_id, version, chunk, (s, e), last_seq, ts
+                    )
+                )
+        return ExecResponse(
+            results=results, time=time.monotonic() - t0
+        )
+
+    def _persist_bookkeeping(self, actor, version, dbv, last_seq, ts) -> None:
+        self.store.conn.execute(
+            "INSERT OR REPLACE INTO __corro_bookkeeping"
+            " (actor_id, start_version, end_version, db_version, last_seq, ts)"
+            " VALUES (?, ?, NULL, ?, ?, ?)",
+            (bytes.fromhex(actor), version, dbv, last_seq, ts),
+        )
+
+    def _changeset_frame(self, actor, version, changes, seqs, last_seq, ts):
+        return {
+            "t": "bcast",
+            "actor": actor,
+            "version": version,
+            "changes": [list(c.to_tuple()) for c in changes],
+            "seqs": list(seqs),
+            "last_seq": last_seq,
+            "ts": ts,
+        }
+
+    def _queue_broadcast(self, frame: dict) -> None:
+        self._pending.append(
+            PendingBroadcast(frame=frame, tx_left=self.cfg.max_transmissions)
+        )
+
+    # -- gossip inbound -------------------------------------------------------
+
+    async def _on_gossip(self, session: Session, msg: dict) -> None:
+        kind = msg.get("t")
+        if kind == "swim":
+            frm = msg.get("from")
+            if frm and "from_addr" in msg:
+                self._addr_of[frm] = tuple(msg["from_addr"])
+            await self.swim.on_message(msg)
+        elif kind == "bcast":
+            try:
+                self._ingest.put_nowait((msg, "broadcast"))
+            except asyncio.QueueFull:
+                pass  # broadcast is lossy; sync heals
+        elif kind == "sync_start":
+            await self._serve_sync(session, msg)
+
+    # -- broadcast loop (broadcast/mod.rs:356-567) ----------------------------
+
+    async def _broadcast_loop(self) -> None:
+        while not self.tripwire.tripped:
+            await asyncio.sleep(self.cfg.broadcast_interval)
+            if not self._pending:
+                continue
+            pending, self._pending = self._pending, []
+            members = self.members.alive()
+            if not members:
+                # No peers yet: requeue, budgets intact (sendable gating).
+                self._pending = pending
+                continue
+            ring0 = self.members.ring0()
+            for pb in pending:
+                # Ring-0 eager + random far targets (mod.rs:465-473,522-537).
+                targets = {m.actor_id: m for m in ring0}
+                others = [m for m in members if m.actor_id not in targets]
+                random.shuffle(others)
+                for m in others[: self.cfg.fanout]:
+                    targets[m.actor_id] = m
+                for m in targets.values():
+                    await self.transport.send_frame(
+                        m.addr, pb.frame
+                    )
+                pb.tx_left -= 1
+                if pb.tx_left > 0:
+                    self._pending.append(pb)
+
+    # -- ingest pipeline (handle_changes + process_multiple_changes) ----------
+
+    async def _ingest_loop(self) -> None:
+        while not self.tripwire.tripped:
+            batch: list[tuple[dict, str]] = []
+            try:
+                item = await asyncio.wait_for(
+                    self._ingest.get(), timeout=0.25
+                )
+                batch.append(item)
+            except asyncio.TimeoutError:
+                continue
+            t0 = time.monotonic()
+            while (
+                len(batch) < self.cfg.ingest_batch
+                and time.monotonic() - t0 < self.cfg.ingest_linger
+            ):
+                try:
+                    batch.append(self._ingest.get_nowait())
+                except asyncio.QueueEmpty:
+                    await asyncio.sleep(0.005)
+            self._process_changes(batch)
+
+    def _process_changes(self, batch: list[tuple[dict, str]]) -> None:
+        for msg, source in batch:
+            actor = msg["actor"]
+            if actor == self.actor_id:
+                continue
+            version = msg["version"]
+            seqs = tuple(msg["seqs"])
+            last_seq = msg["last_seq"]
+            booked = self.bookie.for_actor(actor)
+            if booked.contains(version, seqs):
+                continue  # already known (agent.rs:1817-1843 dedupe)
+            self.hlc.update_with_timestamp(msg["ts"])
+            changes = [Change.from_tuple(tuple(t)) for t in msg["changes"]]
+            complete = seqs[0] == 0 and seqs[1] >= last_seq
+            known = booked.get(version)
+            if complete and not isinstance(known, Partial):
+                self._apply_complete(actor, version, changes, last_seq, msg["ts"])
+            else:
+                self._buffer_partial(
+                    actor, version, changes, seqs, last_seq, msg["ts"]
+                )
+            if source == "broadcast":
+                # Rebroadcast applied changesets (agent.rs:2040-2057).
+                pb = dict(msg)
+                self._queue_broadcast(pb)
+
+    def _apply_complete(self, actor, version, changes, last_seq, ts) -> None:
+        self.store.apply_changes(changes)
+        booked = self.bookie.for_actor(actor)
+        dbv = changes[0].db_version if changes else 0
+        booked.insert(
+            version, Current(db_version=dbv, last_seq=last_seq, ts=ts)
+        )
+        self._persist_bookkeeping(actor, version, dbv, last_seq, ts)
+        if self.subs is not None:
+            self.subs.match_changes(changes)
+
+    def _buffer_partial(self, actor, version, changes, seqs, last_seq, ts) -> None:
+        """process_incomplete_version: stash rows + seq ranges; apply once
+        gap-free (agent.rs:2063-2151, 1667-1806)."""
+        booked = self.bookie.for_actor(actor)
+        known = booked.get(version)
+        if isinstance(known, Partial):
+            known.seqs.insert(seqs[0], seqs[1])
+            partial = known
+        else:
+            partial = Partial(
+                seqs=RangeSet([tuple(seqs)]), last_seq=last_seq, ts=ts
+            )
+            booked.insert(version, partial)
+        c = self.store.conn
+        for ch in changes:
+            c.execute(
+                "INSERT OR IGNORE INTO __corro_buffered_changes VALUES"
+                " (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                (
+                    bytes.fromhex(actor), version, ch.table, ch.pk, ch.cid,
+                    ch.val, ch.col_version, ch.db_version, ch.seq,
+                    ch.site_id, ch.cl,
+                ),
+            )
+        c.execute(
+            "INSERT OR REPLACE INTO __corro_seq_bookkeeping VALUES"
+            " (?, ?, ?, ?, ?, ?)",
+            (bytes.fromhex(actor), version, seqs[0], seqs[1], last_seq, ts),
+        )
+        if partial.is_complete():
+            rows = c.execute(
+                "SELECT tbl, pk, cid, val, col_version, db_version, seq,"
+                " site_id, cl FROM __corro_buffered_changes"
+                " WHERE actor_id = ? AND version = ? ORDER BY seq",
+                (bytes.fromhex(actor), version),
+            ).fetchall()
+            all_changes = [Change.from_tuple(tuple(r)) for r in rows]
+            c.execute(
+                "DELETE FROM __corro_buffered_changes"
+                " WHERE actor_id = ? AND version = ?",
+                (bytes.fromhex(actor), version),
+            )
+            c.execute(
+                "DELETE FROM __corro_seq_bookkeeping"
+                " WHERE actor_id = ? AND version = ?",
+                (bytes.fromhex(actor), version),
+            )
+            self._apply_complete(actor, version, all_changes, last_seq, ts)
+
+    # -- SWIM loop -------------------------------------------------------------
+
+    async def _swim_loop(self) -> None:
+        while not self.tripwire.tripped:
+            await asyncio.sleep(self.cfg.probe_interval)
+            try:
+                await self.swim.probe_round()
+            except Exception:
+                pass
+
+    # -- sync (client: handle_sync/parallel_sync; server: serve_sync) ---------
+
+    async def _sync_loop(self) -> None:
+        while not self.tripwire.tripped:
+            await asyncio.sleep(
+                self.cfg.sync_interval * (0.75 + random.random() * 0.5)
+            )
+            try:
+                await self._sync_once()
+            except Exception:
+                pass
+
+    async def _sync_once(self) -> None:
+        peers = self.members.by_ring()  # ring asc (agent.rs:2383-2423)
+        if not peers:
+            return
+        peers = peers[: self.cfg.sync_peers]
+        my_state = generate_sync(self.bookie, self.actor_id)
+        for m in peers:
+            session = await self.transport.open_session(
+                m.addr,
+                {"t": "sync_start", "actor": self.actor_id,
+                 "clock": self.hlc.new_timestamp()},
+            )
+            if session is None:
+                continue
+            try:
+                reply = await session.recv(timeout=5.0)
+                if not reply or reply.get("t") != "sync_state":
+                    continue
+                self.hlc.update_with_timestamp(reply.get("clock", 0))
+                server_state = _state_from_wire(reply["state"])
+                needs = my_state.compute_available_needs(server_state)
+                if not needs:
+                    continue
+                await session.send(
+                    {"t": "sync_request", "needs": _needs_to_wire(needs)}
+                )
+                while True:
+                    frame = await session.recv(timeout=10.0)
+                    if frame is None or frame.get("t") == "sync_done":
+                        break
+                    if frame.get("t") == "sync_changes":
+                        inner = dict(frame)
+                        inner["t"] = "bcast"
+                        try:
+                            self._ingest.put_nowait((inner, "sync"))
+                        except asyncio.QueueFull:
+                            break
+                    elif frame.get("t") == "sync_cleared":
+                        booked = self.bookie.for_actor(frame["actor"])
+                        for s, e in frame["versions"]:
+                            booked.insert_many(s, e, CLEARED)
+            finally:
+                session.close()
+
+    async def _serve_sync(self, session: Session, start: dict) -> None:
+        """Server side (peer.rs:1289-1527)."""
+        self.hlc.update_with_timestamp(start.get("clock", 0))
+        state = generate_sync(self.bookie, self.actor_id)
+        await session.send(
+            {"t": "sync_state", "state": _state_to_wire(state),
+             "clock": self.hlc.new_timestamp()}
+        )
+        req = await session.recv(timeout=5.0)
+        if req and req.get("t") == "sync_request":
+            for actor, needs in _needs_from_wire(req["needs"]).items():
+                booked = self.bookie.get(actor)
+                if booked is None:
+                    continue
+                for need in needs:
+                    await self._serve_need(session, actor, booked, need)
+        await session.send({"t": "sync_done"})
+
+    async def _serve_need(self, session, actor, booked, need) -> None:
+        if isinstance(need, FullNeed):
+            cleared: list[tuple[int, int]] = []
+            for v in range(need.start, need.end + 1):
+                known = booked.get(v)
+                if isinstance(known, Current):
+                    changes = self.store.changes_for(
+                        bytes.fromhex(actor), known.db_version
+                    )
+                    for chunk, (s, e) in chunk_changes(
+                        changes, known.last_seq
+                    ):
+                        await session.send(
+                            self._sync_changes_frame(
+                                actor, v, chunk, (s, e), known.last_seq,
+                                known.ts,
+                            )
+                        )
+                elif known is CLEARED:
+                    cleared.append((v, v))
+            if cleared:
+                await session.send(
+                    {"t": "sync_cleared", "actor": actor, "versions": cleared}
+                )
+        elif isinstance(need, PartialNeed):
+            known = booked.get(need.version)
+            if not isinstance(known, Partial):
+                return
+            rows = self.store.conn.execute(
+                "SELECT tbl, pk, cid, val, col_version, db_version, seq,"
+                " site_id, cl FROM __corro_buffered_changes"
+                " WHERE actor_id = ? AND version = ? ORDER BY seq",
+                (bytes.fromhex(actor), need.version),
+            ).fetchall()
+            by_seq = {r[6]: Change.from_tuple(tuple(r)) for r in rows}
+            for s, e in need.seqs:
+                have = [by_seq[q] for q in range(s, e + 1) if q in by_seq]
+                if not have:
+                    continue
+                lo = min(c.seq for c in have)
+                hi = max(c.seq for c in have)
+                await session.send(
+                    self._sync_changes_frame(
+                        actor, need.version, have, (lo, hi),
+                        known.last_seq, known.ts,
+                    )
+                )
+
+    def _sync_changes_frame(self, actor, version, changes, seqs, last_seq, ts):
+        f = self._changeset_frame(actor, version, changes, seqs, last_seq, ts)
+        f["t"] = "sync_changes"
+        return f
+
+
+# -- sync state wire codec ---------------------------------------------------
+
+
+def _state_to_wire(state) -> dict:
+    return {
+        "actor_id": state.actor_id,
+        "heads": dict(state.heads),
+        "need": {a: [list(r) for r in rs] for a, rs in state.need.items()},
+        "partial_need": {
+            a: {str(v): [list(r) for r in rs] for v, rs in partials.items()}
+            for a, partials in state.partial_need.items()
+        },
+    }
+
+
+def _state_from_wire(w: dict):
+    from corrosion_tpu.core.bookkeeping import SyncState
+
+    return SyncState(
+        actor_id=w["actor_id"],
+        heads=dict(w["heads"]),
+        need={a: [tuple(r) for r in rs] for a, rs in w["need"].items()},
+        partial_need={
+            a: {int(v): [tuple(r) for r in rs] for v, rs in partials.items()}
+            for a, partials in w["partial_need"].items()
+        },
+    )
+
+
+def _needs_to_wire(needs) -> dict:
+    out: dict = {}
+    for actor, lst in needs.items():
+        items = []
+        for n in lst:
+            if isinstance(n, FullNeed):
+                items.append({"full": [n.start, n.end]})
+            else:
+                items.append(
+                    {"partial": {"version": n.version,
+                                 "seqs": [list(s) for s in n.seqs]}}
+                )
+        out[actor] = items
+    return out
+
+
+def _needs_from_wire(w: dict):
+    out: dict = {}
+    for actor, lst in w.items():
+        items = []
+        for n in lst:
+            if "full" in n:
+                items.append(FullNeed(n["full"][0], n["full"][1]))
+            else:
+                items.append(
+                    PartialNeed(
+                        n["partial"]["version"],
+                        [tuple(s) for s in n["partial"]["seqs"]],
+                    )
+                )
+        out[actor] = items
+    return out

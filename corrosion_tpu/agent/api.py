@@ -1,0 +1,225 @@
+"""HTTP public API over asyncio streams.
+
+The reference serves axum routes /v1/transactions, /v1/queries,
+/v1/migrations, /v1/subscriptions (corro-agent/src/agent.rs:833-931,
+api/public/mod.rs). Python's stdlib has no async HTTP server, so this is a
+deliberately small HTTP/1.1 implementation: enough for JSON request bodies,
+JSON responses, and chunked NDJSON streaming for queries and subscriptions
+(the reference streams QueryEvents as newline-delimited JSON,
+api/public/pubsub.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import TYPE_CHECKING
+from urllib.parse import parse_qs, urlparse
+
+from corrosion_tpu.core.values import Statement
+
+if TYPE_CHECKING:
+    from corrosion_tpu.agent.agent import Agent
+
+MAX_BODY = 64 * 1024 * 1024
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+async def _read_request(reader: asyncio.StreamReader):
+    line = await reader.readline()
+    if not line:
+        return None
+    try:
+        method, target, _version = line.decode().split()
+    except ValueError:
+        raise HttpError(400, "bad request line")
+    headers = {}
+    while True:
+        h = await reader.readline()
+        if h in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = h.decode().partition(":")
+        headers[k.strip().lower()] = v.strip()
+    body = b""
+    n = int(headers.get("content-length", 0))
+    if n:
+        if n > MAX_BODY:
+            raise HttpError(413, "body too large")
+        body = await reader.readexactly(n)
+    return method, target, headers, body
+
+
+def _resp(writer, status: int, body: bytes, content_type="application/json"):
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              413: "Payload Too Large", 500: "Internal Server Error",
+              501: "Not Implemented"}.get(status, "?")
+    writer.write(
+        f"HTTP/1.1 {status} {reason}\r\n"
+        f"content-type: {content_type}\r\n"
+        f"content-length: {len(body)}\r\n"
+        "connection: keep-alive\r\n\r\n".encode() + body
+    )
+
+
+def _json_resp(writer, status: int, obj) -> None:
+    _resp(writer, status, json.dumps(obj).encode())
+
+
+async def _start_stream(writer, content_type="application/json"):
+    writer.write(
+        "HTTP/1.1 200 OK\r\n"
+        f"content-type: {content_type}\r\n"
+        "transfer-encoding: chunked\r\n"
+        "connection: close\r\n\r\n".encode()
+    )
+    await writer.drain()
+
+
+async def _stream_chunk(writer, data: bytes) -> None:
+    writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+    await writer.drain()
+
+
+async def _end_stream(writer) -> None:
+    writer.write(b"0\r\n\r\n")
+    await writer.drain()
+
+
+async def serve_api(agent: "Agent") -> tuple[str, int]:
+    async def on_conn(reader, writer):
+        try:
+            while True:
+                req = await _read_request(reader)
+                if req is None:
+                    break
+                method, target, headers, body = req
+                url = urlparse(target)
+                try:
+                    keep = await _route(
+                        agent, writer, method, url.path,
+                        parse_qs(url.query), body,
+                    )
+                except HttpError as e:
+                    _json_resp(writer, e.status, {"error": e.message})
+                    keep = True
+                except Exception as e:  # 500 (load-shed analogue is upstream)
+                    _json_resp(writer, 500, {"error": repr(e)})
+                    keep = True
+                await writer.drain()
+                if not keep:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError, HttpError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    server = await asyncio.start_server(
+        on_conn, agent.cfg.api_host, agent.cfg.api_port
+    )
+    agent._api_server = server
+    sock = server.sockets[0].getsockname()
+    return sock[0], sock[1]
+
+
+async def _route(agent, writer, method, path, query, body) -> bool:
+    """Dispatch; returns False when the connection was turned into a stream
+    (and must close when the stream ends)."""
+    if method == "POST" and path == "/v1/transactions":
+        stmts = [Statement.parse(o) for o in _json_body(body)]
+        resp = agent.execute(stmts)
+        _json_resp(writer, 200, resp.to_json_obj())
+        return True
+    if method == "POST" and path == "/v1/queries":
+        stmt = Statement.parse(_json_body(body))
+        cols, rows = agent.store.query(stmt)
+        await _start_stream(writer)
+        await _stream_chunk(
+            writer, json.dumps({"columns": cols}).encode() + b"\n"
+        )
+        for i, row in enumerate(rows):
+            await _stream_chunk(
+                writer,
+                json.dumps({"row": [i + 1, _jsonable(row)]}).encode() + b"\n",
+            )
+        await _stream_chunk(writer, b'{"eoq":{}}\n')
+        await _end_stream(writer)
+        return False
+    if method == "POST" and path == "/v1/migrations":
+        stmts = _json_body(body)
+        changed = agent.store.apply_schema(
+            "\n".join(stmts if isinstance(stmts, list) else [stmts])
+        )
+        _json_resp(writer, 200, {"changed": changed})
+        return True
+    if method == "POST" and path == "/v1/subscriptions":
+        if agent.subs is None:
+            raise HttpError(501, "subscriptions not enabled")
+        stmt = Statement.parse(_json_body(body))
+        handle = agent.subs.subscribe(stmt.sql)
+        await _stream_sub(agent, writer, handle, from_change=None,
+                          skip_rows=query.get("skip_rows") == ["true"])
+        return False
+    if method == "GET" and path.startswith("/v1/subscriptions/"):
+        if agent.subs is None:
+            raise HttpError(501, "subscriptions not enabled")
+        sub_id = path.rsplit("/", 1)[1]
+        handle = agent.subs.get(sub_id)
+        if handle is None:
+            raise HttpError(404, f"no such subscription {sub_id}")
+        frm = query.get("from")
+        await _stream_sub(
+            agent, writer, handle,
+            from_change=int(frm[0]) if frm else None,
+            skip_rows=query.get("skip_rows") == ["true"],
+        )
+        return False
+    raise HttpError(404, f"no route for {method} {path}")
+
+
+async def _stream_sub(agent, writer, handle, from_change, skip_rows) -> None:
+    """NDJSON QueryEvent stream (api/public/pubsub.rs:36-180)."""
+    await _start_stream(writer)
+    queue = handle.attach()
+    try:
+        for ev in handle.backlog(from_change=from_change, skip_rows=skip_rows):
+            await _stream_chunk(
+                writer, json.dumps(ev.to_json_obj()).encode() + b"\n"
+            )
+        while not agent.tripwire.tripped:
+            try:
+                ev = await asyncio.wait_for(queue.get(), timeout=0.5)
+            except asyncio.TimeoutError:
+                continue
+            await _stream_chunk(
+                writer, json.dumps(ev.to_json_obj()).encode() + b"\n"
+            )
+    finally:
+        handle.detach(queue)
+        try:
+            await _end_stream(writer)
+        except (ConnectionError, OSError):
+            pass
+
+
+def _json_body(body: bytes):
+    if not body:
+        raise HttpError(400, "empty body")
+    try:
+        return json.loads(body)
+    except json.JSONDecodeError as e:
+        raise HttpError(400, f"bad json: {e}")
+
+
+def _jsonable(row):
+    return [
+        v.hex() if isinstance(v, bytes) else v for v in row
+    ]

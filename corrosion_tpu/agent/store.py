@@ -68,12 +68,32 @@ def _q(ident: str) -> str:
     return '"' + ident.replace('"', '""') + '"'
 
 
+def _qs(text: str) -> str:
+    """Escape a string for single-quoted SQL literal position."""
+    return text.replace("'", "''")
+
+
+def _info_from_meta(
+    name: str, meta: dict[str, tuple], create_sql: str
+) -> TableInfo:
+    rows = sorted(meta.values(), key=lambda r: r[0])
+    pk = tuple(r[1] for r in sorted(rows, key=lambda r: r[5]) if r[5] > 0)
+    data = tuple(r[1] for r in rows if r[5] == 0)
+    if not pk:
+        raise SchemaError(
+            f"table {name} has no primary key — every CRR needs one "
+            "(schema.rs requires non-null PKs)"
+        )
+    return TableInfo(name=name, pk_cols=pk, data_cols=data, create_sql=create_sql)
+
+
 class Store:
     """One node's materialized database + CRDT change tracking.
 
-    Thread-safety: a single writer lock serializes write transactions (the
-    SplitPool's one-writer discipline, corro-types/src/agent.rs:353-547);
-    reads open no transaction and SQLite WAL lets them proceed.
+    Thread-safety: a single writer lock serializes write transactions over
+    the write connection (the SplitPool's one-writer discipline,
+    corro-types/src/agent.rs:353-547); reads run on a separate connection so
+    WAL gives them a committed snapshot, never a writer's in-flight state.
     """
 
     def __init__(self, path: str, site_id: bytes) -> None:
@@ -83,22 +103,33 @@ class Store:
         self.site_id = site_id
         self._write_lock = threading.Lock()
         self.conn = sqlite3.connect(path, check_same_thread=False)
+        # Explicit transaction control (BEGIN IMMEDIATE below); the library's
+        # implicit-transaction mode would fight it.
+        self.conn.isolation_level = None
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.execute("PRAGMA synchronous=NORMAL")
         # setup_conn pragmas (corro-types/src/sqlite.rs:107-118)
         self.conn.create_function("corro_pack", -1, _sql_pack, deterministic=True)
         self._tables: dict[str, TableInfo] = {}
         self._migrate()
+        # Dedicated read connection (the read pool's role): WAL snapshot
+        # isolation from in-flight write transactions.
+        self.read_conn = sqlite3.connect(path, check_same_thread=False)
+        self.read_conn.isolation_level = None
+        self.read_conn.create_function(
+            "corro_pack", -1, _sql_pack, deterministic=True
+        )
         self._load_schema()
 
     def close(self) -> None:
         self.conn.close()
+        self.read_conn.close()
 
     # -- internal tables (migrate framework, sqlite.rs:120-168) -------------
 
     def _migrate(self) -> None:
         c = self.conn
-        with self._write_lock, c:
+        with self._write_lock:
             c.execute(
                 "CREATE TABLE IF NOT EXISTS __corro_meta "
                 "(key TEXT PRIMARY KEY, value) WITHOUT ROWID"
@@ -123,7 +154,7 @@ class Store:
                 " site_id BLOB NOT NULL, cl INTEGER NOT NULL)"
             )
             c.execute(
-                "CREATE INDEX IF NOT EXISTS __crdt_changes_site_dbv"
+                "CREATE UNIQUE INDEX IF NOT EXISTS __crdt_changes_site_dbv"
                 " ON __crdt_changes (site_id, db_version, seq)"
             )
             c.execute(
@@ -155,6 +186,12 @@ class Store:
                 " start_seq INTEGER NOT NULL, end_seq INTEGER NOT NULL,"
                 " last_seq INTEGER NOT NULL, ts INTEGER NOT NULL,"
                 " PRIMARY KEY (actor_id, version, start_seq)) WITHOUT ROWID"
+            )
+            # A crash between apply_changes' COMMIT and its flag reset would
+            # otherwise leave apply_remote=1 persisted, silently muting all
+            # local-change triggers on restart.
+            c.execute(
+                "UPDATE __corro_meta SET value = 0 WHERE key='apply_remote'"
             )
 
     def _load_schema(self) -> None:
@@ -193,6 +230,13 @@ class Store:
                     " WHERE type='table' AND name NOT LIKE 'sqlite_%'"
                 )
             }
+            colmeta: dict[str, dict[str, tuple]] = {
+                name: {
+                    r[1]: r  # (cid, name, type, notnull, dflt, pk)
+                    for r in tmp.execute(f"PRAGMA table_info({_q(name)})")
+                }
+                for name in desired
+            }
         except sqlite3.Error as e:
             raise SchemaError(f"bad schema sql: {e}") from e
         finally:
@@ -203,92 +247,69 @@ class Store:
             if name not in desired:
                 raise SchemaError(f"cannot drop table {name} (destructive)")
 
-        with self._write_lock, self.conn as c:
-            for name, sql in desired.items():
-                if name.startswith(INTERNAL_PREFIXES):
-                    raise SchemaError(f"reserved table name {name}")
-                if name not in self._tables:
-                    c.execute(sql)
-                    info = self._introspect(name, sql)
-                    self._create_crr(c, info)
-                    c.execute(
-                        "INSERT OR REPLACE INTO __corro_schema VALUES (?, ?)",
-                        (name, sql),
-                    )
-                    self._tables[name] = info
-                    changed.append(name)
-                else:
-                    old = self._tables[name]
-                    new_info = self._desired_info(sql)
-                    if new_info.pk_cols != old.pk_cols:
-                        raise SchemaError(
-                            f"cannot change primary key of {name}"
-                        )
-                    dropped = set(old.data_cols) - set(new_info.data_cols)
-                    if dropped:
-                        raise SchemaError(
-                            f"cannot drop columns {sorted(dropped)} of {name}"
-                        )
-                    added = [
-                        col for col in new_info.data_cols
-                        if col not in old.data_cols
-                    ]
-                    if added:
-                        for col in added:
-                            col_def = self._column_def(sql, col)
-                            c.execute(
-                                f"ALTER TABLE {_q(name)} ADD COLUMN {col_def}"
-                            )
-                        info = self._introspect(name, sql)
-                        self._drop_triggers(c, old)
-                        self._create_triggers(c, info)
+        # One explicit transaction so a rejected/broken schema leaves no
+        # partial DDL behind (apply_schema is all-or-nothing in the
+        # reference too, schema.rs:266-628).
+        with self._write_lock:
+            c = self.conn
+            c.execute("BEGIN IMMEDIATE")
+            staged: dict[str, TableInfo] = {}
+            try:
+                for name, sql in desired.items():
+                    if name.startswith(INTERNAL_PREFIXES):
+                        raise SchemaError(f"reserved table name {name}")
+                    meta = colmeta[name]
+                    new_info = _info_from_meta(name, meta, sql)
+                    if name not in self._tables:
+                        c.execute(sql)
+                        self._create_crr(c, new_info)
                         c.execute(
-                            "UPDATE __corro_schema SET create_sql=? WHERE tbl_name=?",
-                            (sql, name),
+                            "INSERT OR REPLACE INTO __corro_schema VALUES (?, ?)",
+                            (name, sql),
                         )
-                        self._tables[name] = info
+                        staged[name] = new_info
                         changed.append(name)
+                    else:
+                        old = self._tables[name]
+                        if new_info.pk_cols != old.pk_cols:
+                            raise SchemaError(
+                                f"cannot change primary key of {name}"
+                            )
+                        dropped = set(old.data_cols) - set(new_info.data_cols)
+                        if dropped:
+                            raise SchemaError(
+                                f"cannot drop columns {sorted(dropped)} of {name}"
+                            )
+                        added = [
+                            col for col in new_info.data_cols
+                            if col not in old.data_cols
+                        ]
+                        if added:
+                            for col in added:
+                                r = meta[col]
+                                type_ = r[2] or ""
+                                dflt = (
+                                    f" DEFAULT {r[4]}" if r[4] is not None else ""
+                                )
+                                c.execute(
+                                    f"ALTER TABLE {_q(name)} ADD COLUMN"
+                                    f" {_q(col)} {type_}{dflt}"
+                                )
+                            self._drop_triggers(c, old)
+                            self._create_triggers(c, new_info)
+                            c.execute(
+                                "UPDATE __corro_schema SET create_sql=?"
+                                " WHERE tbl_name=?",
+                                (sql, name),
+                            )
+                            staged[name] = new_info
+                            changed.append(name)
+                c.execute("COMMIT")
+            except Exception:
+                c.execute("ROLLBACK")
+                raise
+            self._tables.update(staged)
         return changed
-
-    def _desired_info(self, create_sql: str) -> TableInfo:
-        tmp = sqlite3.connect(":memory:")
-        try:
-            tmp.execute(create_sql)
-            rows = list(
-                tmp.execute(
-                    "PRAGMA table_info("
-                    + _q(next(iter(
-                        n for (n,) in tmp.execute(
-                            "SELECT name FROM sqlite_master WHERE type='table'"
-                        )
-                    )))
-                    + ")"
-                )
-            )
-        finally:
-            tmp.close()
-        pk = tuple(r[1] for r in sorted(rows, key=lambda r: r[5]) if r[5] > 0)
-        data = tuple(r[1] for r in rows if r[5] == 0)
-        return TableInfo(name="", pk_cols=pk, data_cols=data, create_sql=create_sql)
-
-    @staticmethod
-    def _column_def(create_sql: str, col: str) -> str:
-        """Extract a column definition from CREATE TABLE sql (best effort:
-        name + type only, constraints beyond DEFAULT are not carried)."""
-        tmp = sqlite3.connect(":memory:")
-        try:
-            tmp.execute(create_sql)
-            (tbl,) = next(
-                iter(tmp.execute("SELECT name FROM sqlite_master WHERE type='table'"))
-            ),
-            for r in tmp.execute(f'PRAGMA table_info("{tbl[0]}")'):
-                if r[1] == col:
-                    type_ = r[2] or ""
-                    dflt = f" DEFAULT {r[4]}" if r[4] is not None else ""
-                    return f"{_q(col)} {type_}{dflt}"
-        finally:
-            tmp.close()
-        raise SchemaError(f"column {col} not found")
 
     # -- CRR machinery (crsql_as_crr analogue) -------------------------------
 
@@ -332,18 +353,20 @@ class Store:
 
         def cell_sql(col: str, new_pk: str) -> str:
             qc = _q(col)
+            lc = _qs(col)
+            lt = _qs(t)
             return (
                 "UPDATE __corro_meta SET value = value + 1 WHERE key='seq';\n"
                 f"INSERT INTO {clock_t} (pk, cid, col_version, db_version, seq, site_id)"
-                f" VALUES ({new_pk}, '{col}', 1, {dbv}, {seq}, NULL)"
+                f" VALUES ({new_pk}, '{lc}', 1, {dbv}, {seq}, NULL)"
                 " ON CONFLICT (pk, cid) DO UPDATE SET"
                 "  col_version = col_version + 1,"
                 "  db_version = excluded.db_version,"
                 "  seq = excluded.seq, site_id = NULL;\n"
                 "INSERT INTO __crdt_changes"
                 " (tbl, pk, cid, val, col_version, db_version, seq, site_id, cl)"
-                f" SELECT '{t}', {new_pk}, '{col}', NEW.{qc},"
-                f"  (SELECT col_version FROM {clock_t} WHERE pk = {new_pk} AND cid = '{col}'),"
+                f" SELECT '{lt}', {new_pk}, '{lc}', NEW.{qc},"
+                f"  (SELECT col_version FROM {clock_t} WHERE pk = {new_pk} AND cid = '{lc}'),"
                 f"  {dbv}, {seq},"
                 "  (SELECT value FROM __corro_meta WHERE key='site_id'),"
                 f"  (SELECT cl FROM {rows_t} WHERE pk = {new_pk});\n"
@@ -364,7 +387,7 @@ class Store:
                 "UPDATE __corro_meta SET value = value + 1 WHERE key='seq';\n"
                 "INSERT INTO __crdt_changes"
                 " (tbl, pk, cid, val, col_version, db_version, seq, site_id, cl)"
-                f" SELECT '{t}', {pk_expr}, '{Change.PKONLY_CID}', NULL, 1,"
+                f" SELECT '{_qs(t)}', {pk_expr}, '{Change.PKONLY_CID}', NULL, 1,"
                 f" {dbv}, {seq},"
                 " (SELECT value FROM __corro_meta WHERE key='site_id'),"
                 f" (SELECT cl FROM {rows_t} WHERE pk = {pk_expr});\n"
@@ -393,7 +416,7 @@ class Store:
             "UPDATE __corro_meta SET value = value + 1 WHERE key='seq';\n"
             "INSERT INTO __crdt_changes"
             " (tbl, pk, cid, val, col_version, db_version, seq, site_id, cl)"
-            f" SELECT '{t}', {old_pk_expr}, '{Change.DELETE_CID}', NULL, 1,"
+            f" SELECT '{_qs(t)}', {old_pk_expr}, '{Change.DELETE_CID}', NULL, 1,"
             f" {dbv}, {seq},"
             " (SELECT value FROM __corro_meta WHERE key='site_id'),"
             f" (SELECT cl FROM {rows_t} WHERE pk = {old_pk_expr});\n"
@@ -403,7 +426,7 @@ class Store:
     # -- reads ---------------------------------------------------------------
 
     def query(self, stmt: Statement) -> tuple[list[str], list[tuple]]:
-        cur = self.conn.execute(stmt.sql, stmt.params())
+        cur = self.read_conn.execute(stmt.sql, _bind(stmt))
         cols = [d[0] for d in cur.description] if cur.description else []
         return cols, cur.fetchall()
 
@@ -436,7 +459,7 @@ class Store:
                 dbv = self.db_version()
                 results = []
                 for st in statements:
-                    cur = c.execute(st.sql, st.params())
+                    cur = c.execute(st.sql, _bind(st))
                     results.append(
                         ExecResult(rows_affected=max(cur.rowcount, 0))
                     )
@@ -534,12 +557,12 @@ class Store:
                 self._delete_row(c, info, ch.pk)
             else:
                 self._ensure_row(c, info, ch.pk)
-            self._log_change(c, ch)
             if ch.cl % 2 == 0 or ch.cid in (
                 Change.DELETE_CID, Change.PKONLY_CID,
             ):
+                self._log_change(c, ch)
                 return True
-            # fall through: apply the cell in the fresh epoch
+            # fall through: apply (and log) the cell in the fresh epoch
         else:
             if ch.cl % 2 == 0:
                 return False  # duplicate delete
@@ -587,7 +610,7 @@ class Store:
         # Keep the winning change re-servable for third-party sync
         # (the crsql_changes vtab serves merged state by (site, db_version)).
         c.execute(
-            "INSERT INTO __crdt_changes"
+            "INSERT OR REPLACE INTO __crdt_changes"
             " (tbl, pk, cid, val, col_version, db_version, seq, site_id, cl)"
             " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
             ch.to_tuple(),
@@ -617,6 +640,12 @@ class Store:
             unpack_columns(pk),
         ).fetchone()
         return row[0] if row else None
+
+
+def _bind(st: Statement):
+    if st.named_params is not None:
+        return st.named_params
+    return st.params or ()
 
 
 def _sql_pack(*values: SqliteValue) -> bytes:

@@ -1,0 +1,253 @@
+"""Subscription engine — the Matcher (corro-types/src/pubsub.rs) rebuilt.
+
+The reference's Matcher (pubsub.rs:510-1570, its largest component) parses a
+SELECT, tracks which tables feed it, and on each batch of changes
+incrementally re-evaluates the query, diffing against the previous result to
+emit insert/update/delete QueryEvents with monotonically increasing change
+ids; subscribers can catch up from any change id (`?from=`).
+
+This implementation keeps the same contract with a different mechanism
+suited to the host store:
+
+- table dependencies are discovered with SQLite's authorizer hook during
+  prepare (instead of a SQL AST walk with sqlite3-parser);
+- row identity: for plain single-table selects the table's primary key is
+  injected into the select list (the reference's PK-alias rewrite,
+  pubsub.rs:566-661); other shapes (joins/aggregates) fall back to
+  whole-row identity, which downgrades updates to delete+insert pairs but
+  keeps the stream correct;
+- the result snapshot and the change history (`query` and `changes` tables
+  of the reference's per-sub SQLite db, pubsub.rs:806-841) live in memory,
+  with the same change-id semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sqlite3
+import time
+import uuid
+from collections import deque
+
+from corrosion_tpu.agent.store import Store
+from corrosion_tpu.core.values import (
+    CHANGE_DELETE,
+    CHANGE_INSERT,
+    CHANGE_UPDATE,
+    Change,
+    QueryEventChange,
+    QueryEventColumns,
+    QueryEventEndOfQuery,
+    QueryEventRow,
+)
+
+MAX_CHANGE_HISTORY = 8192
+
+
+def normalize_sql(sql: str) -> str:
+    """Whitespace/case-insensitive reuse key (pubsub.rs normalize_sql:2089)."""
+    return " ".join(sql.strip().rstrip(";").split()).lower()
+
+
+def _referenced_tables(conn: sqlite3.Connection, sql: str) -> set[str]:
+    """Tables a SELECT reads, via the authorizer hook during prepare."""
+    seen: set[str] = set()
+
+    def auth(action, arg1, arg2, dbname, trigger):
+        if action == sqlite3.SQLITE_READ and arg1:
+            seen.add(arg1)
+        return sqlite3.SQLITE_OK
+
+    conn.set_authorizer(auth)
+    try:
+        conn.execute(f"EXPLAIN {sql}")
+    finally:
+        conn.set_authorizer(None)
+    return {t for t in seen if not t.startswith("__")}
+
+
+class MatcherHandle:
+    """One materialized subscription; fan-out to any number of listeners
+    (the broadcast::Sender per sub, api/public/pubsub.rs:117-180)."""
+
+    def __init__(self, store: Store, sql: str) -> None:
+        self.id = uuid.uuid4().hex
+        self.sql = sql
+        self.store = store
+        self.tables = _referenced_tables(store.read_conn, sql)
+        if not self.tables:
+            raise ValueError("query reads no user tables")
+        self._pk_prefix = 0
+        self._exec_sql = sql
+        self._maybe_inject_pks()
+        self.columns: list[str] = []
+        self.rows: dict[tuple, tuple] = {}  # identity key -> cells
+        self.rowids: dict[tuple, int] = {}
+        self._next_rowid = 1
+        self.change_id = 0
+        self.history: deque[QueryEventChange] = deque(maxlen=MAX_CHANGE_HISTORY)
+        self._listeners: list[asyncio.Queue] = []
+        self._run_initial()
+
+    # -- query shape ---------------------------------------------------------
+
+    def _maybe_inject_pks(self) -> None:
+        """For `SELECT ... FROM <one crr table> ...`, prepend the table's PK
+        columns as identity columns (hidden from emitted cells)."""
+        import re
+
+        m = re.match(
+            r"(?is)^\s*select\s+(?!.*\bjoin\b)(.+?)\s+from\s+([A-Za-z_][\w]*)"
+            r"(\s+(?:where|order|group|limit)\b.*)?\s*;?\s*$",
+            self.sql,
+        )
+        if not m:
+            return
+        table = m.group(2)
+        info = self.store.tables().get(table)
+        if info is None:
+            return
+        select_list, tail = m.group(1), m.group(3) or ""
+        if re.search(r"(?i)\b(count|sum|avg|min|max|group_concat)\s*\(", select_list):
+            return
+        pk_cols = ", ".join(f'"{table}"."{c}"' for c in info.pk_cols)
+        self._exec_sql = (
+            f'SELECT {pk_cols}, {select_list} FROM "{table}"{tail}'
+        )
+        self._pk_prefix = len(info.pk_cols)
+
+    def _evaluate(self) -> tuple[list[str], dict[tuple, tuple]]:
+        cur = self.store.read_conn.execute(self._exec_sql)
+        cols = [d[0] for d in cur.description][self._pk_prefix:]
+        out: dict[tuple, tuple] = {}
+        for row in cur.fetchall():
+            if self._pk_prefix:
+                key = tuple(row[: self._pk_prefix])
+                cells = tuple(row[self._pk_prefix:])
+            else:
+                key = tuple(row)
+                cells = tuple(row)
+            out[key] = cells
+        return cols, out
+
+    def _run_initial(self) -> None:
+        self.columns, self.rows = self._evaluate()
+        for key in self.rows:
+            self.rowids[key] = self._next_rowid
+            self._next_rowid += 1
+
+    # -- change path (handle_candidates, pubsub.rs:1303-1570) ----------------
+
+    def interested(self, changes: list[Change]) -> bool:
+        return any(ch.table in self.tables for ch in changes)
+
+    def process(self) -> list[QueryEventChange]:
+        """Re-evaluate and diff (the rewritten-query + EXCEPT diff of the
+        reference collapses to snapshot diffing here)."""
+        _, new_rows = self._evaluate()
+        events: list[QueryEventChange] = []
+        for key, cells in new_rows.items():
+            if key not in self.rows:
+                self.rowids.setdefault(key, self._next_rowid)
+                self._next_rowid += 1
+                events.append(self._emit(CHANGE_INSERT, key, cells))
+            elif self.rows[key] != cells:
+                events.append(self._emit(CHANGE_UPDATE, key, cells))
+        for key, cells in self.rows.items():
+            if key not in new_rows:
+                events.append(self._emit(CHANGE_DELETE, key, cells))
+                self.rowids.pop(key, None)
+        self.rows = new_rows
+        for ev in events:
+            self.history.append(ev)
+            for q in self._listeners:
+                try:
+                    q.put_nowait(ev)
+                except asyncio.QueueFull:
+                    pass
+        return events
+
+    def _emit(self, kind, key, cells) -> QueryEventChange:
+        self.change_id += 1
+        return QueryEventChange(
+            kind=kind,
+            rowid=self.rowids.get(key, 0),
+            cells=list(cells),
+            change_id=self.change_id,
+        )
+
+    # -- listener fan-out ----------------------------------------------------
+
+    def attach(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=1024)
+        self._listeners.append(q)
+        return q
+
+    def detach(self, q: asyncio.Queue) -> None:
+        if q in self._listeners:
+            self._listeners.remove(q)
+
+    def backlog(self, from_change: int | None = None, skip_rows: bool = False):
+        """Initial events for a new listener: either a snapshot (columns +
+        rows + eoq) or catch-up from a change id (catch_up_sub,
+        api/public/pubsub.rs:36-94)."""
+        events: list = [{"sub_id": self.id}]
+        if from_change is not None:
+            oldest = self.history[0].change_id if self.history else None
+            if oldest is not None and from_change < oldest:
+                # History truncated: restart with a snapshot.
+                from_change = None
+        if from_change is None:
+            events.append(QueryEventColumns(list(self.columns)))
+            if not skip_rows:
+                for key, cells in self.rows.items():
+                    events.append(
+                        QueryEventRow(self.rowids[key], list(cells))
+                    )
+            events.append(
+                QueryEventEndOfQuery(time=time.time(), change_id=self.change_id)
+            )
+        else:
+            events.append(QueryEventColumns(list(self.columns)))
+            for ev in self.history:
+                if ev.change_id >= from_change:
+                    events.append(ev)
+        return [_WireEvent(e) if isinstance(e, dict) else e for e in events]
+
+
+class _WireEvent:
+    """Raw dict frames (sub_id header) alongside QueryEvents."""
+
+    def __init__(self, obj: dict):
+        self._obj = obj
+
+    def to_json_obj(self) -> dict:
+        return self._obj
+
+
+class SubsManager:
+    """Query-text-keyed matcher registry (SubsManager, pubsub.rs:77-214)."""
+
+    def __init__(self, store: Store) -> None:
+        self.store = store
+        self._by_sql: dict[str, MatcherHandle] = {}
+        self._by_id: dict[str, MatcherHandle] = {}
+
+    def subscribe(self, sql: str) -> MatcherHandle:
+        key = normalize_sql(sql)
+        handle = self._by_sql.get(key)
+        if handle is None:
+            handle = MatcherHandle(self.store, sql)
+            self._by_sql[key] = handle
+            self._by_id[handle.id] = handle
+        return handle
+
+    def get(self, sub_id: str) -> MatcherHandle | None:
+        return self._by_id.get(sub_id)
+
+    def match_changes(self, changes: list[Change]) -> None:
+        """filter_matchable_change + candidate dispatch (pubsub.rs:162-214,
+        441)."""
+        for handle in self._by_id.values():
+            if handle.interested(changes):
+                handle.process()

@@ -1,0 +1,71 @@
+"""In-process multi-agent test harness.
+
+The corro-tests analogue (crates/corro-tests/src/lib.rs:11-66): launch a real
+agent on ephemeral localhost ports with a tempdir and the canonical test
+schema, hand back agent + client. All multi-node tests run real TCP over
+loopback, like the reference's integration tests (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+
+from corrosion_tpu.agent.agent import Agent, AgentConfig
+from corrosion_tpu.client import CorrosionApiClient
+
+# corro-tests/src/lib.rs:11-26
+TEST_SCHEMA = """
+CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '');
+CREATE TABLE tests2 (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '');
+CREATE TABLE testsblob (id BLOB NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '');
+"""
+
+
+@dataclass
+class TestAgent:
+    agent: Agent
+    client: CorrosionApiClient
+
+    @property
+    def gossip_addr(self) -> tuple[str, int]:
+        return self.agent.gossip_addr
+
+    async def stop(self) -> None:
+        await self.agent.stop()
+
+
+async def launch_test_agent(
+    data_dir: str,
+    bootstrap: list[tuple[str, int]] | None = None,
+    schema: str = TEST_SCHEMA,
+    subs: bool = True,
+    **cfg_overrides,
+) -> TestAgent:
+    cfg = AgentConfig(
+        data_dir=data_dir,
+        bootstrap=list(bootstrap or []),
+        schema_sql=schema,
+        **cfg_overrides,
+    )
+    agent = Agent(cfg)
+    if subs:
+        from corrosion_tpu.agent.subs import SubsManager
+
+        agent.subs = SubsManager(agent.store)
+    await agent.start()
+    host, port = agent.api_addr
+    return TestAgent(agent=agent, client=CorrosionApiClient(host, port))
+
+
+async def poll_until(cond, timeout: float = 15.0, interval: float = 0.1):
+    """Await an async predicate until truthy or timeout (the polling loops
+    the reference tests use for convergence checks)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = await cond()
+        if value:
+            return value
+        await asyncio.sleep(interval)
+    raise TimeoutError("condition not met within timeout")

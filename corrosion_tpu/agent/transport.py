@@ -1,0 +1,181 @@
+"""Gossip transport: length-delimited frames over TCP (asyncio).
+
+The reference multiplexes three planes over QUIC (SURVEY.md §5: datagrams =
+SWIM, uni streams = broadcast, bi streams = sync) with a cached
+connection-per-addr pool (corro-agent/src/transport.rs:26-63). Python's
+stdlib has no QUIC, so the host agent uses TCP with the same plane split:
+
+- one-shot frames for SWIM packets and broadcast changesets (send_frame,
+  pooled connections, reconnect-once semantics like transport.rs:75-89);
+- a request/stream exchange for sync sessions (open_session), the bi-stream
+  analogue of peer.rs:925-1527.
+
+Frames are 4-byte big-endian length + JSON; bytes values are encoded as
+{"$b": hex}. Wire-type shapes mirror corro-types/src/broadcast.rs
+(UniPayload/BiPayload) without the speedy binary layout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import Any, Callable, Awaitable
+
+MAX_FRAME = 32 * 1024 * 1024
+
+
+def encode_value(o: Any) -> Any:
+    if isinstance(o, bytes):
+        return {"$b": o.hex()}
+    if isinstance(o, (list, tuple)):
+        return [encode_value(x) for x in o]
+    if isinstance(o, dict):
+        return {k: encode_value(v) for k, v in o.items()}
+    return o
+
+
+def decode_value(o: Any) -> Any:
+    if isinstance(o, dict):
+        if set(o.keys()) == {"$b"}:
+            return bytes.fromhex(o["$b"])
+        return {k: decode_value(v) for k, v in o.items()}
+    if isinstance(o, list):
+        return [decode_value(x) for x in o]
+    return o
+
+
+def encode_frame(msg: dict) -> bytes:
+    body = json.dumps(encode_value(msg), separators=(",", ":")).encode()
+    return struct.pack(">I", len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> dict | None:
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    (n,) = struct.unpack(">I", header)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    try:
+        body = await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    return decode_value(json.loads(body))
+
+
+class Transport:
+    """Pooled one-shot sender + session opener + inbound server."""
+
+    def __init__(self) -> None:
+        self._pool: dict[tuple[str, int], tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
+        self._locks: dict[tuple[str, int], asyncio.Lock] = {}
+        self._server: asyncio.AbstractServer | None = None
+
+    # -- outbound ------------------------------------------------------------
+
+    async def send_frame(self, addr: tuple[str, int], msg: dict) -> bool:
+        """Fire-and-forget frame (datagram/uni-stream analogue). One retry
+        with a fresh connection on failure (transport.rs:75-89)."""
+        lock = self._locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            for attempt in (0, 1):
+                try:
+                    _, writer = await self._conn(addr, fresh=attempt > 0)
+                    writer.write(encode_frame(msg))
+                    await writer.drain()
+                    return True
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    self._drop(addr)
+        return False
+
+    async def open_session(
+        self, addr: tuple[str, int], first: dict, timeout: float = 10.0
+    ) -> "Session | None":
+        """Dedicated connection for a sync exchange (bi-stream analogue)."""
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(*addr), timeout
+            )
+            writer.write(encode_frame(first))
+            await writer.drain()
+            return Session(reader, writer)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            return None
+
+    async def _conn(self, addr, fresh=False):
+        if fresh:
+            self._drop(addr)
+        if addr not in self._pool:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(*addr), 5.0
+            )
+            self._pool[addr] = (reader, writer)
+        return self._pool[addr]
+
+    def _drop(self, addr) -> None:
+        pair = self._pool.pop(addr, None)
+        if pair:
+            try:
+                pair[1].close()
+            except Exception:
+                pass
+
+    # -- inbound -------------------------------------------------------------
+
+    async def serve(
+        self,
+        host: str,
+        port: int,
+        handler: Callable[["Session", dict], Awaitable[None]],
+    ) -> tuple[str, int]:
+        """Accept connections; dispatch each inbound frame to ``handler``.
+        The handler may keep the session for a streaming exchange."""
+
+        async def on_conn(reader, writer):
+            session = Session(reader, writer)
+            try:
+                while True:
+                    msg = await read_frame(reader)
+                    if msg is None:
+                        break
+                    await handler(session, msg)
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+            finally:
+                session.close()
+
+        self._server = await asyncio.start_server(on_conn, host, port)
+        sock = self._server.sockets[0].getsockname()
+        return sock[0], sock[1]
+
+    def close(self) -> None:
+        for addr in list(self._pool):
+            self._drop(addr)
+        if self._server is not None:
+            self._server.close()
+
+
+class Session:
+    """One connection usable for framed request/stream exchanges."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+
+    async def send(self, msg: dict) -> None:
+        self.writer.write(encode_frame(msg))
+        await self.writer.drain()
+
+    async def recv(self, timeout: float = 30.0) -> dict | None:
+        try:
+            return await asyncio.wait_for(read_frame(self.reader), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
