@@ -1,14 +1,14 @@
-"""Headline benchmark: cluster-wide change propagation throughput.
+"""Headline benchmark: 10k-node CRDT merge storm — p99 change visibility.
 
-Runs BASELINE config 4 (10k-node concurrent-writer CRDT merge storm) on the
-available accelerator and reports how many change-version applications per
-second the simulated cluster sustains (broadcast deliveries + anti-entropy
-replay across all nodes).
+Runs BASELINE config 4 (10k virtual nodes, concurrent writers, live CRDT
+cell plane) and reports the north-star metric: p99 change-visibility latency
+in simulated seconds (target < 10 s, BASELINE.md). vs_baseline is
+target / measured, so > 1.0 means the target is beaten.
 
-vs_baseline: the only throughput number the reference publishes is the
-2-node quick-start log excerpt, ≈156 changes/s (BASELINE.md; reference
-doc/quick-start.md:119). The ratio is our simulated cluster-wide
-apply throughput over that single-link figure.
+Extra fields document the run honestly: convergence flag, cluster-wide
+apply throughput, wall-clock per round after warm-up (the compile cache is
+hit because the jitted scan is hoisted), and a per-plane step-time
+breakdown (SWIM / broadcast / sync) from isolated timed executions.
 
 Prints exactly one JSON line on stdout; diagnostics go to stderr.
 """
@@ -20,13 +20,26 @@ import sys
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+
+
+def _time_plane(fn, *args, iters=5):
+    out = fn(*args)  # compile
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    return (time.perf_counter() - t0) / iters * 1000.0  # ms
 
 
 def main() -> None:
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     from corrosion_tpu import models
+    from corrosion_tpu.ops import gossip as gossip_ops
+    from corrosion_tpu.ops import swim as swim_ops
     from corrosion_tpu.sim import simulate, visibility_latencies
 
     if on_accel:
@@ -35,39 +48,87 @@ def main() -> None:
         n, rounds = 512, 60
     cfg, topo, sched = models.merge_10k(n=n, rounds=rounds, samples=256)
 
+    chunk = 20  # bound single device executions (watchdog-safe)
     t0 = time.perf_counter()
-    final, curves = simulate(cfg, topo, sched, seed=0)
+    final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=chunk)
     jax.block_until_ready(final.data.contig)
     compile_and_run = time.perf_counter() - t0
 
     t1 = time.perf_counter()
-    final, curves = simulate(cfg, topo, sched, seed=1)
+    final, curves = simulate(cfg, topo, sched, seed=1, max_chunk=chunk)
     jax.block_until_ready(final.data.contig)
     wall = time.perf_counter() - t1
+    step_ms = wall / rounds * 1000.0
 
     applied = float(curves["applied_broadcast"].astype(np.float64).sum()
                     + curves["applied_sync"].astype(np.float64).sum())
-    throughput = applied / wall
+    merges = float(curves["cell_merges"].astype(np.float64).sum())
     lat = visibility_latencies(final, sched, cfg)
     heads = np.asarray(final.data.head, dtype=np.float64)
     contig = np.asarray(final.data.contig, dtype=np.float64)
     converged = bool((contig == heads[None, :]).all())
+    cells_ok = bool(gossip_ops.cells_agree(final.data, cfg.gossip))
 
-    print(
-        f"[bench] platform={platform} nodes={n} rounds={rounds} "
-        f"wall={wall:.3f}s (first run incl. compile {compile_and_run:.1f}s) "
-        f"applied={applied:.0f} converged={converged} "
-        f"vis p50={lat['p50_s']:.2f}s p99={lat['p99_s']:.2f}s "
-        f"unseen={lat['unseen']}",
-        file=sys.stderr,
+    # Per-plane step-time breakdown on fresh state (isolated jitted calls).
+    data = gossip_ops.init_data(cfg.gossip)
+    sw = swim_ops.init_state(cfg.swim)
+    alive = jnp.ones(cfg.n_nodes, bool)
+    n_regions = int(np.asarray(topo.region).max()) + 1
+    part = jnp.zeros((n_regions, n_regions), bool)
+    writes = jnp.asarray(sched.writes[0], jnp.uint32)
+    key = jax.random.PRNGKey(0)
+    bcast_ms = _time_plane(
+        lambda: gossip_ops.broadcast_round(
+            data, topo, alive, part, writes, key, cfg.gossip
+        )
     )
+    sync_ms = _time_plane(
+        lambda: gossip_ops.sync_round(
+            data, topo, alive, part, jnp.int32(0), key, cfg.gossip
+        )
+    )
+    swim_ms = _time_plane(
+        lambda: swim_ops.swim_round(sw, key, jnp.int32(0), cfg.swim)
+    )
+
+    state_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(final.data)
+    ) + sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(final.swim))
+
+    diag = {
+        "platform": platform,
+        "nodes": n,
+        "rounds": rounds,
+        "wall_s": round(wall, 3),
+        "first_run_incl_compile_s": round(compile_and_run, 1),
+        "applied": applied,
+        "cell_merges": merges,
+        "state_mib": round(state_bytes / 2**20, 1),
+    }
+    print(f"[bench] {json.dumps(diag)}", file=sys.stderr)
+
+    p99 = lat["p99_s"]
     print(
         json.dumps(
             {
-                "metric": "change_propagation_throughput",
-                "value": round(throughput, 1),
-                "unit": "changes/s",
-                "vs_baseline": round(throughput / 156.0, 1),
+                "metric": "p99_change_visibility_10k",
+                "value": round(p99, 2),
+                "unit": "s",
+                # North-star target is p99 < 10 s (BASELINE.md); ratio > 1
+                # beats it. The reference publishes no comparable number —
+                # its only throughput figure is a 2-node log excerpt.
+                "vs_baseline": round(10.0 / p99, 2) if p99 > 0 else None,
+                "converged": converged,
+                "cells_converged": cells_ok,
+                "unseen_pairs": lat["unseen"],
+                "p50_s": round(lat["p50_s"], 2),
+                "throughput_changes_per_s": round(applied / wall, 1),
+                "step_ms": round(step_ms, 1),
+                "plane_ms": {
+                    "swim": round(swim_ms, 1),
+                    "broadcast": round(bcast_ms, 1),
+                    "sync": round(sync_ms, 1),
+                },
             }
         )
     )

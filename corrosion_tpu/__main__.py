@@ -1,0 +1,3 @@
+from corrosion_tpu.cli import main
+
+raise SystemExit(main())

@@ -34,6 +34,7 @@ from corrosion_tpu.core.changes import chunk_changes
 from corrosion_tpu.core.hlc import HLC
 from corrosion_tpu.core.intervals import RangeSet
 from corrosion_tpu.core.values import Change, ExecResponse, ExecResult, Statement
+from corrosion_tpu.utils.locks import LockRegistry
 from corrosion_tpu.utils.spawn import TaskRegistry
 from corrosion_tpu.utils.tripwire import Tripwire
 
@@ -55,6 +56,7 @@ class AgentConfig:
     sync_peers: int = 3  # 3-10 by need desc / ring asc (agent.rs:2383-2423)
     ingest_batch: int = 1000  # handle_changes batching (agent.rs:2450-2518)
     ingest_linger: float = 0.05
+    admin_uds: str = ""  # unix socket path for admin RPC ("" = disabled)
 
 
 @dataclass
@@ -78,6 +80,9 @@ class Agent:
         self.members = Members(self.actor_id)
         self.tasks = TaskRegistry()
         self.tripwire = Tripwire()
+        self.lock_registry = LockRegistry()
+        self.store.lock_registry = self.lock_registry
+        self._admin_server = None
         self.gossip_addr: tuple[str, int] | None = None
         self.api_addr: tuple[str, int] | None = None
         self.swim: Swim | None = None
@@ -138,6 +143,10 @@ class Agent:
         self.tasks.spawn(self._broadcast_loop(), name="broadcast_loop")
         self.tasks.spawn(self._ingest_loop(), name="handle_changes")
         self.tasks.spawn(self._sync_loop(), name="sync_loop")
+        if self.cfg.admin_uds:
+            from corrosion_tpu.agent.admin import start_admin
+
+            await start_admin(self, self.cfg.admin_uds)
         for addr in self.cfg.bootstrap:
             await self.swim.announce(tuple(addr))
 
@@ -148,6 +157,8 @@ class Agent:
         self.transport.close()
         if self._api_server is not None:
             self._api_server.close()
+        if self._admin_server is not None:
+            self._admin_server.close()
         self.store.close()
 
     # -- write path (make_broadcastable_changes) ------------------------------
@@ -386,8 +397,12 @@ class Agent:
         if not peers:
             return
         peers = peers[: self.cfg.sync_peers]
-        my_state = generate_sync(self.bookie, self.actor_id)
         for m in peers:
+            # Regenerate per peer: changesets ingested from the previous
+            # peer shrink what we ask the next one for (the reference's
+            # scheduler dedups in-flight needs across peers,
+            # peer.rs:1108-1223).
+            my_state = generate_sync(self.bookie, self.actor_id)
             session = await self.transport.open_session(
                 m.addr,
                 {"t": "sync_start", "actor": self.actor_id,
@@ -424,6 +439,9 @@ class Agent:
                             booked.insert_many(s, e, CLEARED)
             finally:
                 session.close()
+            # Let the ingest batcher absorb this peer's changesets before
+            # computing the next peer's (smaller) request.
+            await asyncio.sleep(self.cfg.ingest_linger * 2)
 
     async def _serve_sync(self, session: Session, start: dict) -> None:
         """Server side (peer.rs:1289-1527)."""
@@ -445,28 +463,30 @@ class Agent:
 
     async def _serve_need(self, session, actor, booked, need) -> None:
         if isinstance(need, FullNeed):
-            cleared: list[tuple[int, int]] = []
-            for v in range(need.start, need.end + 1):
-                known = booked.get(v)
-                if isinstance(known, Current):
-                    changes = self.store.changes_for(
-                        bytes.fromhex(actor), known.db_version
-                    )
-                    for chunk, (s, e) in chunk_changes(
-                        changes, known.last_seq
-                    ):
-                        await session.send(
-                            self._sync_changes_frame(
-                                actor, v, chunk, (s, e), known.last_seq,
-                                known.ts,
-                            )
-                        )
-                elif known is CLEARED:
-                    cleared.append((v, v))
+            # Cleared spans come straight from the interval set — a large
+            # compacted range must not be walked version-by-version (it
+            # would block the event loop and stall SWIM probes).
+            cleared = [
+                (max(s, need.start), min(e, need.end))
+                for s, e in booked.cleared
+                if s <= need.end and e >= need.start
+            ]
             if cleared:
                 await session.send(
                     {"t": "sync_cleared", "actor": actor, "versions": cleared}
                 )
+            for v, known in sorted(booked.current.items()):
+                if v < need.start or v > need.end:
+                    continue
+                changes = self.store.changes_for(
+                    bytes.fromhex(actor), known.db_version
+                )
+                for chunk, (s, e) in chunk_changes(changes, known.last_seq):
+                    await session.send(
+                        self._sync_changes_frame(
+                            actor, v, chunk, (s, e), known.last_seq, known.ts,
+                        )
+                    )
         elif isinstance(need, PartialNeed):
             known = booked.get(need.version)
             if not isinstance(known, Partial):

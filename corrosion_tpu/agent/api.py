@@ -47,7 +47,10 @@ async def _read_request(reader: asyncio.StreamReader):
         k, _, v = h.decode().partition(":")
         headers[k.strip().lower()] = v.strip()
     body = b""
-    n = int(headers.get("content-length", 0))
+    try:
+        n = int(headers.get("content-length", 0))
+    except ValueError:
+        raise HttpError(400, "bad content-length")
     if n:
         if n > MAX_BODY:
             raise HttpError(413, "body too large")
@@ -95,7 +98,12 @@ async def serve_api(agent: "Agent") -> tuple[str, int]:
     async def on_conn(reader, writer):
         try:
             while True:
-                req = await _read_request(reader)
+                try:
+                    req = await _read_request(reader)
+                except HttpError as e:
+                    _json_resp(writer, e.status, {"error": e.message})
+                    await writer.drain()
+                    break
                 if req is None:
                     break
                 method, target, headers, body = req
@@ -114,7 +122,7 @@ async def serve_api(agent: "Agent") -> tuple[str, int]:
                 await writer.drain()
                 if not keep:
                     break
-        except (ConnectionError, asyncio.IncompleteReadError, HttpError):
+        except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
             try:
@@ -192,7 +200,7 @@ async def _stream_sub(agent, writer, handle, from_change, skip_rows) -> None:
     try:
         for ev in handle.backlog(from_change=from_change, skip_rows=skip_rows):
             await _stream_chunk(
-                writer, json.dumps(ev.to_json_obj()).encode() + b"\n"
+                writer, json.dumps(_json_safe(ev.to_json_obj())).encode() + b"\n"
             )
         while not agent.tripwire.tripped:
             try:
@@ -200,7 +208,7 @@ async def _stream_sub(agent, writer, handle, from_change, skip_rows) -> None:
             except asyncio.TimeoutError:
                 continue
             await _stream_chunk(
-                writer, json.dumps(ev.to_json_obj()).encode() + b"\n"
+                writer, json.dumps(_json_safe(ev.to_json_obj())).encode() + b"\n"
             )
     finally:
         handle.detach(queue)
@@ -223,3 +231,14 @@ def _jsonable(row):
     return [
         v.hex() if isinstance(v, bytes) else v for v in row
     ]
+
+
+def _json_safe(obj):
+    """Recursive bytes -> hex for event payloads (BLOB cells)."""
+    if isinstance(obj, bytes):
+        return obj.hex()
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj

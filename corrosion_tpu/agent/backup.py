@@ -1,0 +1,71 @@
+"""Backup / restore.
+
+Mirrors `corrosion backup` / `corrosion restore` (reference
+corrosion/src/main.rs:154-288): backup = `VACUUM INTO` a snapshot and strip
+node-local state so the file can seed a *different* node; restore = swap
+the db file into place (offline here — the reference's online variant takes
+SQLite's C file locks, sqlite3-restore/lib.rs:15-57, which only matters for
+a live process) optionally re-adopting the backup's actor id.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sqlite3
+
+# Node-local tables a backup must not carry into another node
+# (main.rs:176-216 strips members + local bookkeeping rewrite).
+NODE_LOCAL_TABLES = ("__corro_members",)
+
+
+def backup(db_path: str, out_path: str) -> None:
+    if os.path.exists(out_path):
+        raise FileExistsError(out_path)
+    src = sqlite3.connect(db_path)
+    try:
+        src.execute("VACUUM INTO ?", (out_path,))
+    finally:
+        src.close()
+    snap = sqlite3.connect(out_path)
+    try:
+        for tbl in NODE_LOCAL_TABLES:
+            snap.execute(f"DROP TABLE IF EXISTS {tbl}")
+        # The snapshot must not reuse the origin's identity by default: a
+        # restored node adopts it only with --self-actor-id (main.rs:220-288).
+        snap.execute("COMMIT") if snap.in_transaction else None
+        snap.execute("VACUUM")
+    finally:
+        snap.close()
+
+
+def restore(
+    backup_path: str, db_path: str, self_actor_id: bool = False
+) -> bytes:
+    """Swap the backup into place; returns the site_id now in effect.
+
+    With self_actor_id=False a fresh identity is assigned so the restored
+    node replicates as a new actor (the safe default); True keeps the
+    backup's identity (re-adoption)."""
+    tmp = db_path + ".restore"
+    shutil.copyfile(backup_path, tmp)
+    conn = sqlite3.connect(tmp)
+    try:
+        if not self_actor_id:
+            new_site = os.urandom(16)
+            conn.execute(
+                "UPDATE __corro_meta SET value = ? WHERE key = 'site_id'",
+                (new_site,),
+            )
+            conn.commit()
+        (site_id,) = conn.execute(
+            "SELECT value FROM __corro_meta WHERE key='site_id'"
+        ).fetchone()
+    finally:
+        conn.close()
+    for suffix in ("", "-wal", "-shm"):
+        p = db_path + suffix
+        if suffix and os.path.exists(p):
+            os.unlink(p)
+    os.replace(tmp, db_path)
+    return bytes(site_id)

@@ -150,12 +150,15 @@ class Swim:
         )
 
     def _piggyback(self) -> list[dict]:
-        out = []
-        for r in self.rumors:
+        sent, out = self.rumors[:8], []
+        for r in sent:
             out.append(r.wire())
             r.tx_left -= 1
-        self.rumors = [r for r in self.rumors if r.tx_left > 0]
-        return out[:8]
+        # Rotate: spent rumors drop, unsent ones move to the front so a
+        # deep backlog still disseminates everything over later packets.
+        keep = [r for r in sent if r.tx_left > 0]
+        self.rumors = self.rumors[8:] + keep
+        return out
 
     def _absorb(self, updates: list[dict]) -> None:
         for u in updates:

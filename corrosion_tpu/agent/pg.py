@@ -1,0 +1,173 @@
+"""PostgreSQL wire-protocol server — the corro-pg analogue.
+
+The reference serves the pgwire protocol, translating PG SQL to SQLite and
+executing against the agent DB with full bookkeeping + broadcast parity
+(corro-pg/src/lib.rs:474-1769). This implementation speaks protocol v3's
+startup + simple-query flow (plus SSLRequest refusal and Terminate):
+SELECTs run on the store's read connection; writes run through
+Agent.execute so version allocation, bookkeeping, and dissemination are
+identical to the HTTP path (the parity that matters, lib.rs write path).
+
+Everything is typed as text on the wire (like psql's default rendering);
+the extended query protocol (parse/bind) is not implemented — psql's simple
+protocol and most drivers' simple modes work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import TYPE_CHECKING
+
+from corrosion_tpu.core.values import Statement
+
+if TYPE_CHECKING:
+    from corrosion_tpu.agent.agent import Agent
+
+SSL_REQUEST = 80877103
+PROTOCOL_V3 = 196608
+TEXT_OID = 25
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack(">I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+def _error(message: str, code: str = "XX000") -> bytes:
+    fields = b"S" + _cstr("ERROR") + b"C" + _cstr(code) + b"M" + _cstr(message) + b"\x00"
+    return _msg(b"E", fields)
+
+
+def _row_description(cols: list[str]) -> bytes:
+    body = struct.pack(">H", len(cols))
+    for name in cols:
+        body += _cstr(name)
+        body += struct.pack(">IhIhih", 0, 0, TEXT_OID, -1, -1, 0)
+    return _msg(b"T", body)
+
+
+def _data_row(row) -> bytes:
+    body = struct.pack(">H", len(row))
+    for v in row:
+        if v is None:
+            body += struct.pack(">i", -1)
+        else:
+            if isinstance(v, bytes):
+                text = "\\x" + v.hex()
+            elif isinstance(v, bool):
+                text = "t" if v else "f"
+            else:
+                text = str(v)
+            raw = text.encode()
+            body += struct.pack(">i", len(raw)) + raw
+    return body and _msg(b"D", body)
+
+
+def _command_complete(tag: str) -> bytes:
+    return _msg(b"C", _cstr(tag))
+
+
+def _ready() -> bytes:
+    return _msg(b"Z", b"I")
+
+
+def _is_query(sql: str) -> bool:
+    head = sql.lstrip().split(None, 1)
+    word = head[0].upper() if head else ""
+    return word in ("SELECT", "WITH", "EXPLAIN", "PRAGMA", "VALUES", "SHOW")
+
+
+def translate_pg_sql(sql: str) -> str:
+    """Small PG->SQLite surface translation (corro-pg's parse_query,
+    lib.rs:306-472, collapses to the dialect overlaps that matter here)."""
+    s = sql.strip().rstrip(";")
+    upper = s.upper()
+    if upper in ("BEGIN", "COMMIT", "ROLLBACK", "START TRANSACTION"):
+        return ""  # the agent wraps writes in its own transaction
+    if upper.startswith("SET ") or upper.startswith("SHOW "):
+        return ""
+    if upper == "SELECT VERSION()":
+        return "SELECT 'corrosion-tpu (PostgreSQL 14 compatible)' AS version"
+    return s
+
+
+async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
+    async def on_conn(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            await _handshake(reader, writer)
+            writer.write(_msg(b"R", struct.pack(">I", 0)))  # AuthenticationOk
+            for k, v in (
+                ("server_version", "14.0 (corrosion-tpu)"),
+                ("server_encoding", "UTF8"),
+                ("client_encoding", "UTF8"),
+            ):
+                writer.write(_msg(b"S", _cstr(k) + _cstr(v)))
+            writer.write(_ready())
+            await writer.drain()
+            while True:
+                header = await reader.readexactly(5)
+                tag, length = header[0:1], struct.unpack(">I", header[1:5])[0]
+                payload = await reader.readexactly(length - 4)
+                if tag == b"X":
+                    break
+                if tag == b"Q":
+                    await _simple_query(
+                        agent, writer, payload[:-1].decode()
+                    )
+                else:
+                    writer.write(
+                        _error(f"unsupported message {tag!r}", "0A000")
+                    )
+                    writer.write(_ready())
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    server = await asyncio.start_server(on_conn, host, port)
+    sock = server.sockets[0].getsockname()
+    return server, (sock[0], sock[1])
+
+
+async def _handshake(reader, writer) -> None:
+    while True:
+        (length,) = struct.unpack(">I", await reader.readexactly(4))
+        payload = await reader.readexactly(length - 4)
+        (code,) = struct.unpack(">I", payload[:4])
+        if code == SSL_REQUEST:
+            writer.write(b"N")  # no TLS
+            await writer.drain()
+            continue
+        if code != PROTOCOL_V3:
+            raise ConnectionError(f"unsupported protocol {code}")
+        return
+
+
+async def _simple_query(agent: "Agent", writer, sql: str) -> None:
+    for part in filter(None, (p.strip() for p in sql.split(";"))):
+        translated = translate_pg_sql(part)
+        if not translated:
+            writer.write(_command_complete("SET"))
+            continue
+        try:
+            if _is_query(translated):
+                cols, rows = agent.store.query(Statement(translated))
+                writer.write(_row_description(cols))
+                for row in rows:
+                    writer.write(_data_row(row))
+                writer.write(_command_complete(f"SELECT {len(rows)}"))
+            else:
+                resp = agent.execute([Statement(translated)])
+                n = sum(r.rows_affected for r in resp.results)
+                word = translated.split(None, 1)[0].upper()
+                tag = f"INSERT 0 {n}" if word == "INSERT" else f"{word} {n}"
+                writer.write(_command_complete(tag))
+        except Exception as e:
+            writer.write(_error(str(e)))
+            break
+    writer.write(_ready())

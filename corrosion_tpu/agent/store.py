@@ -102,6 +102,7 @@ class Store:
         self.path = path
         self.site_id = site_id
         self._write_lock = threading.Lock()
+        self.lock_registry = None  # optional utils.locks.LockRegistry
         self.conn = sqlite3.connect(path, check_same_thread=False)
         # Explicit transaction control (BEGIN IMMEDIATE below); the library's
         # implicit-transaction mode would fight it.
@@ -125,11 +126,18 @@ class Store:
         self.conn.close()
         self.read_conn.close()
 
+    def _wlock(self, label: str):
+        """Writer lock, registered for lock diagnostics when a registry is
+        attached (CountedTokioRwLock's role, corro-types/agent.rs:593-650)."""
+        if self.lock_registry is not None:
+            return self.lock_registry.acquire(self._write_lock, label)
+        return self._write_lock
+
     # -- internal tables (migrate framework, sqlite.rs:120-168) -------------
 
     def _migrate(self) -> None:
         c = self.conn
-        with self._write_lock:
+        with self._wlock("migrate"):
             c.execute(
                 "CREATE TABLE IF NOT EXISTS __corro_meta "
                 "(key TEXT PRIMARY KEY, value) WITHOUT ROWID"
@@ -153,6 +161,20 @@ class Store:
                 " db_version INTEGER NOT NULL, seq INTEGER NOT NULL,"
                 " site_id BLOB NOT NULL, cl INTEGER NOT NULL)"
             )
+            # Upgrade path: an earlier schema created this index non-unique;
+            # IF NOT EXISTS would silently keep it and break the
+            # INSERT OR REPLACE dedup in _log_change.
+            idx_sql = c.execute(
+                "SELECT sql FROM sqlite_master WHERE type='index'"
+                " AND name='__crdt_changes_site_dbv'"
+            ).fetchone()
+            if idx_sql is not None and "UNIQUE" not in (idx_sql[0] or ""):
+                c.execute(
+                    "DELETE FROM __crdt_changes WHERE rowid NOT IN ("
+                    " SELECT MIN(rowid) FROM __crdt_changes"
+                    " GROUP BY site_id, db_version, seq)"
+                )
+                c.execute("DROP INDEX __crdt_changes_site_dbv")
             c.execute(
                 "CREATE UNIQUE INDEX IF NOT EXISTS __crdt_changes_site_dbv"
                 " ON __crdt_changes (site_id, db_version, seq)"
@@ -250,7 +272,7 @@ class Store:
         # One explicit transaction so a rejected/broken schema leaves no
         # partial DDL behind (apply_schema is all-or-nothing in the
         # reference too, schema.rs:266-628).
-        with self._write_lock:
+        with self._wlock("apply_schema"):
             c = self.conn
             c.execute("BEGIN IMMEDIATE")
             staged: dict[str, TableInfo] = {}
@@ -448,7 +470,7 @@ class Store:
         the changeset. Returns (results, db_version, last_seq, changes);
         db_version is 0 and changes empty when nothing was recorded."""
         c = self.conn
-        with self._write_lock:
+        with self._wlock("execute_transaction"):
             try:
                 c.execute("BEGIN IMMEDIATE")
                 c.execute(
@@ -513,7 +535,7 @@ class Store:
         """Merge remote changes in one txn; returns the applied count."""
         c = self.conn
         applied = 0
-        with self._write_lock:
+        with self._wlock("apply_changes"):
             try:
                 c.execute("BEGIN IMMEDIATE")
                 c.execute(

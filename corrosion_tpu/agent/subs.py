@@ -49,20 +49,40 @@ def normalize_sql(sql: str) -> str:
     return " ".join(sql.strip().rstrip(";").split()).lower()
 
 
+_WRITE_ACTIONS = {
+    sqlite3.SQLITE_INSERT, sqlite3.SQLITE_UPDATE, sqlite3.SQLITE_DELETE,
+    sqlite3.SQLITE_CREATE_TABLE, sqlite3.SQLITE_DROP_TABLE,
+    sqlite3.SQLITE_ALTER_TABLE, sqlite3.SQLITE_CREATE_INDEX,
+    sqlite3.SQLITE_DROP_INDEX, sqlite3.SQLITE_PRAGMA,
+}
+
+
 def _referenced_tables(conn: sqlite3.Connection, sql: str) -> set[str]:
-    """Tables a SELECT reads, via the authorizer hook during prepare."""
+    """Tables a SELECT reads, via the authorizer hook during prepare.
+    Rejects anything that would write — subscriptions are SELECT-only
+    (the Matcher parses a SELECT, pubsub.rs:510-712)."""
     seen: set[str] = set()
+    writes: list[int] = []
 
     def auth(action, arg1, arg2, dbname, trigger):
         if action == sqlite3.SQLITE_READ and arg1:
             seen.add(arg1)
+        if action in _WRITE_ACTIONS:
+            writes.append(action)
+            return sqlite3.SQLITE_DENY
         return sqlite3.SQLITE_OK
 
     conn.set_authorizer(auth)
     try:
         conn.execute(f"EXPLAIN {sql}")
+    except sqlite3.DatabaseError as e:
+        if writes:
+            raise ValueError("subscriptions must be SELECT statements") from e
+        raise
     finally:
         conn.set_authorizer(None)
+    if writes:
+        raise ValueError("subscriptions must be SELECT statements")
     return {t for t in seen if not t.startswith("__")}
 
 
@@ -194,7 +214,7 @@ class MatcherHandle:
         events: list = [{"sub_id": self.id}]
         if from_change is not None:
             oldest = self.history[0].change_id if self.history else None
-            if oldest is not None and from_change < oldest:
+            if oldest is not None and from_change + 1 < oldest:
                 # History truncated: restart with a snapshot.
                 from_change = None
         if from_change is None:
@@ -208,9 +228,11 @@ class MatcherHandle:
                 QueryEventEndOfQuery(time=time.time(), change_id=self.change_id)
             )
         else:
+            # Exclusive: replay events AFTER the given change id
+            # (doc/api/subscriptions.md resume semantics).
             events.append(QueryEventColumns(list(self.columns)))
             for ev in self.history:
-                if ev.change_id >= from_change:
+                if ev.change_id > from_change:
                     events.append(ev)
         return [_WireEvent(e) if isinstance(e, dict) else e for e in events]
 

@@ -1,0 +1,203 @@
+"""corrosion CLI — the crates/corrosion binary's command surface.
+
+Subcommands mirror corrosion/src/main.rs (Cli :447-513, Command :515-641):
+agent, query, exec, backup, restore, sync generate, locks, cluster members,
+reload, template. Run as `python -m corrosion_tpu ...`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from corrosion_tpu.agent.config import Config, parse_addr
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="corrosion", description=__doc__)
+    p.add_argument("--config", "-c", default=None, help="TOML config path")
+    p.add_argument("--api-addr", default=None, help="host:port of the HTTP API")
+    p.add_argument("--admin-path", default=None, help="admin unix socket path")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("agent", help="run the agent until interrupted")
+
+    q = sub.add_parser("query", help="run a read-only SQL statement")
+    q.add_argument("sql")
+    q.add_argument("--columns", action="store_true")
+    q.add_argument("--timer", action="store_true")
+
+    e = sub.add_parser("exec", help="run write statements in a transaction")
+    e.add_argument("sql", nargs="+")
+    e.add_argument("--timer", action="store_true")
+
+    b = sub.add_parser("backup", help="snapshot the db (VACUUM INTO + strip)")
+    b.add_argument("out")
+    b.add_argument("--db", required=True)
+
+    r = sub.add_parser("restore", help="swap a backup into place (offline)")
+    r.add_argument("backup")
+    r.add_argument("--db", required=True)
+    r.add_argument(
+        "--self-actor-id", action="store_true",
+        help="keep the backup's actor identity instead of assigning fresh",
+    )
+
+    s = sub.add_parser("sync", help="sync protocol utilities")
+    s.add_argument("sync_cmd", choices=["generate"])
+
+    lk = sub.add_parser("locks", help="show longest-held lock acquisitions")
+    lk.add_argument("--top", type=int, default=10)
+
+    cl = sub.add_parser("cluster", help="cluster introspection")
+    cl.add_argument("cluster_cmd", choices=["members"])
+
+    rl = sub.add_parser("reload", help="re-apply schema paths from config")
+
+    t = sub.add_parser("template", help="render templates (--watch to follow)")
+    t.add_argument("files", nargs="+", help="TEMPLATE[:OUTPUT] specs")
+    t.add_argument("--watch", action="store_true")
+
+    cs = sub.add_parser("consul", help="consul bridge")
+    cs.add_argument("consul_cmd", choices=["sync"])
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    cfg = Config.load(args.config) if args.config else Config.load()
+    if args.api_addr:
+        cfg.api.addr = args.api_addr
+    if args.admin_path:
+        cfg.admin.uds_path = args.admin_path
+    try:
+        return asyncio.run(_dispatch(args, cfg)) or 0
+    except BrokenPipeError:
+        return 0  # stdout closed early (e.g. piped into head)
+
+
+async def _dispatch(args, cfg: Config) -> int:
+    if args.command == "agent":
+        return await _run_agent(cfg)
+    if args.command == "query":
+        return await _query(args, cfg)
+    if args.command == "exec":
+        return await _exec(args, cfg)
+    if args.command == "backup":
+        from corrosion_tpu.agent.backup import backup
+
+        backup(args.db, args.out)
+        print(f"backed up {args.db} -> {args.out}")
+        return 0
+    if args.command == "restore":
+        from corrosion_tpu.agent.backup import restore
+
+        site = restore(args.backup, args.db, self_actor_id=args.self_actor_id)
+        print(f"restored {args.db} (actor {site.hex()})")
+        return 0
+    if args.command == "sync":
+        frames = await _admin(cfg, {"c": "sync"})
+        print(json.dumps(frames[0], indent=2))
+        return 0
+    if args.command == "locks":
+        frames = await _admin(cfg, {"c": "locks", "top": args.top})
+        print(json.dumps(frames[0]["locks"], indent=2))
+        return 0
+    if args.command == "cluster":
+        frames = await _admin(cfg, {"c": "cluster"})
+        print(json.dumps(frames[0]["members"], indent=2))
+        return 0
+    if args.command == "reload":
+        frames = await _admin(
+            cfg, {"c": "reload", "schema_sql": cfg.schema_sql()}
+        )
+        print(json.dumps(frames[0], indent=2))
+        return 0
+    if args.command == "template":
+        from corrosion_tpu.tpl import run_templates
+
+        await run_templates(args.files, cfg, watch=args.watch)
+        return 0
+    if args.command == "consul":
+        from corrosion_tpu.integrations.consul import run_consul_sync
+
+        await run_consul_sync(cfg)
+        return 0
+    return 2
+
+
+async def _run_agent(cfg: Config) -> int:
+    import os
+
+    from corrosion_tpu.agent.agent import Agent, AgentConfig
+    from corrosion_tpu.agent.subs import SubsManager
+
+    gossip_host, gossip_port = parse_addr(cfg.gossip.addr)
+    api_host, api_port = parse_addr(cfg.api.addr)
+    acfg = AgentConfig(
+        data_dir=os.path.dirname(cfg.db.path) or ".",
+        gossip_host=gossip_host,
+        gossip_port=gossip_port,
+        api_host=api_host,
+        api_port=api_port,
+        bootstrap=[parse_addr(b) for b in cfg.gossip.bootstrap],
+        schema_sql=cfg.schema_sql(),
+        probe_interval=cfg.gossip.probe_interval_ms / 1000.0,
+        sync_interval=cfg.gossip.sync_interval_ms / 1000.0,
+        max_transmissions=cfg.gossip.max_transmissions,
+        admin_uds=cfg.admin.uds_path,
+    )
+    agent = Agent(acfg)
+    agent.subs = SubsManager(agent.store)
+    await agent.start()
+    from corrosion_tpu.utils.tripwire import Tripwire
+
+    agent.tripwire = Tripwire.new_signals()
+    print(
+        f"agent {agent.actor_id} api={agent.api_addr} "
+        f"gossip={agent.gossip_addr}",
+        file=sys.stderr,
+    )
+    await agent.tripwire.wait()
+    await agent.stop()
+    return 0
+
+
+async def _query(args, cfg: Config) -> int:
+    from corrosion_tpu.client import CorrosionApiClient
+
+    host, port = parse_addr(cfg.api.addr)
+    client = CorrosionApiClient(host, port)
+    import time
+
+    t0 = time.monotonic()
+    cols, rows = await client.query(args.sql)
+    if args.columns:
+        print("|".join(cols))
+    for row in rows:
+        print("|".join("" if v is None else str(v) for v in row))
+    if args.timer:
+        print(f"time: {time.monotonic() - t0:.6f}s", file=sys.stderr)
+    return 0
+
+
+async def _exec(args, cfg: Config) -> int:
+    from corrosion_tpu.client import CorrosionApiClient
+
+    host, port = parse_addr(cfg.api.addr)
+    client = CorrosionApiClient(host, port)
+    resp = await client.execute(list(args.sql))
+    print(json.dumps(resp))
+    return 0
+
+
+async def _admin(cfg: Config, command: dict) -> list[dict]:
+    from corrosion_tpu.agent.admin import AdminClient
+
+    return await AdminClient(cfg.admin.uds_path).call(command)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

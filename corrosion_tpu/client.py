@@ -212,12 +212,9 @@ class SubscriptionStream:
         if self.sub_id is None:
             raise ApiError(400, "no sub_id observed yet")
         self.close()
-        frm = (
-            self.last_change_id + 1
-            if self.last_change_id is not None
-            else None
+        fresh = await self._client.resubscribe(
+            self.sub_id, from_change=self.last_change_id
         )
-        fresh = await self._client.resubscribe(self.sub_id, from_change=frm)
         self._resp = fresh._resp
         self._lines = fresh._lines
 

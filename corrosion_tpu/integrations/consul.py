@@ -1,0 +1,204 @@
+"""Consul bridge: poll the local Consul agent, upsert diffs into corrosion.
+
+The reference polls Consul every 1 s, hashes services/checks (seahash), and
+writes only changed rows into the `consul_services` / `consul_checks`
+tables, remembering hashes in `__corro_consul_*` node-local tables
+(corrosion/src/command/consul/sync.rs:20-246,408-530; HTTP client in
+consul-client). Same structure here with a stdlib HTTP client and blake2b
+hashing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+
+from corrosion_tpu.agent.config import Config, parse_addr
+from corrosion_tpu.client import CorrosionApiClient
+
+SETUP_SQL = """
+CREATE TABLE IF NOT EXISTS __corro_consul_services (
+  id TEXT PRIMARY KEY, hash BLOB NOT NULL
+) WITHOUT ROWID;
+CREATE TABLE IF NOT EXISTS __corro_consul_checks (
+  id TEXT PRIMARY KEY, hash BLOB NOT NULL
+) WITHOUT ROWID;
+"""
+
+# The replicated tables the operator's schema must provide (doc'd by the
+# reference's consul docs): consul_services(node, id, name, tags, meta,
+# port, address, updated_at) / consul_checks(node, id, service_id,
+# service_name, name, status, output, updated_at).
+
+
+def hash_service(svc: dict) -> bytes:
+    """Stable digest over the identity-relevant fields (sync.rs:214-233)."""
+    key = json.dumps(
+        {
+            "id": svc.get("ID"),
+            "name": svc.get("Service"),
+            "tags": sorted(svc.get("Tags") or []),
+            "meta": svc.get("Meta") or {},
+            "port": svc.get("Port"),
+            "address": svc.get("Address"),
+        },
+        sort_keys=True,
+    )
+    return hashlib.blake2b(key.encode(), digest_size=8).digest()
+
+
+def hash_check(chk: dict) -> bytes:
+    """Checks hash on status-relevant fields only (sync.rs:235-246)."""
+    key = json.dumps(
+        {
+            "id": chk.get("CheckID"),
+            "service_id": chk.get("ServiceID"),
+            "status": chk.get("Status"),
+            "output": chk.get("Output"),
+        },
+        sort_keys=True,
+    )
+    return hashlib.blake2b(key.encode(), digest_size=8).digest()
+
+
+def diff_statements(
+    node: str,
+    services: dict[str, dict],
+    checks: dict[str, dict],
+    known_services: dict[str, bytes],
+    known_checks: dict[str, bytes],
+) -> tuple[list[list], dict[str, bytes], dict[str, bytes]]:
+    """Compute upsert/delete statements + the new hash tables
+    (update_consul/execute, sync.rs:408-530). Pure, for testing."""
+    stmts: list[list] = []
+    new_svc_hashes: dict[str, bytes] = {}
+    for sid, svc in services.items():
+        h = hash_service(svc)
+        new_svc_hashes[sid] = h
+        if known_services.get(sid) == h:
+            continue
+        stmts.append(
+            [
+                "INSERT INTO consul_services"
+                " (node, id, name, tags, meta, port, address, updated_at)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, strftime('%s','now'))"
+                " ON CONFLICT (node, id) DO UPDATE SET"
+                " name=excluded.name, tags=excluded.tags, meta=excluded.meta,"
+                " port=excluded.port, address=excluded.address,"
+                " updated_at=excluded.updated_at",
+                [
+                    node, sid, svc.get("Service") or "",
+                    json.dumps(svc.get("Tags") or []),
+                    json.dumps(svc.get("Meta") or {}),
+                    svc.get("Port") or 0, svc.get("Address") or "",
+                ],
+            ]
+        )
+    for sid in known_services:
+        if sid not in services:
+            stmts.append(
+                ["DELETE FROM consul_services WHERE node = ? AND id = ?",
+                 [node, sid]]
+            )
+    new_chk_hashes: dict[str, bytes] = {}
+    for cid, chk in checks.items():
+        h = hash_check(chk)
+        new_chk_hashes[cid] = h
+        if known_checks.get(cid) == h:
+            continue
+        stmts.append(
+            [
+                "INSERT INTO consul_checks"
+                " (node, id, service_id, service_name, name, status, output,"
+                "  updated_at)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, strftime('%s','now'))"
+                " ON CONFLICT (node, id) DO UPDATE SET"
+                " service_id=excluded.service_id,"
+                " service_name=excluded.service_name, name=excluded.name,"
+                " status=excluded.status, output=excluded.output,"
+                " updated_at=excluded.updated_at",
+                [
+                    node, cid, chk.get("ServiceID") or "",
+                    chk.get("ServiceName") or "", chk.get("Name") or "",
+                    chk.get("Status") or "", chk.get("Output") or "",
+                ],
+            ]
+        )
+    for cid in known_checks:
+        if cid not in checks:
+            stmts.append(
+                ["DELETE FROM consul_checks WHERE node = ? AND id = ?",
+                 [node, cid]]
+            )
+    return stmts, new_svc_hashes, new_chk_hashes
+
+
+class ConsulHttp:
+    """Minimal Consul agent HTTP client (consul-client's role)."""
+
+    def __init__(self, address: str):
+        self.host, self.port = parse_addr(address)
+
+    async def _get(self, path: str):
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            writer.write(
+                f"GET {path} HTTP/1.1\r\nhost: {self.host}\r\n"
+                "connection: close\r\n\r\n".encode()
+            )
+            await writer.drain()
+            raw = await reader.read()
+        finally:
+            writer.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        status = int(head.split()[1])
+        if status != 200:
+            raise RuntimeError(f"consul HTTP {status}")
+        if b"chunked" in head.lower():
+            body = _dechunk(body)
+        return json.loads(body)
+
+    async def agent_services(self) -> dict:
+        return await self._get("/v1/agent/services")
+
+    async def agent_checks(self) -> dict:
+        return await self._get("/v1/agent/checks")
+
+
+def _dechunk(body: bytes) -> bytes:
+    out = b""
+    while body:
+        size_line, _, rest = body.partition(b"\r\n")
+        n = int(size_line, 16)
+        if n == 0:
+            break
+        out += rest[:n]
+        body = rest[n + 2:]
+    return out
+
+
+async def run_consul_sync(cfg: Config, iterations: int | None = None) -> None:
+    """Poll-and-upsert loop (sync.rs run, :20-117)."""
+    import socket
+
+    node = socket.gethostname()
+    consul = ConsulHttp(cfg.consul.address)
+    host, port = parse_addr(cfg.api.addr)
+    client = CorrosionApiClient(host, port)
+    known_services: dict[str, bytes] = {}
+    known_checks: dict[str, bytes] = {}
+    i = 0
+    while iterations is None or i < iterations:
+        i += 1
+        try:
+            services = await consul.agent_services()
+            checks = await consul.agent_checks()
+            stmts, known_services, known_checks = diff_statements(
+                node, services, checks, known_services, known_checks
+            )
+            if stmts:
+                await client.execute(stmts)
+        except (OSError, RuntimeError):
+            pass  # consul unreachable: retry next tick
+        await asyncio.sleep(cfg.consul.interval_ms / 1000.0)

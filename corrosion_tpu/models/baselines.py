@@ -22,7 +22,9 @@ def _max_tx(n: int) -> int:
     return max(4, int(math.ceil(math.log2(max(n, 2)))) + 2)
 
 
-def _cfg(n, writers, regions=None, **gossip_kw) -> tuple[ClusterConfig, object]:
+def _cfg(
+    n, writers, regions=None, region_rtt=None, **gossip_kw
+) -> tuple[ClusterConfig, object]:
     regions = regions or [n]
     g = GossipConfig(
         n_nodes=n,
@@ -36,7 +38,7 @@ def _cfg(n, writers, regions=None, **gossip_kw) -> tuple[ClusterConfig, object]:
         suspect_rounds=3,
         gossip_fanout=3,
     )
-    topo = make_topology(regions, writers)
+    topo = make_topology(regions, writers, region_rtt=region_rtt)
     return ClusterConfig(swim=s, gossip=g), topo
 
 
@@ -167,6 +169,7 @@ def wan_100k(n: int = 100_000, n_regions: int = 20, n_writers: int = 512,
         n,
         writers=writers,
         regions=[region_size] * n_regions,
+        region_rtt="geo",  # graded WAN rings (members.rs:33)
         sync_interval=12,
         sync_budget=512,
         sync_chunk=64,

@@ -73,24 +73,34 @@ class GossipConfig:
 
 
 class Topology(NamedTuple):
-    """Region layout (contiguous index blocks) + writer placement.
+    """Region layout (contiguous index blocks) + writer placement + rings.
 
-    Regions model the RTT-ring structure (corro-types/src/members.rs:33):
-    same-region peers are "ring 0"; cross-region links can be partitioned.
+    Regions model geography; ``region_rtt`` classifies every region pair
+    into an RTT ring bucket 0-5 (the 0-5/5-15/15-50/50-100/100-200/
+    200-300 ms buckets of corro-types/src/members.rs:33). Same-region pairs
+    are ring 0 — the eager-broadcast / preferred-sync peers; cross-region
+    links can be partitioned.
     """
 
     region: jax.Array  # i32[N] region id per node
     region_start: jax.Array  # i32[N] first node index of own region
     region_size: jax.Array  # i32[N] size of own region
+    region_rtt: jax.Array  # i32[R, R] ring bucket per region pair (0-5)
     writer_nodes: jax.Array  # i32[W] node hosting each writer stream
     writer_of_node: jax.Array  # i32[N] writer index or -1
     sync_phase: jax.Array  # i32[N] per-node jitter offset for sync cadence
 
 
-def make_topology(region_sizes: list[int], writer_nodes, seed: int = 0) -> Topology:
+def make_topology(
+    region_sizes: list[int], writer_nodes, seed: int = 0, region_rtt=None
+) -> Topology:
+    """Build a topology; ``region_rtt`` defaults to a ring-1 flat geography
+    (everything near but not ring 0). Pass an [R, R] matrix of ring classes
+    0-5, or "geo" for a synthetic circle geography with graded rings."""
     import numpy as np
 
     n = int(sum(region_sizes))
+    r_count = len(region_sizes)
     region = np.zeros(n, np.int32)
     rstart = np.zeros(n, np.int32)
     rsize = np.zeros(n, np.int32)
@@ -100,6 +110,19 @@ def make_topology(region_sizes: list[int], writer_nodes, seed: int = 0) -> Topol
         rstart[off : off + sz] = off
         rsize[off : off + sz] = sz
         off += sz
+    if region_rtt is None:
+        rtt = np.ones((r_count, r_count), np.int32)
+        np.fill_diagonal(rtt, 0)
+    elif isinstance(region_rtt, str) and region_rtt == "geo":
+        # Regions on a circle; ring class grows with arc distance, spanning
+        # the full bucket range like a WAN deployment.
+        d = np.abs(np.arange(r_count)[:, None] - np.arange(r_count)[None, :])
+        d = np.minimum(d, r_count - d)  # circular distance
+        max_d = max(int(d.max()), 1)
+        rtt = np.ceil(d / max_d * 5).astype(np.int32)
+    else:
+        rtt = np.asarray(region_rtt, np.int32)
+        assert rtt.shape == (r_count, r_count)
     writer_nodes = np.asarray(writer_nodes, np.int32)
     won = np.full(n, -1, np.int32)
     won[writer_nodes] = np.arange(len(writer_nodes), dtype=np.int32)
@@ -108,6 +131,7 @@ def make_topology(region_sizes: list[int], writer_nodes, seed: int = 0) -> Topol
         region=jnp.asarray(region),
         region_start=jnp.asarray(rstart),
         region_size=jnp.asarray(rsize),
+        region_rtt=jnp.asarray(rtt),
         writer_nodes=jnp.asarray(writer_nodes),
         writer_of_node=jnp.asarray(won),
         sync_phase=jnp.asarray(phase),
@@ -424,30 +448,45 @@ def sync_round(
         & ~partition[topo.region[:, None], topo.region[cand]]
     )
 
-    # Exact per-candidate need (versions the candidate holds that we lack),
-    # computed one candidate column at a time to keep the transient at
-    # [N, W] instead of [N, C, W].
+    # Candidate need scoring. Exact mode computes, per candidate, the count
+    # of versions the candidate holds that we lack — an [N, W] transient per
+    # candidate, too much HBM at N = W = 10k+ — so large configs use a
+    # total-progress digest instead (sum of watermarks, like ranking peers
+    # by advertised heads). Selection is heuristic either way; the grant
+    # loop below recomputes the exact deficit for the chosen peers.
     c_count = cfg.sync_candidates
+    exact = cfg.n_nodes * cfg.n_writers * c_count <= (1 << 27)
     seen = data.seen
     need_cols = []
+    total = None if exact else jnp.sum(data.contig, axis=1, dtype=jnp.uint32)
     for c in range(c_count):
-        cc = data.contig[cand[:, c]]  # [N, W]
-        need_cols.append(
-            jnp.sum(
-                (cc - jnp.minimum(cc, data.contig)).astype(jnp.uint32),
-                axis=-1,
-                dtype=jnp.int32,
+        if exact:
+            cc = data.contig[cand[:, c]]  # [N, W]
+            need_cols.append(
+                jnp.sum(
+                    (cc - jnp.minimum(cc, data.contig)).astype(jnp.uint32),
+                    axis=-1,
+                    dtype=jnp.int32,
+                )
             )
-        )
-        # Scoring reads the candidate's state — that digest also carries its
-        # heads, so adopt them (the reference learns heads from every
-        # SyncState exchange, not only from peers it pulls from).
-        seen = jnp.maximum(
-            seen, jnp.where(ok_c[:, c, None], data.seen[cand[:, c]], 0)
-        )
+        else:
+            tc = total[cand[:, c]]
+            need_cols.append(
+                jnp.maximum(tc - jnp.minimum(tc, total), 0).astype(jnp.int32)
+            )
+        if exact:
+            # Scoring reads the candidate's state — that digest also carries
+            # its heads, so adopt them (the reference learns heads from every
+            # SyncState exchange, not only from peers it pulls from). In
+            # digest mode this [N, W] gather per candidate is the memory
+            # blowup we are avoiding; selected peers still share heads below.
+            seen = jnp.maximum(
+                seen, jnp.where(ok_c[:, c, None], data.seen[cand[:, c]], 0)
+            )
     defc = jnp.stack(need_cols, axis=1)  # i32[N, C]
 
-    same_region = topo.region[cand] == topo.region[:, None]
+    # RTT ring of each candidate (members.rs:33 buckets via region pairs).
+    ring = topo.region_rtt[topo.region[:, None], topo.region[cand]]
     # Candidates are sampled with replacement; mask duplicate columns so a
     # single peer cannot occupy several of the top slots (and soak up
     # sync_peers x chunk from one source).
@@ -456,8 +495,9 @@ def sync_round(
         dup = dup.at[:, i].set(
             jnp.any(cand[:, :i] == cand[:, i : i + 1], axis=1)
         )
-    # need desc, ring asc: scale need so the ring bonus only breaks ties.
-    score = jnp.where(ok_c & ~dup & (defc > 0), defc * 2 + same_region, -1)
+    # need desc, ring asc (agent.rs:2383-2423): scale need so the ring
+    # ordering only breaks need ties.
+    score = jnp.where(ok_c & ~dup & (defc > 0), defc * 8 + (5 - ring), -1)
     order = jnp.argsort(-score, axis=1, stable=True)[:, : cfg.sync_peers]
     sel = jnp.take_along_axis(cand, order, axis=1)  # i32[N, S]
     sel_ok = jnp.take_along_axis(score, order, axis=1) > 0
@@ -478,6 +518,10 @@ def sync_round(
         ).astype(jnp.uint32)
         contig = contig + grant
         budget_left = budget_left - jnp.sum(grant, axis=1, dtype=jnp.int32)
+        if not exact:
+            seen = jnp.maximum(
+                seen, jnp.where(ok_s[:, None], data.seen[p], 0)
+            )
     seen = jnp.maximum(seen, contig)
 
     cells = data.cells

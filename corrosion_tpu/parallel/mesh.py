@@ -43,6 +43,7 @@ def shard_topology(topo: Topology, mesh: Mesh, axis: str = "nodes") -> Topology:
         region=_put(topo.region, mesh, n),
         region_start=_put(topo.region_start, mesh, n),
         region_size=_put(topo.region_size, mesh, n),
+        region_rtt=_put(topo.region_rtt, mesh, r),
         writer_nodes=_put(topo.writer_nodes, mesh, r),
         writer_of_node=_put(topo.writer_of_node, mesh, n),
         sync_phase=_put(topo.sync_phase, mesh, n),

@@ -169,9 +169,44 @@ def simulate(
     schedule: Schedule,
     seed: int = 0,
     state: ClusterState | None = None,
+    max_chunk: int | None = None,
 ) -> tuple[ClusterState, dict]:
     """Scan `cluster_round` over the schedule. Returns final state + per-round
-    metric curves (numpy arrays of length schedule.rounds)."""
+    metric curves (numpy arrays of length schedule.rounds).
+
+    ``max_chunk`` splits the run into several device executions of at most
+    that many rounds (state carried between them): long single executions
+    can trip device-side watchdogs, and chunking also bounds the stacked
+    curve buffers. Results are identical either way — per-round RNG keys
+    fold in the absolute round index.
+    """
+    if max_chunk is not None and schedule.rounds > max_chunk:
+        cur = state
+        curve_parts: list[dict] = []
+        for start in range(0, schedule.rounds, max_chunk):
+            stop = min(start + max_chunk, schedule.rounds)
+            part = Schedule(
+                writes=schedule.writes[start:stop],
+                kill=None if schedule.kill is None else schedule.kill[start:stop],
+                revive=(
+                    None if schedule.revive is None
+                    else schedule.revive[start:stop]
+                ),
+                partition=(
+                    None if schedule.partition is None
+                    else schedule.partition[start:stop]
+                ),
+                sample_writer=schedule.sample_writer,
+                sample_ver=schedule.sample_ver,
+                sample_round=schedule.sample_round,
+            )
+            cur, curves = simulate(cfg, topo, part, seed=seed, state=cur)
+            curve_parts.append(curves)
+        merged = {
+            k: np.concatenate([p[k] for p in curve_parts])
+            for k in curve_parts[0]
+        }
+        return cur, merged
     n = cfg.n_nodes
     n_regions = int(np.asarray(topo.region).max()) + 1
     has_churn = schedule.kill is not None or schedule.revive is not None
@@ -200,22 +235,41 @@ def simulate(
     s_round = jnp.asarray(schedule.sample_round)
     if state is None:
         state = init_cluster(cfg, len(schedule.sample_writer))
+        offset = 0
+    else:
+        # Continue from the carried round counter so chunked/chained runs
+        # fold distinct per-round RNG keys.
+        offset = int(np.asarray(state.round))
     base_key = jax.random.PRNGKey(seed)
 
-    @jax.jit
-    def body(carry, xs):
-        w, p, kl, rv, r = xs
+    xs = (
+        writes, partition, kill, revive,
+        jnp.arange(offset, offset + rounds, dtype=jnp.int32),
+    )
+    final, curves = _scan_rounds(
+        state, topo, xs, s_writer, s_ver, s_round, base_key, cfg, has_churn
+    )
+    curves = {k: np.asarray(v) for k, v in curves.items()}
+    return final, curves
+
+
+@partial(jax.jit, static_argnames=("cfg", "has_churn"))
+def _scan_rounds(
+    state, topo, xs, s_writer, s_ver, s_round, base_key, cfg, has_churn
+):
+    """Whole-run scan, jitted once per (cfg, shapes): repeat calls — e.g. a
+    timed bench run after a warm-up — hit the compile cache (the seed is a
+    traced argument, not a constant)."""
+
+    def body(carry, x):
+        w, p, kl, rv, r = x
         key = jax.random.fold_in(base_key, r)
-        new_state, stats = cluster_round(
+        return cluster_round(
             carry, topo, w, p, kl, rv, s_writer, s_ver, s_round, key, cfg,
             has_churn,
         )
-        return new_state, stats
 
-    xs = (writes, partition, kill, revive, jnp.arange(rounds, dtype=jnp.int32))
-    final, curves = jax.lax.scan(body, state, xs)
-    curves = {k: np.asarray(v) for k, v in curves.items()}
-    return final, curves
+    return jax.lax.scan(body, state, xs)
 
 
 def visibility_latencies(

@@ -228,8 +228,8 @@ def test_subscription_catch_up_from_change_id(tmp_path):
                 return handle.change_id >= 2
 
             await poll_until(two_changes)
-            # Catch up from change 2 only.
-            sub = await a.client.resubscribe(sub_id, from_change=2)
+            # Exclusive resume: from=1 replays only events after change 1.
+            sub = await a.client.resubscribe(sub_id, from_change=1)
             events = []
             async for ev in sub:
                 events.append(ev)

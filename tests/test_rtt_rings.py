@@ -1,0 +1,78 @@
+"""RTT ring model: ring-aware sync peer ordering (members.rs:33,101-136).
+
+Kernel-side: region_rtt ring classes break need ties toward low-RTT peers.
+Host-side: per-member RTT samples bucket into the reference's ring edges.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu.agent.membership import MemberState, rtt_ring
+from corrosion_tpu.ops import gossip
+
+
+def test_geo_rings_span_buckets():
+    topo = gossip.make_topology([4] * 10, [0], region_rtt="geo")
+    rtt = np.asarray(topo.region_rtt)
+    assert rtt.min() == 0 and rtt.max() == 5
+    assert (np.diag(rtt) == 0).all()
+    assert (rtt == rtt.T).all()
+
+
+def test_sync_prefers_low_ring_peer_on_need_tie():
+    # Ring-0 holders of writer 0 sit in node 0's own region; ring-5 holders
+    # of writer 1 fill regions 1-2. With sync_peers=1 and equal need, the
+    # tie must break toward ring 0 whenever a ring-0 holder is among the
+    # candidates — which the half-ring-0 candidate sampling makes near
+    # certain. (When no ring-0 holder is sampled there is no tie, so the
+    # far peer may legitimately win; hence a margin, not an absolute.)
+    rtt = np.array([[0, 5, 5], [5, 0, 5], [5, 5, 0]], np.int32)
+    cfg = gossip.GossipConfig(
+        n_nodes=9, n_writers=2, fanout_near=0, fanout_far=0,
+        sync_interval=1, sync_budget=4, sync_chunk=4,
+        sync_peers=1, sync_candidates=8,
+    )
+    topo = gossip.make_topology([3, 3, 3], [1, 3], region_rtt=rtt)
+    data = gossip.init_data(cfg)
+    contig = data.contig
+    for holder in (1, 2):  # ring 0 relative to node 0
+        contig = contig.at[holder, 0].set(4)
+    for holder in (3, 4, 5, 6, 7, 8):  # ring 5
+        contig = contig.at[holder, 1].set(4)
+    data = data._replace(
+        head=jnp.array([4, 4], jnp.uint32),
+        contig=contig,
+        seen=jnp.maximum(data.seen, contig),
+    )
+    alive = jnp.ones(9, bool)
+    part = jnp.zeros((3, 3), bool)
+    pulls_near = pulls_far = 0
+    for seed in range(40):
+        d, _ = gossip.sync_round(
+            data, topo, alive, part, jnp.int32(0),
+            jax.random.PRNGKey(seed), cfg,
+        )
+        got_near = int(d.contig[0, 0]) > 0
+        got_far = int(d.contig[0, 1]) > 0
+        pulls_near += got_near
+        pulls_far += got_far and not got_near
+    assert pulls_near >= 35, f"ring-0 should win almost always ({pulls_near})"
+    assert pulls_far <= 3, f"ring-5 must only win when ring 0 unsampled ({pulls_far})"
+
+
+def test_host_rtt_buckets_match_reference_edges():
+    assert rtt_ring(2.0) == 0
+    assert rtt_ring(10.0) == 1
+    assert rtt_ring(20.0) == 2
+    assert rtt_ring(70.0) == 3
+    assert rtt_ring(150.0) == 4
+    assert rtt_ring(250.0) == 5
+    assert rtt_ring(400.0) == 5
+    m = MemberState(actor_id="x", addr=("h", 1))
+    for _ in range(5):
+        m.add_rtt(3.0)
+    assert m.ring == 0
+    for _ in range(30):
+        m.add_rtt(120.0)
+    assert m.ring == 4
