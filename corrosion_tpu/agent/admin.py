@@ -31,7 +31,12 @@ async def start_admin(agent: "Agent", uds_path: str) -> asyncio.AbstractServer:
                 msg = await read_frame(reader)
                 if msg is None:
                     break
-                await _handle(agent, session, msg)
+                try:
+                    await _handle(agent, session, msg)
+                except (ConnectionError, asyncio.CancelledError):
+                    raise
+                except Exception as e:  # command failed: report, stay up
+                    await session.send({"error": str(e), "done": True})
         except (ConnectionError, asyncio.CancelledError):
             pass
         finally:

@@ -51,6 +51,8 @@ async def _read_request(reader: asyncio.StreamReader):
         n = int(headers.get("content-length", 0))
     except ValueError:
         raise HttpError(400, "bad content-length")
+    if n < 0:
+        raise HttpError(400, "bad content-length")
     if n:
         if n > MAX_BODY:
             raise HttpError(413, "body too large")

@@ -148,8 +148,31 @@ async def _handshake(reader, writer) -> None:
         return
 
 
+def _split_statements(sql: str) -> list[str]:
+    """Split on top-level semicolons only — ';' inside '…'/"…" string or
+    identifier literals (with doubled-quote escapes) must not split."""
+    parts: list[str] = []
+    cur: list[str] = []
+    quote: str | None = None
+    for ch in sql:
+        if quote is not None:
+            cur.append(ch)
+            if ch == quote:
+                quote = None  # doubled quotes re-enter on the next char
+        elif ch in ("'", '"'):
+            quote = ch
+            cur.append(ch)
+        elif ch == ";":
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return [p for p in (s.strip() for s in parts) if p]
+
+
 async def _simple_query(agent: "Agent", writer, sql: str) -> None:
-    for part in filter(None, (p.strip() for p in sql.split(";"))):
+    for part in _split_statements(sql):
         translated = translate_pg_sql(part)
         if not translated:
             writer.write(_command_complete("SET"))

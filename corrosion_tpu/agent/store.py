@@ -33,6 +33,7 @@ import sqlite3
 import threading
 from dataclasses import dataclass
 
+from corrosion_tpu import native
 from corrosion_tpu.core.values import (
     Change,
     Statement,
@@ -40,7 +41,7 @@ from corrosion_tpu.core.values import (
     SqliteValue,
     pack_columns,
     unpack_columns,
-    value_cmp_key,
+    value_le,
 )
 
 
@@ -111,6 +112,10 @@ class Store:
         self.conn.execute("PRAGMA synchronous=NORMAL")
         # setup_conn pragmas (corro-types/src/sqlite.rs:107-118)
         self.conn.create_function("corro_pack", -1, _sql_pack, deterministic=True)
+        # Native CRDT helpers (crdt_value_cmp, …) — the cr-sqlite loading
+        # seam (init_cr_conn, corro-types/src/sqlite.rs:87-105). When the
+        # built extension is absent the pure-Python merge path is used.
+        self.native_crdt = native.load_crdt_extension(self.conn)
         self._tables: dict[str, TableInfo] = {}
         self._migrate()
         # Dedicated read connection (the read pool's role): WAL snapshot
@@ -120,6 +125,7 @@ class Store:
         self.read_conn.create_function(
             "corro_pack", -1, _sql_pack, deterministic=True
         )
+        native.load_crdt_extension(self.read_conn)
         self._load_schema()
 
     def close(self) -> None:
@@ -607,8 +613,22 @@ class Store:
             if ch.col_version < local_cv:
                 return False
             if ch.col_version == local_cv:
-                local_val = self._cell_value(c, info, ch.pk, ch.cid)
-                if value_cmp_key(ch.val) <= value_cmp_key(local_val):
+                if self.native_crdt:
+                    # In-DB tie-break: the local value never leaves SQLite.
+                    where = " AND ".join(
+                        f"{_q(k)} = ?" for k in info.pk_cols
+                    )
+                    row = c.execute(
+                        f"SELECT crdt_value_cmp(?, {_q(ch.cid)}) <= 0"
+                        f" FROM {_q(info.name)} WHERE {where}",
+                        (ch.val, *unpack_columns(ch.pk)),
+                    ).fetchone()
+                    # Missing row ⇒ local cell is NULL: only a NULL ties.
+                    lose = bool(row[0]) if row is not None else ch.val is None
+                else:
+                    local_val = self._cell_value(c, info, ch.pk, ch.cid)
+                    lose = value_le(ch.val, local_val)
+                if lose:
                     return False  # we win or tie exactly (idempotent)
         self._ensure_row(c, info, ch.pk)
         c.execute(

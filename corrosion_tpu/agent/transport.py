@@ -10,9 +10,13 @@ stdlib has no QUIC, so the host agent uses TCP with the same plane split:
 - a request/stream exchange for sync sessions (open_session), the bi-stream
   analogue of peer.rs:925-1527.
 
-Frames are 4-byte big-endian length + JSON; bytes values are encoded as
-{"$b": hex}. Wire-type shapes mirror corro-types/src/broadcast.rs
-(UniPayload/BiPayload) without the speedy binary layout.
+Frames are 4-byte big-endian length + a kind byte + body. Kind 1 is the
+compact binary codec (the speedy-encoding role of
+corro-types/src/broadcast.rs), encoded by the native runtime
+(corrosion_tpu/_native) when built; kind 0 is JSON with bytes values as
+{"$b": hex}, the encode fallback without a C toolchain. Decoding accepts
+both kinds on every peer — a pure-Python binary decoder below keeps mixed
+native/non-native clusters fully interoperable.
 """
 
 from __future__ import annotations
@@ -22,7 +26,12 @@ import json
 import struct
 from typing import Any, Callable, Awaitable
 
+from corrosion_tpu import native as _native
+
 MAX_FRAME = 32 * 1024 * 1024
+
+FRAME_JSON = 0
+FRAME_BIN = 1
 
 
 def encode_value(o: Any) -> Any:
@@ -46,8 +55,94 @@ def decode_value(o: Any) -> Any:
 
 
 def encode_frame(msg: dict) -> bytes:
-    body = json.dumps(encode_value(msg), separators=(",", ":")).encode()
+    if _native.native is not None:
+        body = bytes([FRAME_BIN]) + _native.native.encode(msg)
+    else:
+        body = bytes([FRAME_JSON]) + json.dumps(
+            encode_value(msg), separators=(",", ":")
+        ).encode()
     return struct.pack(">I", len(body)) + body
+
+
+# Binary wire tags (native/corro_native.c W_*; keep in sync).
+_W_NULL, _W_FALSE, _W_TRUE, _W_INT = 0, 1, 2, 3
+_W_FLOAT, _W_STR, _W_BYTES, _W_LIST, _W_DICT = 4, 5, 6, 7, 8
+
+
+def _py_read_varint(b: bytes, i: int) -> tuple[int, int]:
+    n = shift = 0
+    while True:
+        if i >= len(b) or shift > 63:
+            raise ValueError("truncated wire varint")
+        byte = b[i]
+        i += 1
+        n |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return n, i
+        shift += 7
+
+
+def _py_wire_decode(b: bytes, i: int = 0, depth: int = 0) -> tuple[Any, int]:
+    """Pure-Python decoder for the binary wire format (parity with the C
+    decoder; used when the native module is not built)."""
+    if depth > 64 or i >= len(b):
+        raise ValueError("bad wire value")
+    tag = b[i]
+    i += 1
+    if tag == _W_NULL:
+        return None, i
+    if tag == _W_FALSE:
+        return False, i
+    if tag == _W_TRUE:
+        return True, i
+    if tag == _W_INT:
+        z, i = _py_read_varint(b, i)
+        return (z >> 1) ^ -(z & 1), i
+    if tag == _W_FLOAT:
+        if i + 8 > len(b):
+            raise ValueError("truncated wire float")
+        return struct.unpack_from(">d", b, i)[0], i + 8
+    if tag in (_W_STR, _W_BYTES):
+        n, i = _py_read_varint(b, i)
+        if i + n > len(b):
+            raise ValueError("truncated wire string")
+        raw = b[i : i + n]
+        return (raw.decode("utf-8") if tag == _W_STR else raw), i + n
+    if tag == _W_LIST:
+        n, i = _py_read_varint(b, i)
+        out = []
+        for _ in range(n):
+            v, i = _py_wire_decode(b, i, depth + 1)
+            out.append(v)
+        return out, i
+    if tag == _W_DICT:
+        n, i = _py_read_varint(b, i)
+        d: dict = {}
+        for _ in range(n):
+            kn, i = _py_read_varint(b, i)
+            if i + kn > len(b):
+                raise ValueError("truncated wire key")
+            key = b[i : i + kn].decode("utf-8")
+            i += kn
+            d[key], i = _py_wire_decode(b, i, depth + 1)
+        return d, i
+    raise ValueError(f"bad wire tag {tag}")
+
+
+def decode_frame_body(body: bytes) -> dict:
+    if not body:
+        raise ValueError("empty frame")
+    kind, payload = body[0], body[1:]
+    if kind == FRAME_BIN:
+        if _native.native is not None:
+            return _native.native.decode(payload)
+        obj, end = _py_wire_decode(payload)
+        if end != len(payload):
+            raise ValueError("trailing bytes after wire value")
+        return obj
+    if kind == FRAME_JSON:
+        return decode_value(json.loads(payload))
+    raise ValueError(f"unknown frame kind {kind}")
 
 
 async def read_frame(reader: asyncio.StreamReader) -> dict | None:
@@ -62,7 +157,7 @@ async def read_frame(reader: asyncio.StreamReader) -> dict | None:
         body = await reader.readexactly(n)
     except (asyncio.IncompleteReadError, ConnectionError):
         return None
-    return decode_value(json.loads(body))
+    return decode_frame_body(body)
 
 
 class Transport:
@@ -143,6 +238,8 @@ class Transport:
                     await handler(session, msg)
             except (ConnectionError, asyncio.CancelledError):
                 pass
+            except ValueError:
+                pass  # malformed frame: drop the connection cleanly
             finally:
                 session.close()
 

@@ -196,7 +196,12 @@ async def _exec(args, cfg: Config) -> int:
 async def _admin(cfg: Config, command: dict) -> list[dict]:
     from corrosion_tpu.agent.admin import AdminClient
 
-    return await AdminClient(cfg.admin.uds_path).call(command)
+    frames = await AdminClient(cfg.admin.uds_path).call(command)
+    if not frames:
+        raise SystemExit("admin: connection closed without a response")
+    if "error" in frames[0]:
+        raise SystemExit(f"admin: {frames[0]['error']}")
+    return frames
 
 
 if __name__ == "__main__":

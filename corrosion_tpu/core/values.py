@@ -68,13 +68,7 @@ def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
         shift += 7
 
 
-def pack_columns(values: Iterable[SqliteValue]) -> bytes:
-    """Serialize a tuple of SQL values into one blob (PK encoding).
-
-    Deterministic: equal tuples produce equal blobs, so blobs are usable as
-    dictionary keys and DB-stored primary-key identities, like the packed pk
-    column in the reference (pubsub.rs:2115+).
-    """
+def _py_pack_columns(values: Iterable[SqliteValue]) -> bytes:
     out = bytearray()
     for v in values:
         tag = _tag(v)
@@ -95,7 +89,7 @@ def pack_columns(values: Iterable[SqliteValue]) -> bytes:
     return bytes(out)
 
 
-def unpack_columns(blob: bytes) -> tuple[SqliteValue, ...]:
+def _py_unpack_columns(blob: bytes) -> tuple[SqliteValue, ...]:
     values: list[SqliteValue] = []
     i = 0
     while i < len(blob):
@@ -123,6 +117,40 @@ def unpack_columns(blob: bytes) -> tuple[SqliteValue, ...]:
         else:
             raise MalformedBlobError(f"bad column tag {tag} at offset {i-1}")
     return tuple(values)
+
+
+# Native fast path (corrosion_tpu/_native, built from native/): byte-exact
+# with the Python codec above; MalformedError translates to
+# MalformedBlobError so callers see one exception type.
+from corrosion_tpu import native as _native_mod  # noqa: E402
+
+
+def pack_columns(values: Iterable[SqliteValue]) -> bytes:
+    """Serialize a tuple of SQL values into one blob (PK encoding).
+
+    Deterministic: equal tuples produce equal blobs, so blobs are usable as
+    dictionary keys and DB-stored primary-key identities, like the packed pk
+    column in the reference (pubsub.rs:2115+).
+    """
+    if _native_mod.native is not None:
+        return _native_mod.native.pack_columns(values)
+    return _py_pack_columns(values)
+
+
+def unpack_columns(blob: bytes) -> tuple[SqliteValue, ...]:
+    if _native_mod.native is not None:
+        try:
+            return _native_mod.native.unpack_columns(blob)
+        except _native_mod.native.MalformedError as e:
+            raise MalformedBlobError(str(e)) from None
+    return _py_unpack_columns(blob)
+
+
+def value_le(a: SqliteValue, b: SqliteValue) -> bool:
+    """a <= b under the LWW total order (native when built)."""
+    if _native_mod.native is not None:
+        return _native_mod.native.value_cmp(a, b) <= 0
+    return value_cmp_key(a) <= value_cmp_key(b)
 
 
 def value_cmp_key(v: SqliteValue) -> tuple[int, Any]:

@@ -194,11 +194,14 @@ async def run_consul_sync(cfg: Config, iterations: int | None = None) -> None:
         try:
             services = await consul.agent_services()
             checks = await consul.agent_checks()
-            stmts, known_services, known_checks = diff_statements(
+            stmts, new_services, new_checks = diff_statements(
                 node, services, checks, known_services, known_checks
             )
             if stmts:
                 await client.execute(stmts)
-        except (OSError, RuntimeError):
-            pass  # consul unreachable: retry next tick
+            # Adopt the hash state only after the corrosion write succeeded;
+            # a failed tick must re-diff (and re-send) next tick.
+            known_services, known_checks = new_services, new_checks
+        except Exception:
+            pass  # consul/corrosion unreachable or rejected: retry next tick
         await asyncio.sleep(cfg.consul.interval_ms / 1000.0)

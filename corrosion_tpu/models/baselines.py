@@ -23,7 +23,7 @@ def _max_tx(n: int) -> int:
 
 
 def _cfg(
-    n, writers, regions=None, region_rtt=None, **gossip_kw
+    n, writers, regions=None, region_rtt=None, swim_kw=None, **gossip_kw
 ) -> tuple[ClusterConfig, object]:
     regions = regions or [n]
     g = GossipConfig(
@@ -37,6 +37,7 @@ def _cfg(
         max_transmissions=_max_tx(n),
         suspect_rounds=3,
         gossip_fanout=3,
+        **(swim_kw or {}),
     )
     topo = make_topology(regions, writers, region_rtt=region_rtt)
     return ClusterConfig(swim=s, gossip=g), topo
@@ -176,6 +177,9 @@ def wan_100k(n: int = 100_000, n_regions: int = 20, n_writers: int = 512,
         fanout_near=2,
         fanout_far=1,
         n_cells=256,
+        # Dense SWIM is u32[N, N] = 40 GB at 100k nodes; the sparse
+        # exception-table kernel is ~0.5 KiB/node (ops/swim_sparse.py).
+        swim_kw={"view_capacity": 64},
     )
     writes = (rng.random((rounds, n_writers)) < 0.05).astype(np.uint32)
     writes[rounds - 80 :, :] = 0

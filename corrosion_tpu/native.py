@@ -1,0 +1,97 @@
+"""Loader for the native runtime components (native/ C sources).
+
+Two artifacts, both optional at runtime (pure-Python fallbacks exist
+everywhere they are used):
+
+- ``_corro_native`` — CPython extension: packed-PK codec, exact SQLite
+  value ordering, and the compact binary wire codec (the reference's
+  speedy encoding role, corro-types/src/broadcast.rs).
+- ``crdt_ext.so`` — SQLite run-time loadable extension with the CRDT SQL
+  helpers (``crdt_value_cmp`` et al.); the analogue of the reference
+  loading cr-sqlite into every connection (corro-types/src/sqlite.rs:87-105).
+
+``build()`` compiles both from source with the in-image toolchain; tests
+and the CLI call it so a fresh checkout self-builds without any package
+installation.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import subprocess
+import sys
+from types import ModuleType
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "_native")
+_REPO_NATIVE_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)), "native"
+)
+
+CRDT_EXT_PATH = os.path.join(_NATIVE_DIR, "crdt_ext")
+
+
+def _import_native() -> ModuleType | None:
+    if _NATIVE_DIR not in sys.path and os.path.isdir(_NATIVE_DIR):
+        sys.path.insert(0, _NATIVE_DIR)
+    try:
+        import _corro_native  # type: ignore[import-not-found]
+
+        return _corro_native
+    except ImportError:
+        return None
+
+
+native = _import_native()
+
+
+def available() -> bool:
+    """True when the CPython codec module is importable."""
+    return native is not None
+
+
+def crdt_ext_available() -> bool:
+    return os.path.exists(CRDT_EXT_PATH + ".so")
+
+
+def load_crdt_extension(conn: sqlite3.Connection) -> bool:
+    """Load the CRDT SQL helpers into a connection; False if unavailable.
+
+    Mirrors init_cr_conn (corro-types/src/sqlite.rs:87-105): every Store
+    connection gets the extension when the artifact exists.
+    """
+    if not crdt_ext_available():
+        return False
+    try:
+        conn.enable_load_extension(True)
+        try:
+            conn.load_extension(CRDT_EXT_PATH)
+        finally:
+            conn.enable_load_extension(False)
+        return True
+    except sqlite3.OperationalError:
+        return False
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile the native artifacts in-tree. Returns success. Safe to call
+    repeatedly (make is incremental); never raises on a missing toolchain."""
+    global native
+    if not os.path.isdir(_REPO_NATIVE_SRC):
+        return False
+    try:
+        proc = subprocess.run(
+            ["make", "-C", _REPO_NATIVE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        if not quiet:
+            sys.stderr.write(proc.stdout + proc.stderr)
+        return False
+    if native is None:
+        native = _import_native()
+    return True

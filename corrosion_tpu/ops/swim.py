@@ -67,6 +67,28 @@ class SwimConfig:
     timers: int = 8  # own-suspicion timer slots
     probe_tries: int = 4  # rejection-sampling tries for probe target
     loss_prob: float = 0.0  # modeled probe/ack loss
+    # > 0 selects the sparse exception-table kernel (ops/swim_sparse.py)
+    # with K = view_capacity belief slots per node; 0 = dense u32[N, N] view.
+    view_capacity: int = 0
+    # sparse kernel: gossiped view-merge messages absorbed per node per
+    # round (0 = gossip_fanout * backlog, the expected arrival rate).
+    view_intake: int = 0
+
+
+def impl(cfg: SwimConfig):
+    """Kernel module for this config: dense view or sparse exception tables.
+
+    Both expose the same surface (init_state / swim_round / apply_churn /
+    mismatches / accuracy) over their own state type; callers dispatch once
+    per static config.
+    """
+    if cfg.view_capacity > 0:
+        from corrosion_tpu.ops import swim_sparse
+
+        return swim_sparse
+    import corrosion_tpu.ops.swim as dense
+
+    return dense
 
 
 class SwimState(NamedTuple):

@@ -1,0 +1,438 @@
+"""Scalable SWIM membership kernel — bounded exception tables, O(N·K) state.
+
+The dense kernel (`ops/swim.py`) keeps every node's full belief row — a
+packed u32[N, N] view. That is the honest analogue of foca's member list
+(every real SWIM node does track every peer), but it caps the simulator at
+~30k virtual nodes: at N=100k the view alone is 40 GB, far past a single
+chip's HBM (SURVEY.md §6's north star is 100k nodes).
+
+The sparse kernel exploits the belief lattice's shape instead. A belief is
+the packed ``inc << 2 | severity`` of the dense kernel, merged by ``max``,
+and every pair starts at the baseline ``alive @ inc 0`` (= 0). Beliefs only
+ever *rise* above the baseline for nodes that were suspected, declared down,
+or refuted — i.e. nodes touched by churn, a bounded set in any real cluster.
+So each node stores only its *exceptions*: up to K (target, packed) entries
+that differ from the baseline; everything absent is alive@inc0. State drops
+to O(N·K): at N=100k, K=64 the tables are 51 MB (≈ 0.5 KiB/node).
+
+Semantics match the dense kernel merge-for-merge: probes, suspect→down
+timers, bounded piggyback dissemination, refutation, and identity renewal
+are the same code shape, with each scatter-max replaced by a sequential scan
+of single-entry table merges (`_merge_one`) so intra-round read-after-write
+ordering is preserved. Two deliberate deviations, both bounded-resource
+drops a real deployment also makes:
+
+- **View intake cap**: a node absorbs at most ``view_intake`` gossiped
+  entries per round (excess datagrams drop, like UDP under burst).
+- **Eviction**: when a table is full, the entry closest to the baseline
+  (lowest severity, then lowest incarnation) is evicted — forgetting an
+  *alive* exception is harmless (belief falls back to alive@inc0); suspect/
+  down beliefs are kept in preference. foca's bounded updates backlog makes
+  the same freshness-over-completeness trade for dissemination.
+
+Reference map: foca runtime loop corro-agent/src/broadcast/mod.rs:116-568,
+WAN config mod.rs:704-713, identity renewal corro-types/src/actor.rs:169-194.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.ops import routing
+from corrosion_tpu.ops.swim import (
+    SEV_ALIVE,
+    SEV_DOWN,
+    SEV_SUSPECT,
+    SwimConfig,
+    pack,
+    packed_inc,
+    packed_sev,
+)
+
+
+class SparseSwimState(NamedTuple):
+    exc_tgt: jax.Array  # i32[N, K] exception target (-1 = empty slot)
+    exc_pkd: jax.Array  # u32[N, K] packed belief (> baseline 0)
+    incarnation: jax.Array  # u32[N] own incarnation
+    alive: jax.Array  # bool[N] ground-truth process liveness (churn input)
+    # own suspect→down timers
+    susp_target: jax.Array  # i32[N, S] (-1 = empty)
+    susp_inc: jax.Array  # u32[N, S]
+    susp_started: jax.Array  # i32[N, S]
+    # updates backlog (piggyback dissemination queue)
+    upd_target: jax.Array  # i32[N, U] (-1 = empty)
+    upd_packed: jax.Array  # u32[N, U]
+    upd_tx: jax.Array  # i32[N, U] transmissions left
+
+
+def init_state(cfg: SwimConfig) -> SparseSwimState:
+    n, s, u = cfg.n_nodes, cfg.timers, cfg.backlog
+    k = cfg.view_capacity
+    if k <= 0:
+        raise ValueError("sparse kernel needs SwimConfig.view_capacity > 0")
+    return SparseSwimState(
+        exc_tgt=jnp.full((n, k), -1, dtype=jnp.int32),
+        exc_pkd=jnp.zeros((n, k), dtype=jnp.uint32),
+        incarnation=jnp.zeros((n,), dtype=jnp.uint32),
+        alive=jnp.ones((n,), dtype=bool),
+        susp_target=jnp.full((n, s), -1, dtype=jnp.int32),
+        susp_inc=jnp.zeros((n, s), dtype=jnp.uint32),
+        susp_started=jnp.zeros((n, s), dtype=jnp.int32),
+        upd_target=jnp.full((n, u), -1, dtype=jnp.int32),
+        upd_packed=jnp.zeros((n, u), dtype=jnp.uint32),
+        upd_tx=jnp.zeros((n, u), dtype=jnp.int32),
+    )
+
+
+def state_bytes_per_node(cfg: SwimConfig) -> int:
+    """Membership-plane memory budget per virtual node (the 100k plan)."""
+    k, s, u = cfg.view_capacity, cfg.timers, cfg.backlog
+    return 8 * k + 4 + 1 + 12 * s + 12 * u
+
+
+def _lookup(exc_tgt: jax.Array, exc_pkd: jax.Array, tgt: jax.Array) -> jax.Array:
+    """Belief each row holds about its (per-row) target; baseline 0."""
+    hit = exc_tgt == tgt[:, None]
+    return jnp.max(jnp.where(hit, exc_pkd, 0), axis=1)
+
+
+def _evict_score(pkd: jax.Array) -> jax.Array:
+    """Keep-priority: severity first, then incarnation (evict the minimum).
+
+    Forgetting an alive@inc exception only resets the pair to the baseline
+    (still believed up); suspect/down beliefs are the ones that must survive.
+    """
+    inc = jnp.minimum(packed_inc(pkd), jnp.uint32(2**27 - 1)).astype(jnp.int32)
+    return (packed_sev(pkd).astype(jnp.int32) << 27) | inc
+
+
+def _merge_one(
+    exc_tgt: jax.Array,
+    exc_pkd: jax.Array,
+    tgt: jax.Array,  # i32[N] per-row target
+    pkd: jax.Array,  # u32[N]
+    valid: jax.Array,  # bool[N]
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Merge one (target, packed) belief into each row's table.
+
+    Returns (exc_tgt, exc_pkd, raised[N]) where ``raised`` is True iff the
+    merge strictly raised the row's belief about the target — the dense
+    kernel's ``packed > view[row, tgt]`` change test.
+    """
+    n, _k = exc_tgt.shape
+    rows = jnp.arange(n)
+    old = _lookup(exc_tgt, exc_pkd, tgt)
+    raised = valid & (pkd > old)
+
+    hit = (exc_tgt == tgt[:, None]) & raised[:, None]
+    any_hit = hit.any(axis=1)
+    exc_pkd = jnp.where(hit, jnp.maximum(exc_pkd, pkd[:, None]), exc_pkd)
+
+    # Insert path: no existing slot for this target. Choose the slot with the
+    # lowest keep-priority (empty slots first), evict only if strictly lower
+    # priority than the incoming entry.
+    ins = raised & ~any_hit & (pkd > 0)
+    score = jnp.where(exc_tgt < 0, jnp.int32(-1), _evict_score(exc_pkd))
+    slot = jnp.argmin(score, axis=1)
+    slot_score = score[rows, slot]
+    ok = ins & (slot_score < _evict_score(pkd))
+    exc_tgt = exc_tgt.at[rows, slot].set(
+        jnp.where(ok, tgt, exc_tgt[rows, slot])
+    )
+    exc_pkd = exc_pkd.at[rows, slot].set(
+        jnp.where(ok, pkd, exc_pkd[rows, slot])
+    )
+    # A raise that found no slot (table full of higher-priority entries) is
+    # dropped — report it as not-raised so it is not re-gossiped as applied.
+    raised = raised & (any_hit | ~ins | ok)
+    return exc_tgt, exc_pkd, raised
+
+
+def _merge_scan(
+    exc_tgt: jax.Array,
+    exc_pkd: jax.Array,
+    tgts: jax.Array,  # i32[N, C] per-row targets, column-sequential
+    pkds: jax.Array,  # u32[N, C]
+    valids: jax.Array,  # bool[N, C]
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Sequentially merge C columns of per-row entries; returns raised[N, C]."""
+
+    def body(carry, col):
+        et, ep = carry
+        t, p, v = col
+        et, ep, raised = _merge_one(et, ep, t, p, v)
+        return (et, ep), raised
+
+    (exc_tgt, exc_pkd), raised = jax.lax.scan(
+        body,
+        (exc_tgt, exc_pkd),
+        (tgts.T, pkds.T, valids.T),
+    )
+    return exc_tgt, exc_pkd, raised.T
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def swim_round(
+    state: SparseSwimState, rng: jax.Array, round_idx: jax.Array, cfg: SwimConfig
+) -> SparseSwimState:
+    """One bulk-synchronous SWIM protocol period for all N nodes."""
+    n = cfg.n_nodes
+    nodes = jnp.arange(n)
+    k_probe, k_loss, k_goss = jax.random.split(rng, 3)
+    exc_tgt, exc_pkd = state.exc_tgt, state.exc_pkd
+    alive = state.alive
+    inc_self = state.incarnation
+
+    cand_tgt = []
+    cand_pkd = []
+    cand_tx = []
+    cand_ok = []
+
+    # ---- 1. probe ----------------------------------------------------------
+    tries = jax.random.randint(k_probe, (cfg.probe_tries, n), 0, n)
+
+    def pick(carry, t):
+        chosen = carry
+        sev_t = packed_sev(_lookup(exc_tgt, exc_pkd, t))
+        ok = (t != nodes) & (sev_t < SEV_DOWN) & (chosen < 0)
+        return jnp.where(ok, t, chosen), None
+
+    probe_tgt, _ = jax.lax.scan(pick, jnp.full((n,), -1, jnp.int32), tries)
+    has_probe = (probe_tgt >= 0) & alive
+    pt = jnp.maximum(probe_tgt, 0)
+    lost = jax.random.uniform(k_loss, (n,)) < cfg.loss_prob
+    ack = has_probe & alive[pt] & ~lost
+    ack_pkd = pack(inc_self[pt], SEV_ALIVE)
+    known = _lookup(exc_tgt, exc_pkd, pt)
+    susp_pkd = pack(packed_inc(known), SEV_SUSPECT)
+    probe_pkd = jnp.where(ack, ack_pkd, susp_pkd)
+    exc_tgt, exc_pkd, probe_new = _merge_one(
+        exc_tgt, exc_pkd, pt, probe_pkd, has_probe
+    )
+    cand_tgt.append(pt[:, None])
+    cand_pkd.append(probe_pkd[:, None])
+    cand_tx.append(jnp.full((n, 1), cfg.max_transmissions, jnp.int32))
+    cand_ok.append(probe_new[:, None])
+
+    # New suspicion → start a timer in a free/oldest slot.
+    new_susp = has_probe & ~ack & probe_new
+    slot_empty = state.susp_target < 0
+    slot_score = jnp.where(slot_empty, -(2**30), state.susp_started)
+    slot = jnp.argmin(slot_score, axis=1)
+    susp_target = state.susp_target.at[nodes, slot].set(
+        jnp.where(new_susp, pt, state.susp_target[nodes, slot])
+    )
+    susp_inc = state.susp_inc.at[nodes, slot].set(
+        jnp.where(new_susp, packed_inc(known), state.susp_inc[nodes, slot])
+    )
+    susp_started = state.susp_started.at[nodes, slot].set(
+        jnp.where(new_susp, round_idx, state.susp_started[nodes, slot])
+    )
+
+    # ---- 2. suspect→down timer expiry --------------------------------------
+    active = susp_target >= 0
+    expired = active & (round_idx - susp_started >= cfg.suspect_rounds)
+    exp_tgt = jnp.maximum(susp_target, 0)
+    down_pkd = pack(susp_inc, SEV_DOWN)
+    fire = expired & alive[:, None]
+    # `_merge_one` itself enforces the dense kernel's "only if we still
+    # believe suspect at that incarnation" check: the merge is a no-op unless
+    # down_pkd exceeds the current belief.
+    exc_tgt, exc_pkd, fired = _merge_scan(
+        exc_tgt, exc_pkd, exp_tgt, down_pkd, fire
+    )
+    cand_tgt.append(exp_tgt)
+    cand_pkd.append(down_pkd)
+    cand_tx.append(jnp.full(exp_tgt.shape, cfg.max_transmissions, jnp.int32))
+    cand_ok.append(fired)
+    susp_target = jnp.where(expired, -1, susp_target)
+
+    # ---- 3. gossip dissemination (bounded piggyback) -----------------------
+    sendable = (state.upd_target >= 0) & (state.upd_tx > 0) & alive[:, None]
+    g_tgts = jax.random.randint(k_goss, (n, cfg.gossip_fanout), 0, n)
+    recv = jnp.repeat(g_tgts[:, :, None], cfg.backlog, axis=2)  # [N, G, U]
+    tgt = jnp.broadcast_to(state.upd_target[:, None, :], recv.shape)
+    pkd = jnp.broadcast_to(state.upd_packed[:, None, :], recv.shape)
+    ok = (
+        jnp.broadcast_to(sendable[:, None, :], recv.shape)
+        & (recv != jnp.arange(n)[:, None, None])  # not to self
+        & alive[recv]  # dead receivers drop datagrams
+    )
+    upd_tx = jnp.where(sendable, state.upd_tx - 1, state.upd_tx)
+
+    # Bounded receiver intake (the cap is the sparse kernel's datagram-drop
+    # deviation; see module docstring), then a sequential merge scan that
+    # doubles as the per-message change test.
+    r_view = cfg.view_intake if cfg.view_intake > 0 else (
+        cfg.gossip_fanout * cfg.backlog
+    )
+    in_mask, (in_tgt, in_pkd) = routing.bounded_intake(
+        recv.reshape(-1),
+        ok.reshape(-1),
+        (jnp.maximum(tgt, 0).reshape(-1), pkd.reshape(-1)),
+        n,
+        r_view,
+    )
+    exc_tgt, exc_pkd, raised = _merge_scan(
+        exc_tgt, exc_pkd, in_tgt, in_pkd, in_mask
+    )
+
+    # Raised entries re-enter the receiver's backlog (bounded re-gossip
+    # intake, same cap as the dense kernel).
+    r_bk = cfg.gossip_fanout * 2
+    keep, (bk_tgt, bk_pkd) = routing.rebuild_bounded_queue(
+        raised, jnp.ones_like(in_tgt), (in_tgt, in_pkd), r_bk
+    )
+    cand_tgt.append(jnp.where(keep, bk_tgt, -1))
+    cand_pkd.append(bk_pkd)
+    cand_tx.append(jnp.full((n, r_bk), cfg.max_transmissions, jnp.int32))
+    cand_ok.append(keep)
+
+    # ---- 4. refutation -----------------------------------------------------
+    self_belief = _lookup(exc_tgt, exc_pkd, nodes)
+    refute = alive & (packed_sev(self_belief) >= SEV_SUSPECT) & (
+        packed_inc(self_belief) >= inc_self
+    )
+    new_inc = jnp.where(refute, packed_inc(self_belief) + 1, inc_self)
+    refute_pkd = pack(new_inc, SEV_ALIVE)
+    exc_tgt, exc_pkd, _ = _merge_one(
+        exc_tgt, exc_pkd, nodes.astype(jnp.int32), refute_pkd, refute
+    )
+    cand_tgt.append(nodes[:, None].astype(jnp.int32))
+    cand_pkd.append(refute_pkd[:, None])
+    cand_tx.append(jnp.full((n, 1), cfg.max_transmissions, jnp.int32))
+    cand_ok.append(refute[:, None])
+
+    # ---- 5. rebuild backlog by priority ------------------------------------
+    cand_tgt.append(state.upd_target)
+    cand_pkd.append(state.upd_packed)
+    cand_tx.append(upd_tx)
+    cand_ok.append((state.upd_target >= 0) & (upd_tx > 0))
+
+    ct = jnp.concatenate(cand_tgt, axis=1)
+    cp = jnp.concatenate(cand_pkd, axis=1)
+    cx = jnp.concatenate(cand_tx, axis=1)
+    co = jnp.concatenate(cand_ok, axis=1)
+    keep, (upd_target, upd_packed, upd_tx2) = routing.rebuild_bounded_queue(
+        co, cx, (ct, cp, cx), cfg.backlog
+    )
+    upd_target = jnp.where(keep, upd_target, -1)
+
+    return SparseSwimState(
+        exc_tgt=exc_tgt,
+        exc_pkd=exc_pkd,
+        incarnation=new_inc,
+        alive=alive,
+        susp_target=susp_target,
+        susp_inc=susp_inc,
+        susp_started=susp_started,
+        upd_target=upd_target,
+        upd_packed=upd_packed,
+        upd_tx=upd_tx2,
+    )
+
+
+def apply_churn(
+    state: SparseSwimState,
+    kill: jax.Array,
+    revive: jax.Array,
+    rng: jax.Array | None = None,
+    max_transmissions: int = 6,
+) -> SparseSwimState:
+    """Ground-truth churn between rounds (identity renewal on revive).
+
+    Mirrors the dense kernel: a revived node bumps its incarnation, repairs
+    its self-belief, queues a self-announce, and — when ``rng`` is given —
+    bootstrap-pulls one random alive peer's exception table (the member-list
+    transfer a SWIM announce gets from its seed).
+    """
+    alive = (state.alive & ~kill) | revive
+    inc = jnp.where(revive, state.incarnation + 1, state.incarnation)
+    n = state.exc_tgt.shape[0]
+    nodes = jnp.arange(n)
+    self_pkd = pack(inc, SEV_ALIVE)
+    exc_tgt, exc_pkd, _ = _merge_one(
+        state.exc_tgt, state.exc_pkd, nodes.astype(jnp.int32), self_pkd, revive
+    )
+    if rng is not None:
+        cand = jax.random.randint(rng, (4, n), 0, n)
+
+        def pick(carry, t):
+            ok = alive[t] & ~revive[t] & (carry < 0)
+            return jnp.where(ok, t, carry), None
+
+        seed, _ = jax.lax.scan(pick, jnp.full((n,), -1, jnp.int32), cand)
+        seed = jnp.where(seed < 0, nodes, seed)
+        pull_ok = revive & (seed != nodes)
+        exc_tgt, exc_pkd, _ = _merge_scan(
+            exc_tgt,
+            exc_pkd,
+            exc_tgt[seed],
+            exc_pkd[seed],
+            pull_ok[:, None] & (exc_tgt[seed] >= 0),
+        )
+    last = state.upd_target.shape[1] - 1
+    upd_target = state.upd_target.at[:, last].set(
+        jnp.where(revive, nodes.astype(jnp.int32), state.upd_target[:, last])
+    )
+    upd_packed = state.upd_packed.at[:, last].set(
+        jnp.where(revive, self_pkd, state.upd_packed[:, last])
+    )
+    upd_tx = state.upd_tx.at[:, last].set(
+        jnp.where(revive, max_transmissions, state.upd_tx[:, last])
+    )
+    return state._replace(
+        alive=alive,
+        incarnation=inc,
+        exc_tgt=exc_tgt,
+        exc_pkd=exc_pkd,
+        upd_target=upd_target,
+        upd_packed=upd_packed,
+        upd_tx=upd_tx,
+    )
+
+
+def mismatches(state: SparseSwimState) -> jax.Array:
+    """Exact count of (live observer, peer) beliefs contradicting truth.
+
+    Computed without materializing an N×N view: pairs with no exception
+    entry are believed up (the baseline), so they mismatch exactly when the
+    target is dead; exception entries then correct that default per entry
+    (each row has at most one entry per target, a `_merge_one` invariant).
+    """
+    n = state.exc_tgt.shape[0]
+    alive = state.alive
+    alive_count = jnp.sum(alive)
+    dead_count = n - alive_count
+    default_mis = alive_count * dead_count  # i alive, j dead ⇒ i != j
+
+    ent_valid = (
+        (state.exc_tgt >= 0)
+        & alive[:, None]
+        & (state.exc_tgt != jnp.arange(n)[:, None])  # self-pairs excluded
+    )
+    t = jnp.maximum(state.exc_tgt, 0)
+    believed_up = packed_sev(state.exc_pkd) < SEV_DOWN
+    truth = alive[t]
+    ent_mis = jnp.sum(ent_valid & (believed_up != truth))
+    ent_default_mis = jnp.sum(ent_valid & ~truth)
+    return default_mis + ent_mis - ent_default_mis
+
+
+def beliefs_about(state: SparseSwimState, target: int) -> jax.Array:
+    """packed[N]: every node's belief about one target (tests/diagnostics)."""
+    n = state.exc_tgt.shape[0]
+    return _lookup(
+        state.exc_tgt, state.exc_pkd, jnp.full((n,), target, jnp.int32)
+    )
+
+
+def accuracy(state: SparseSwimState) -> jax.Array:
+    """Approximate fraction of correct beliefs (see dense kernel caveat)."""
+    n = state.exc_tgt.shape[0]
+    total = jnp.maximum(jnp.sum(state.alive) * (n - 1), 1)
+    return 1.0 - mismatches(state) / total

@@ -21,7 +21,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from corrosion_tpu.ops.gossip import DataState, Topology
-from corrosion_tpu.ops.swim import SwimState
 from corrosion_tpu.sim.engine import ClusterState
 
 
@@ -56,17 +55,11 @@ def shard_cluster_state(
     row = P(axis, None)
     vec = P(axis)
     rep = P()
-    sw: SwimState = state.swim
-    sw = SwimState(
-        view=_put(sw.view, mesh, row),
-        incarnation=_put(sw.incarnation, mesh, vec),
-        alive=_put(sw.alive, mesh, vec),
-        susp_target=_put(sw.susp_target, mesh, row),
-        susp_inc=_put(sw.susp_inc, mesh, row),
-        susp_started=_put(sw.susp_started, mesh, row),
-        upd_target=_put(sw.upd_target, mesh, row),
-        upd_packed=_put(sw.upd_packed, mesh, row),
-        upd_tx=_put(sw.upd_tx, mesh, row),
+    # Every SWIM-plane field (dense SwimState or SparseSwimState) is
+    # node-major: shard the leading axis, replicate the rest.
+    sw = jax.tree.map(
+        lambda x: _put(x, mesh, P(axis, *([None] * (x.ndim - 1)))),
+        state.swim,
     )
     d: DataState = state.data
     d = DataState(

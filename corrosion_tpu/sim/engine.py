@@ -48,7 +48,7 @@ class ClusterConfig:
 
 
 class ClusterState(NamedTuple):
-    swim: SwimState
+    swim: NamedTuple  # SwimState or SparseSwimState (swim_ops.impl(cfg.swim))
     data: DataState
     round: jax.Array  # i32
     vis_round: jax.Array  # i32[S, N] first round sample s visible at node, -1
@@ -99,7 +99,7 @@ class Schedule:
 
 def init_cluster(cfg: ClusterConfig, n_samples: int) -> ClusterState:
     return ClusterState(
-        swim=swim_ops.init_state(cfg.swim),
+        swim=swim_ops.impl(cfg.swim).init_state(cfg.swim),
         data=gossip_ops.init_data(cfg.gossip),
         round=jnp.int32(0),
         vis_round=jnp.full((n_samples, cfg.n_nodes), -1, jnp.int32),
@@ -122,9 +122,10 @@ def cluster_round(
     has_churn: bool,
 ) -> tuple[ClusterState, dict]:
     k_churn, k_bcast, k_swim, k_sync = jax.random.split(rng, 4)
+    swim_impl = swim_ops.impl(cfg.swim)
     sw = state.swim
     if has_churn:
-        sw = swim_ops.apply_churn(
+        sw = swim_impl.apply_churn(
             sw, kill, revive, k_churn, cfg.swim.max_transmissions
         )
     alive = sw.alive
@@ -132,7 +133,7 @@ def cluster_round(
     data, bstats = gossip_ops.broadcast_round(
         state.data, topo, alive, partition, writes, k_bcast, cfg.gossip
     )
-    sw = swim_ops.swim_round(sw, k_swim, state.round, cfg.swim)
+    sw = swim_impl.swim_round(sw, k_swim, state.round, cfg.swim)
     data, sstats = gossip_ops.sync_round(
         data, topo, alive, partition, state.round, k_sync, cfg.gossip
     )
@@ -147,7 +148,7 @@ def cluster_round(
     )
 
     stats = {
-        "mismatches": swim_ops.mismatches(sw),
+        "mismatches": swim_impl.mismatches(sw),
         "need": gossip_ops.total_need(data),
         "applied_broadcast": bstats["applied_broadcast"],
         "applied_sync": sstats["applied_sync"],

@@ -169,7 +169,9 @@ class TemplateState:
         self.queries = list(dict.fromkeys(recorded))
 
         def sql_real(q: str) -> QueryResponse:
-            return results.get(q) or QueryResponse([], [])
+            # Explicit membership test: a zero-row QueryResponse is falsy
+            # but must keep its real column names.
+            return results[q] if q in results else QueryResponse([], [])
 
         fn(chunks.append, sql_real, socket.gethostname, {})
         return "".join(chunks)

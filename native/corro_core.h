@@ -1,0 +1,252 @@
+/* Shared C core for the corrosion-tpu native runtime components.
+ *
+ * Implements the byte-level primitives both native artifacts build on:
+ *
+ *   - varint + zigzag codec (the PK / wire integer encoding of
+ *     corrosion_tpu/core/values.py, itself mirroring the packed-column
+ *     format of the reference's pubsub.rs:2115-2283)
+ *   - packed-column (PK blob) encode/validate/iterate
+ *   - exact SQLite cross-type value comparison (NULL < numeric < text <
+ *     blob, ints and reals compared exactly) — the LWW "biggest value
+ *     wins" tie-break of the reference's cr-sqlite engine
+ *     (doc/crdts.md:15-16)
+ *
+ * Used by:
+ *   - corro_native.c  (CPython extension module corrosion_tpu._corro_native)
+ *   - crdt_ext.c      (SQLite run-time loadable extension, the cr-sqlite
+ *                      analogue loaded into every Store connection)
+ */
+#ifndef CORRO_CORE_H
+#define CORRO_CORE_H
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* Column type tags — ordered like SQLite's cross-type ordering so tag
+ * comparison gives type precedence (values.py T_NULL..T_BLOB). */
+enum {
+  CORRO_T_NULL = 0,
+  CORRO_T_INT = 1,
+  CORRO_T_REAL = 2,
+  CORRO_T_TEXT = 3,
+  CORRO_T_BLOB = 4,
+};
+
+/* ---- growable byte buffer ---------------------------------------------- */
+
+typedef struct {
+  uint8_t *data;
+  size_t len;
+  size_t cap;
+  int oom;
+} corro_buf;
+
+static inline void corro_buf_init(corro_buf *b) {
+  b->data = NULL;
+  b->len = 0;
+  b->cap = 0;
+  b->oom = 0;
+}
+
+static inline void corro_buf_free(corro_buf *b) {
+  free(b->data);
+  corro_buf_init(b);
+}
+
+static inline int corro_buf_reserve(corro_buf *b, size_t extra) {
+  if (b->oom) return -1;
+  if (b->len + extra <= b->cap) return 0;
+  size_t cap = b->cap ? b->cap : 64;
+  while (cap < b->len + extra) cap *= 2;
+  uint8_t *p = (uint8_t *)realloc(b->data, cap);
+  if (!p) {
+    b->oom = 1;
+    return -1;
+  }
+  b->data = p;
+  b->cap = cap;
+  return 0;
+}
+
+static inline void corro_buf_put(corro_buf *b, const void *src, size_t n) {
+  if (corro_buf_reserve(b, n)) return;
+  memcpy(b->data + b->len, src, n);
+  b->len += n;
+}
+
+static inline void corro_buf_put_u8(corro_buf *b, uint8_t v) {
+  corro_buf_put(b, &v, 1);
+}
+
+/* ---- varint + zigzag ---------------------------------------------------- */
+
+static inline void corro_write_varint(corro_buf *b, uint64_t n) {
+  while (1) {
+    uint8_t byte = (uint8_t)(n & 0x7F);
+    n >>= 7;
+    if (n) {
+      corro_buf_put_u8(b, byte | 0x80);
+    } else {
+      corro_buf_put_u8(b, byte);
+      return;
+    }
+  }
+}
+
+/* Returns bytes consumed, or 0 on truncation/overflow. */
+static inline size_t corro_read_varint(const uint8_t *buf, size_t len,
+                                       uint64_t *out) {
+  uint64_t n = 0;
+  unsigned shift = 0;
+  size_t i = 0;
+  while (1) {
+    if (i >= len || shift > 63) return 0;
+    uint8_t byte = buf[i++];
+    n |= (uint64_t)(byte & 0x7F) << shift;
+    if (!(byte & 0x80)) {
+      *out = n;
+      return i;
+    }
+    shift += 7;
+  }
+}
+
+static inline uint64_t corro_zigzag(int64_t v) {
+  return ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+}
+
+static inline int64_t corro_unzigzag(uint64_t z) {
+  return (int64_t)(z >> 1) ^ -(int64_t)(z & 1);
+}
+
+/* ---- big-endian doubles -------------------------------------------------- */
+
+static inline void corro_write_be_double(corro_buf *b, double d) {
+  uint64_t bits;
+  memcpy(&bits, &d, 8);
+  uint8_t be[8];
+  for (int i = 0; i < 8; i++) be[i] = (uint8_t)(bits >> (56 - 8 * i));
+  corro_buf_put(b, be, 8);
+}
+
+static inline double corro_read_be_double(const uint8_t *p) {
+  uint64_t bits = 0;
+  for (int i = 0; i < 8; i++) bits = (bits << 8) | p[i];
+  double d;
+  memcpy(&d, &bits, 8);
+  return d;
+}
+
+/* ---- packed-column iteration -------------------------------------------- */
+
+typedef struct {
+  uint8_t tag;
+  int64_t i;          /* CORRO_T_INT */
+  double r;           /* CORRO_T_REAL */
+  const uint8_t *ptr; /* CORRO_T_TEXT / CORRO_T_BLOB payload */
+  size_t len;
+} corro_col;
+
+/* Parse the next packed column at buf[*off]; advances *off.
+ * Returns 1 on success, 0 at end of blob, -1 on malformed data. */
+static inline int corro_next_col(const uint8_t *buf, size_t len, size_t *off,
+                                 corro_col *out) {
+  if (*off >= len) return 0;
+  uint8_t tag = buf[(*off)++];
+  out->tag = tag;
+  switch (tag) {
+    case CORRO_T_NULL:
+      return 1;
+    case CORRO_T_INT: {
+      uint64_t z;
+      size_t n = corro_read_varint(buf + *off, len - *off, &z);
+      if (!n) return -1;
+      *off += n;
+      out->i = corro_unzigzag(z);
+      return 1;
+    }
+    case CORRO_T_REAL: {
+      if (*off + 8 > len) return -1;
+      out->r = corro_read_be_double(buf + *off);
+      *off += 8;
+      return 1;
+    }
+    case CORRO_T_TEXT:
+    case CORRO_T_BLOB: {
+      uint64_t n;
+      size_t used = corro_read_varint(buf + *off, len - *off, &n);
+      if (!used) return -1;
+      *off += used;
+      if (n > len - *off) return -1;
+      out->ptr = buf + *off;
+      out->len = (size_t)n;
+      *off += (size_t)n;
+      return 1;
+    }
+    default:
+      return -1;
+  }
+}
+
+/* Number of columns in a packed blob, or -1 if malformed. */
+static inline int corro_col_count(const uint8_t *buf, size_t len) {
+  size_t off = 0;
+  corro_col c;
+  int count = 0;
+  int rc;
+  while ((rc = corro_next_col(buf, len, &off, &c)) == 1) count++;
+  return rc < 0 ? -1 : count;
+}
+
+/* ---- exact SQLite cross-type value comparison --------------------------- */
+
+/* Exact i64-vs-double comparison (no precision loss for |i| > 2^53),
+ * the same approach as SQLite's sqlite3IntFloatCompare. */
+static inline int corro_int_float_cmp(int64_t i, double r) {
+  if (r != r) return 1; /* NaN sorts below every numeric */
+  if (r < -9223372036854775808.0) return 1;
+  if (r >= 9223372036854775808.0) return -1;
+  int64_t y = (int64_t)r;
+  if (i < y) return -1;
+  if (i > y) return 1;
+  double s = (double)i;
+  if (s < r) return -1;
+  if (s > r) return 1;
+  return 0;
+}
+
+static inline int corro_mem_cmp(const uint8_t *a, size_t an, const uint8_t *b,
+                                size_t bn) {
+  size_t n = an < bn ? an : bn;
+  int c = n ? memcmp(a, b, n) : 0;
+  if (c) return c < 0 ? -1 : 1;
+  if (an == bn) return 0;
+  return an < bn ? -1 : 1;
+}
+
+/* Compare two parsed columns with SQLite semantics: NULL < numeric <
+ * text < blob; ints and reals share the numeric class. UTF-8 memcmp order
+ * equals code-point order, matching Python str comparison. */
+static inline int corro_value_cmp(const corro_col *a, const corro_col *b) {
+  int ca = a->tag == CORRO_T_REAL ? CORRO_T_INT : a->tag;
+  int cb = b->tag == CORRO_T_REAL ? CORRO_T_INT : b->tag;
+  if (ca != cb) return ca < cb ? -1 : 1;
+  switch (ca) {
+    case CORRO_T_NULL:
+      return 0;
+    case CORRO_T_INT: {
+      if (a->tag == CORRO_T_INT && b->tag == CORRO_T_INT)
+        return a->i < b->i ? -1 : a->i > b->i ? 1 : 0;
+      if (a->tag == CORRO_T_INT) return corro_int_float_cmp(a->i, b->r);
+      if (b->tag == CORRO_T_INT) return -corro_int_float_cmp(b->i, a->r);
+      if (a->r != a->r) return b->r != b->r ? 0 : -1; /* NaN lowest */
+      if (b->r != b->r) return 1;
+      return a->r < b->r ? -1 : a->r > b->r ? 1 : 0;
+    }
+    default:
+      return corro_mem_cmp(a->ptr, a->len, b->ptr, b->len);
+  }
+}
+
+#endif /* CORRO_CORE_H */

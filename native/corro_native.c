@@ -1,0 +1,502 @@
+/* corrosion_tpu._corro_native — CPython extension: the native data-path
+ * runtime for the host agent.
+ *
+ * Provides (with pure-Python fallbacks in corrosion_tpu/core/values.py and
+ * corrosion_tpu/agent/transport.py):
+ *
+ *   pack_columns(seq)   -> bytes   packed-PK codec (values.py:71-95)
+ *   unpack_columns(b)   -> tuple   inverse, with malformed-blob rejection
+ *   value_cmp(a, b)     -> int     exact SQLite cross-type value ordering
+ *                                  (LWW tie-break, doc/crdts.md:15-16)
+ *   encode(obj)         -> bytes   compact binary wire codec for frame
+ *   decode(b)           -> obj     payloads — the speedy-encoding analogue
+ *                                  (corro-types/src/broadcast.rs UniPayload
+ *                                  derives speedy Readable/Writable); the
+ *                                  JSON+hex frame codec remains the
+ *                                  interoperable fallback
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include "corro_core.h"
+
+/* ---- wire codec tags (generic value encoding) --------------------------- */
+enum {
+  W_NULL = 0,
+  W_FALSE = 1,
+  W_TRUE = 2,
+  W_INT = 3,
+  W_FLOAT = 4,
+  W_STR = 5,
+  W_BYTES = 6,
+  W_LIST = 7,
+  W_DICT = 8,
+};
+
+#define MAX_DEPTH 64
+
+static PyObject *CorroError; /* maps to ValueError subclass-ish usage */
+
+/* ---- pack_columns ------------------------------------------------------- */
+
+static int pack_one(corro_buf *b, PyObject *v) {
+  if (v == Py_None) {
+    corro_buf_put_u8(b, CORRO_T_NULL);
+    return 0;
+  }
+  if (PyBool_Check(v)) {
+    corro_buf_put_u8(b, CORRO_T_INT);
+    corro_write_varint(b, corro_zigzag(v == Py_True ? 1 : 0));
+    return 0;
+  }
+  if (PyLong_Check(v)) {
+    int overflow = 0;
+    long long n = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (overflow || (n == -1 && PyErr_Occurred())) {
+      PyErr_Clear();
+      PyErr_SetString(PyExc_ValueError, "integer out of SQLite i64 range");
+      return -1;
+    }
+    corro_buf_put_u8(b, CORRO_T_INT);
+    corro_write_varint(b, corro_zigzag((int64_t)n));
+    return 0;
+  }
+  if (PyFloat_Check(v)) {
+    corro_buf_put_u8(b, CORRO_T_REAL);
+    corro_write_be_double(b, PyFloat_AS_DOUBLE(v));
+    return 0;
+  }
+  if (PyUnicode_Check(v)) {
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!s) return -1;
+    corro_buf_put_u8(b, CORRO_T_TEXT);
+    corro_write_varint(b, (uint64_t)n);
+    corro_buf_put(b, s, (size_t)n);
+    return 0;
+  }
+  if (PyBytes_Check(v) || PyByteArray_Check(v) || PyMemoryView_Check(v)) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(v, &view, PyBUF_SIMPLE)) return -1;
+    corro_buf_put_u8(b, CORRO_T_BLOB);
+    corro_write_varint(b, (uint64_t)view.len);
+    corro_buf_put(b, view.buf, (size_t)view.len);
+    PyBuffer_Release(&view);
+    return 0;
+  }
+  PyErr_Format(PyExc_TypeError, "unsupported SQL value type: %.200s",
+               Py_TYPE(v)->tp_name);
+  return -1;
+}
+
+static PyObject *py_pack_columns(PyObject *self, PyObject *arg) {
+  (void)self;
+  PyObject *it = PyObject_GetIter(arg);
+  if (!it) return NULL;
+  corro_buf b;
+  corro_buf_init(&b);
+  PyObject *item;
+  while ((item = PyIter_Next(it))) {
+    int rc = pack_one(&b, item);
+    Py_DECREF(item);
+    if (rc) {
+      Py_DECREF(it);
+      corro_buf_free(&b);
+      return NULL;
+    }
+  }
+  Py_DECREF(it);
+  if (PyErr_Occurred() || b.oom) {
+    corro_buf_free(&b);
+    return b.oom ? PyErr_NoMemory() : NULL;
+  }
+  PyObject *out = PyBytes_FromStringAndSize((const char *)b.data,
+                                            (Py_ssize_t)b.len);
+  corro_buf_free(&b);
+  return out;
+}
+
+/* ---- unpack_columns ----------------------------------------------------- */
+
+static PyObject *col_to_py(const corro_col *c) {
+  switch (c->tag) {
+    case CORRO_T_NULL:
+      Py_RETURN_NONE;
+    case CORRO_T_INT:
+      return PyLong_FromLongLong((long long)c->i);
+    case CORRO_T_REAL:
+      return PyFloat_FromDouble(c->r);
+    case CORRO_T_TEXT:
+      return PyUnicode_DecodeUTF8((const char *)c->ptr, (Py_ssize_t)c->len,
+                                  NULL);
+    default:
+      return PyBytes_FromStringAndSize((const char *)c->ptr,
+                                       (Py_ssize_t)c->len);
+  }
+}
+
+static PyObject *py_unpack_columns(PyObject *self, PyObject *arg) {
+  (void)self;
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE)) return NULL;
+  const uint8_t *buf = (const uint8_t *)view.buf;
+  size_t len = (size_t)view.len;
+  PyObject *list = PyList_New(0);
+  if (!list) {
+    PyBuffer_Release(&view);
+    return NULL;
+  }
+  size_t off = 0;
+  corro_col c;
+  int rc;
+  while ((rc = corro_next_col(buf, len, &off, &c)) == 1) {
+    PyObject *v = col_to_py(&c);
+    if (!v || PyList_Append(list, v)) {
+      Py_XDECREF(v);
+      goto fail;
+    }
+    Py_DECREF(v);
+  }
+  if (rc < 0) {
+    PyErr_SetObject(CorroError,
+                    PyUnicode_FromFormat("malformed packed blob at offset %zu",
+                                         off));
+    goto fail;
+  }
+  PyBuffer_Release(&view);
+  PyObject *tup = PyList_AsTuple(list);
+  Py_DECREF(list);
+  return tup;
+fail:
+  PyBuffer_Release(&view);
+  Py_DECREF(list);
+  return NULL;
+}
+
+/* ---- value_cmp ---------------------------------------------------------- */
+
+/* Parse a Python SqliteValue into a corro_col; borrowed buffers stay alive
+ * while the caller holds the value. Returns 0 ok / -1 error. */
+static int py_to_col(PyObject *v, corro_col *c, Py_buffer *view,
+                     int *has_view) {
+  *has_view = 0;
+  if (v == Py_None) {
+    c->tag = CORRO_T_NULL;
+    return 0;
+  }
+  if (PyBool_Check(v)) {
+    c->tag = CORRO_T_INT;
+    c->i = v == Py_True;
+    return 0;
+  }
+  if (PyLong_Check(v)) {
+    int overflow = 0;
+    long long n = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (overflow || (n == -1 && PyErr_Occurred())) {
+      PyErr_Clear();
+      PyErr_SetString(PyExc_OverflowError, "integer out of i64 range");
+      return -1;
+    }
+    c->tag = CORRO_T_INT;
+    c->i = (int64_t)n;
+    return 0;
+  }
+  if (PyFloat_Check(v)) {
+    c->tag = CORRO_T_REAL;
+    c->r = PyFloat_AS_DOUBLE(v);
+    return 0;
+  }
+  if (PyUnicode_Check(v)) {
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!s) return -1;
+    c->tag = CORRO_T_TEXT;
+    c->ptr = (const uint8_t *)s;
+    c->len = (size_t)n;
+    return 0;
+  }
+  if (PyObject_CheckBuffer(v)) {
+    if (PyObject_GetBuffer(v, view, PyBUF_SIMPLE)) return -1;
+    *has_view = 1;
+    c->tag = CORRO_T_BLOB;
+    c->ptr = (const uint8_t *)view->buf;
+    c->len = (size_t)view->len;
+    return 0;
+  }
+  PyErr_Format(PyExc_TypeError, "unsupported SQL value type: %.200s",
+               Py_TYPE(v)->tp_name);
+  return -1;
+}
+
+static PyObject *py_value_cmp(PyObject *self, PyObject *args) {
+  (void)self;
+  PyObject *a, *b;
+  if (!PyArg_ParseTuple(args, "OO", &a, &b)) return NULL;
+  corro_col ca, cb;
+  Py_buffer va, vb;
+  int ha = 0, hb = 0;
+  int rc = py_to_col(a, &ca, &va, &ha);
+  if (!rc) rc = py_to_col(b, &cb, &vb, &hb);
+  PyObject *out = NULL;
+  if (!rc) out = PyLong_FromLong(corro_value_cmp(&ca, &cb));
+  if (ha) PyBuffer_Release(&va);
+  if (hb) PyBuffer_Release(&vb);
+  return out;
+}
+
+/* ---- generic wire codec (speedy analogue) ------------------------------- */
+
+static int encode_obj(corro_buf *b, PyObject *v, int depth) {
+  if (depth > MAX_DEPTH) {
+    PyErr_SetString(PyExc_ValueError, "wire value nested too deeply");
+    return -1;
+  }
+  if (v == Py_None) {
+    corro_buf_put_u8(b, W_NULL);
+    return 0;
+  }
+  if (PyBool_Check(v)) {
+    corro_buf_put_u8(b, v == Py_True ? W_TRUE : W_FALSE);
+    return 0;
+  }
+  if (PyLong_Check(v)) {
+    int overflow = 0;
+    long long n = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (overflow || (n == -1 && PyErr_Occurred())) {
+      PyErr_Clear();
+      PyErr_SetString(PyExc_ValueError, "wire integer out of i64 range");
+      return -1;
+    }
+    corro_buf_put_u8(b, W_INT);
+    corro_write_varint(b, corro_zigzag((int64_t)n));
+    return 0;
+  }
+  if (PyFloat_Check(v)) {
+    corro_buf_put_u8(b, W_FLOAT);
+    corro_write_be_double(b, PyFloat_AS_DOUBLE(v));
+    return 0;
+  }
+  if (PyUnicode_Check(v)) {
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!s) return -1;
+    corro_buf_put_u8(b, W_STR);
+    corro_write_varint(b, (uint64_t)n);
+    corro_buf_put(b, s, (size_t)n);
+    return 0;
+  }
+  if (PyBytes_Check(v) || PyByteArray_Check(v) || PyMemoryView_Check(v)) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(v, &view, PyBUF_SIMPLE)) return -1;
+    corro_buf_put_u8(b, W_BYTES);
+    corro_write_varint(b, (uint64_t)view.len);
+    corro_buf_put(b, view.buf, (size_t)view.len);
+    PyBuffer_Release(&view);
+    return 0;
+  }
+  if (PyList_Check(v) || PyTuple_Check(v)) {
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(v);
+    corro_buf_put_u8(b, W_LIST);
+    corro_write_varint(b, (uint64_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *item = PyList_Check(v) ? PyList_GET_ITEM(v, i)
+                                       : PyTuple_GET_ITEM(v, i);
+      if (encode_obj(b, item, depth + 1)) return -1;
+    }
+    return 0;
+  }
+  if (PyDict_Check(v)) {
+    corro_buf_put_u8(b, W_DICT);
+    corro_write_varint(b, (uint64_t)PyDict_Size(v));
+    Py_ssize_t pos = 0;
+    PyObject *key, *val;
+    while (PyDict_Next(v, &pos, &key, &val)) {
+      if (!PyUnicode_Check(key)) {
+        PyErr_SetString(PyExc_TypeError, "wire dict keys must be str");
+        return -1;
+      }
+      Py_ssize_t n;
+      const char *s = PyUnicode_AsUTF8AndSize(key, &n);
+      if (!s) return -1;
+      corro_write_varint(b, (uint64_t)n);
+      corro_buf_put(b, s, (size_t)n);
+      if (encode_obj(b, val, depth + 1)) return -1;
+    }
+    return 0;
+  }
+  PyErr_Format(PyExc_TypeError, "unsupported wire value type: %.200s",
+               Py_TYPE(v)->tp_name);
+  return -1;
+}
+
+static PyObject *py_encode(PyObject *self, PyObject *arg) {
+  (void)self;
+  corro_buf b;
+  corro_buf_init(&b);
+  if (encode_obj(&b, arg, 0)) {
+    corro_buf_free(&b);
+    return NULL;
+  }
+  if (b.oom) {
+    corro_buf_free(&b);
+    return PyErr_NoMemory();
+  }
+  PyObject *out = PyBytes_FromStringAndSize((const char *)b.data,
+                                            (Py_ssize_t)b.len);
+  corro_buf_free(&b);
+  return out;
+}
+
+static PyObject *decode_obj(const uint8_t *buf, size_t len, size_t *off,
+                            int depth) {
+  if (depth > MAX_DEPTH) {
+    PyErr_SetString(CorroError, "wire value nested too deeply");
+    return NULL;
+  }
+  if (*off >= len) {
+    PyErr_SetString(CorroError, "truncated wire value");
+    return NULL;
+  }
+  uint8_t tag = buf[(*off)++];
+  switch (tag) {
+    case W_NULL:
+      Py_RETURN_NONE;
+    case W_FALSE:
+      Py_RETURN_FALSE;
+    case W_TRUE:
+      Py_RETURN_TRUE;
+    case W_INT: {
+      uint64_t z;
+      size_t n = corro_read_varint(buf + *off, len - *off, &z);
+      if (!n) goto truncated;
+      *off += n;
+      return PyLong_FromLongLong((long long)corro_unzigzag(z));
+    }
+    case W_FLOAT: {
+      if (*off + 8 > len) goto truncated;
+      double d = corro_read_be_double(buf + *off);
+      *off += 8;
+      return PyFloat_FromDouble(d);
+    }
+    case W_STR:
+    case W_BYTES: {
+      uint64_t n;
+      size_t used = corro_read_varint(buf + *off, len - *off, &n);
+      if (!used || n > len - *off - used) goto truncated;
+      *off += used;
+      const char *p = (const char *)(buf + *off);
+      *off += (size_t)n;
+      return tag == W_STR
+                 ? PyUnicode_DecodeUTF8(p, (Py_ssize_t)n, NULL)
+                 : PyBytes_FromStringAndSize(p, (Py_ssize_t)n);
+    }
+    case W_LIST: {
+      uint64_t n;
+      size_t used = corro_read_varint(buf + *off, len - *off, &n);
+      if (!used || n > len - *off) goto truncated; /* ≥1 byte per item */
+      *off += used;
+      PyObject *list = PyList_New((Py_ssize_t)n);
+      if (!list) return NULL;
+      for (uint64_t i = 0; i < n; i++) {
+        PyObject *item = decode_obj(buf, len, off, depth + 1);
+        if (!item) {
+          Py_DECREF(list);
+          return NULL;
+        }
+        PyList_SET_ITEM(list, (Py_ssize_t)i, item);
+      }
+      return list;
+    }
+    case W_DICT: {
+      uint64_t n;
+      size_t used = corro_read_varint(buf + *off, len - *off, &n);
+      if (!used || n > len - *off) goto truncated;
+      *off += used;
+      PyObject *dict = PyDict_New();
+      if (!dict) return NULL;
+      for (uint64_t i = 0; i < n; i++) {
+        uint64_t kn;
+        size_t ku = corro_read_varint(buf + *off, len - *off, &kn);
+        if (!ku || kn > len - *off - ku) {
+          Py_DECREF(dict);
+          goto truncated;
+        }
+        *off += ku;
+        PyObject *key = PyUnicode_DecodeUTF8((const char *)(buf + *off),
+                                             (Py_ssize_t)kn, NULL);
+        *off += (size_t)kn;
+        if (!key) {
+          Py_DECREF(dict);
+          return NULL;
+        }
+        PyObject *val = decode_obj(buf, len, off, depth + 1);
+        if (!val || PyDict_SetItem(dict, key, val)) {
+          Py_DECREF(key);
+          Py_XDECREF(val);
+          Py_DECREF(dict);
+          return NULL;
+        }
+        Py_DECREF(key);
+        Py_DECREF(val);
+      }
+      return dict;
+    }
+    default:
+      PyErr_Format(CorroError, "bad wire tag %d at offset %zu", tag,
+                   *off - 1);
+      return NULL;
+  }
+truncated:
+  PyErr_SetString(CorroError, "truncated wire value");
+  return NULL;
+}
+
+static PyObject *py_decode(PyObject *self, PyObject *arg) {
+  (void)self;
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE)) return NULL;
+  size_t off = 0;
+  PyObject *out = decode_obj((const uint8_t *)view.buf, (size_t)view.len,
+                             &off, 0);
+  if (out && off != (size_t)view.len) {
+    Py_DECREF(out);
+    out = NULL;
+    PyErr_SetString(CorroError, "trailing bytes after wire value");
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+/* ---- module ------------------------------------------------------------- */
+
+static PyMethodDef methods[] = {
+    {"pack_columns", py_pack_columns, METH_O,
+     "Serialize a sequence of SQL values into one packed-PK blob."},
+    {"unpack_columns", py_unpack_columns, METH_O,
+     "Parse a packed-PK blob back into a tuple of SQL values."},
+    {"value_cmp", py_value_cmp, METH_VARARGS,
+     "Exact SQLite cross-type comparison of two SQL values (-1/0/1)."},
+    {"encode", py_encode, METH_O,
+     "Encode a JSON-able value (+ bytes) into the compact binary wire form."},
+    {"decode", py_decode, METH_O, "Decode the compact binary wire form."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_corro_native",
+    "Native data-path runtime (codec + value ordering) for corrosion_tpu.",
+    -1, methods, NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit__corro_native(void) {
+  PyObject *m = PyModule_Create(&moduledef);
+  if (!m) return NULL;
+  CorroError = PyErr_NewException("_corro_native.MalformedError",
+                                  PyExc_ValueError, NULL);
+  if (!CorroError || PyModule_AddObject(m, "MalformedError", CorroError)) {
+    Py_XDECREF(CorroError);
+    Py_DECREF(m);
+    return NULL;
+  }
+  return m;
+}

@@ -1,0 +1,207 @@
+/* crdt_ext — SQLite run-time loadable extension: the native CRDT helper
+ * functions the host Store loads into every connection.
+ *
+ * This is the architectural analogue of the reference's vendored cr-sqlite
+ * C extension (corro-types/src/sqlite.rs:20-26,87-105 loads prebuilt
+ * sqlite3_crsqlite_init blobs into every conn). Our CRR layer keeps the
+ * clock/causal-length tables in plain SQL (agent/store.py), and this
+ * extension supplies the pieces SQL cannot express natively:
+ *
+ *   crdt_value_cmp(a, b)      -> -1/0/1  exact SQLite cross-type ordering,
+ *                                        the LWW "biggest value wins"
+ *                                        tie-break (doc/crdts.md:15-16).
+ *                                        With it, a remote cell merge is a
+ *                                        single conditional UPDATE instead
+ *                                        of SELECT + host compare + UPDATE.
+ *   crdt_pack_columns(v...)   -> blob    packed-PK codec (values.py:71-95)
+ *   crdt_unpack_col(blob, i)  -> value   i-th packed column (0-based)
+ *   crdt_col_count(blob)      -> int     column count / malformed check
+ *   crdt_site_hex(blob)       -> text    site-id rendering for diagnostics
+ *
+ * All functions are deterministic, so SQLite may use them in indexes and
+ * partial-index predicates.
+ */
+#include "sqlite3ext.h"
+SQLITE_EXTENSION_INIT1
+
+#include "corro_core.h"
+
+/* Parse an sqlite3_value into a corro_col (no copies; SQLite owns memory
+ * for the duration of the function call). */
+static int sqval_to_col(sqlite3_value *v, corro_col *c) {
+  switch (sqlite3_value_type(v)) {
+    case SQLITE_NULL:
+      c->tag = CORRO_T_NULL;
+      return 0;
+    case SQLITE_INTEGER:
+      c->tag = CORRO_T_INT;
+      c->i = sqlite3_value_int64(v);
+      return 0;
+    case SQLITE_FLOAT:
+      c->tag = CORRO_T_REAL;
+      c->r = sqlite3_value_double(v);
+      return 0;
+    case SQLITE_TEXT:
+      c->tag = CORRO_T_TEXT;
+      c->ptr = (const uint8_t *)sqlite3_value_text(v);
+      c->len = (size_t)sqlite3_value_bytes(v);
+      return 0;
+    case SQLITE_BLOB:
+      c->tag = CORRO_T_BLOB;
+      c->ptr = (const uint8_t *)sqlite3_value_blob(v);
+      c->len = (size_t)sqlite3_value_bytes(v);
+      return 0;
+    default:
+      return -1;
+  }
+}
+
+static void fn_value_cmp(sqlite3_context *ctx, int argc,
+                         sqlite3_value **argv) {
+  corro_col a, b;
+  if (argc != 2 || sqval_to_col(argv[0], &a) || sqval_to_col(argv[1], &b)) {
+    sqlite3_result_error(ctx, "crdt_value_cmp expects two SQL values", -1);
+    return;
+  }
+  sqlite3_result_int(ctx, corro_value_cmp(&a, &b));
+}
+
+static void fn_pack_columns(sqlite3_context *ctx, int argc,
+                            sqlite3_value **argv) {
+  corro_buf buf;
+  corro_buf_init(&buf);
+  for (int i = 0; i < argc; i++) {
+    corro_col c;
+    if (sqval_to_col(argv[i], &c)) {
+      corro_buf_free(&buf);
+      sqlite3_result_error(ctx, "crdt_pack_columns: unsupported value", -1);
+      return;
+    }
+    corro_buf_put_u8(&buf, c.tag);
+    switch (c.tag) {
+      case CORRO_T_NULL:
+        break;
+      case CORRO_T_INT:
+        corro_write_varint(&buf, corro_zigzag(c.i));
+        break;
+      case CORRO_T_REAL:
+        corro_write_be_double(&buf, c.r);
+        break;
+      default:
+        corro_write_varint(&buf, (uint64_t)c.len);
+        corro_buf_put(&buf, c.ptr, c.len);
+    }
+  }
+  if (buf.oom) {
+    corro_buf_free(&buf);
+    sqlite3_result_error_nomem(ctx);
+    return;
+  }
+  sqlite3_result_blob(ctx, buf.data, (int)buf.len, SQLITE_TRANSIENT);
+  corro_buf_free(&buf);
+}
+
+static void col_to_result(sqlite3_context *ctx, const corro_col *c) {
+  switch (c->tag) {
+    case CORRO_T_NULL:
+      sqlite3_result_null(ctx);
+      return;
+    case CORRO_T_INT:
+      sqlite3_result_int64(ctx, c->i);
+      return;
+    case CORRO_T_REAL:
+      sqlite3_result_double(ctx, c->r);
+      return;
+    case CORRO_T_TEXT:
+      sqlite3_result_text(ctx, (const char *)c->ptr, (int)c->len,
+                          SQLITE_TRANSIENT);
+      return;
+    default:
+      sqlite3_result_blob(ctx, c->ptr, (int)c->len, SQLITE_TRANSIENT);
+  }
+}
+
+static void fn_unpack_col(sqlite3_context *ctx, int argc,
+                          sqlite3_value **argv) {
+  if (argc != 2 || sqlite3_value_type(argv[0]) != SQLITE_BLOB) {
+    sqlite3_result_error(ctx, "crdt_unpack_col(blob, index)", -1);
+    return;
+  }
+  const uint8_t *buf = (const uint8_t *)sqlite3_value_blob(argv[0]);
+  size_t len = (size_t)sqlite3_value_bytes(argv[0]);
+  sqlite3_int64 want = sqlite3_value_int64(argv[1]);
+  size_t off = 0;
+  corro_col c;
+  sqlite3_int64 idx = 0;
+  int rc;
+  while ((rc = corro_next_col(buf, len, &off, &c)) == 1) {
+    if (idx++ == want) {
+      col_to_result(ctx, &c);
+      return;
+    }
+  }
+  if (rc < 0)
+    sqlite3_result_error(ctx, "crdt_unpack_col: malformed blob", -1);
+  else
+    sqlite3_result_null(ctx); /* index out of range */
+}
+
+static void fn_col_count(sqlite3_context *ctx, int argc,
+                         sqlite3_value **argv) {
+  if (argc != 1 || sqlite3_value_type(argv[0]) != SQLITE_BLOB) {
+    sqlite3_result_error(ctx, "crdt_col_count(blob)", -1);
+    return;
+  }
+  int n = corro_col_count((const uint8_t *)sqlite3_value_blob(argv[0]),
+                          (size_t)sqlite3_value_bytes(argv[0]));
+  if (n < 0)
+    sqlite3_result_error(ctx, "crdt_col_count: malformed blob", -1);
+  else
+    sqlite3_result_int(ctx, n);
+}
+
+static void fn_site_hex(sqlite3_context *ctx, int argc, sqlite3_value **argv) {
+  static const char hexd[] = "0123456789abcdef";
+  if (argc != 1 || sqlite3_value_type(argv[0]) != SQLITE_BLOB) {
+    sqlite3_result_error(ctx, "crdt_site_hex(blob)", -1);
+    return;
+  }
+  const uint8_t *p = (const uint8_t *)sqlite3_value_blob(argv[0]);
+  int n = sqlite3_value_bytes(argv[0]);
+  char *out = (char *)sqlite3_malloc(2 * n + 1);
+  if (!out) {
+    sqlite3_result_error_nomem(ctx);
+    return;
+  }
+  for (int i = 0; i < n; i++) {
+    out[2 * i] = hexd[p[i] >> 4];
+    out[2 * i + 1] = hexd[p[i] & 0xF];
+  }
+  out[2 * n] = 0;
+  sqlite3_result_text(ctx, out, 2 * n, sqlite3_free);
+}
+
+#ifdef _WIN32
+__declspec(dllexport)
+#endif
+int sqlite3_crdtext_init(sqlite3 *db, char **pzErrMsg,
+                         const sqlite3_api_routines *pApi) {
+  (void)pzErrMsg;
+  SQLITE_EXTENSION_INIT2(pApi);
+  const int flags = SQLITE_UTF8 | SQLITE_DETERMINISTIC;
+  int rc = sqlite3_create_function(db, "crdt_value_cmp", 2, flags, 0,
+                                   fn_value_cmp, 0, 0);
+  if (rc == SQLITE_OK)
+    rc = sqlite3_create_function(db, "crdt_pack_columns", -1, flags, 0,
+                                 fn_pack_columns, 0, 0);
+  if (rc == SQLITE_OK)
+    rc = sqlite3_create_function(db, "crdt_unpack_col", 2, flags, 0,
+                                 fn_unpack_col, 0, 0);
+  if (rc == SQLITE_OK)
+    rc = sqlite3_create_function(db, "crdt_col_count", 1, flags, 0,
+                                 fn_col_count, 0, 0);
+  if (rc == SQLITE_OK)
+    rc = sqlite3_create_function(db, "crdt_site_hex", 1, flags, 0,
+                                 fn_site_hex, 0, 0);
+  return rc;
+}

@@ -1,0 +1,57 @@
+"""On-chip validation for the 100k-node WAN config (BASELINE config 5).
+
+Initializes the full wan_100k cluster (sparse SWIM kernel) on the real
+device, runs a bounded number of rounds, and prints state size + step time.
+This is the memory-plan check: 100k nodes must fit and run on one chip.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from corrosion_tpu import models
+from corrosion_tpu.ops import swim_sparse
+from corrosion_tpu.sim import simulate
+
+
+def main() -> None:
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    cfg, topo, sched = models.wan_100k(rounds=rounds, samples=64)
+    t0 = time.perf_counter()
+    final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=8)
+    jax.block_until_ready(final.data.contig)
+    wall = time.perf_counter() - t0
+
+    state_bytes = sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves((final.swim, final.data))
+    )
+    print(
+        json.dumps(
+            {
+                "platform": jax.devices()[0].platform,
+                "nodes": cfg.n_nodes,
+                "rounds": rounds,
+                "wall_s": round(wall, 2),
+                "step_ms": round(wall / rounds * 1000.0, 1),
+                "state_mib": round(state_bytes / 2**20, 1),
+                "swim_bytes_per_node": swim_sparse.state_bytes_per_node(
+                    cfg.swim
+                ),
+                "applied": int(
+                    curves["applied_broadcast"].sum()
+                    + curves["applied_sync"].sum()
+                ),
+                "mismatches_last": int(curves["mismatches"][-1]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -18,3 +18,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Build the native runtime artifacts (codec + SQLite CRDT extension) once
+# per session so the host-agent tests exercise the native path; everything
+# they cover also runs pure-Python when the toolchain is absent.
+from corrosion_tpu import native as _native  # noqa: E402
+
+_native.build()

@@ -52,29 +52,22 @@ def test_cli_help_and_query_exec(tmp_path, capsys):
     with pytest.raises(SystemExit):
         cli_main(["--help"])
 
-    async def main():
-        a = await launch_test_agent(str(tmp_path / "a"))
-        return a
-
-    a = run(_setup_and_query(tmp_path, capsys))
+    run(_setup_and_query(tmp_path, capsys))
 
 
 async def _setup_and_query(tmp_path, capsys):
     a = await launch_test_agent(str(tmp_path / "a"))
     host, port = a.agent.api_addr
     try:
-        # CLI runs its own event loop, so call it from a thread.
-        def run_cli(args):
-            return cli_main(args)
-
+        # The CLI runs its own event loop, so call it from a thread.
         rc = await asyncio.to_thread(
-            run_cli,
+            cli_main,
             ["--api-addr", f"{host}:{port}", "exec",
              "INSERT INTO tests (id, text) VALUES (7, 'cli')"],
         )
         assert rc == 0
         rc = await asyncio.to_thread(
-            run_cli,
+            cli_main,
             ["--api-addr", f"{host}:{port}", "query", "--columns",
              "SELECT id, text FROM tests"],
         )
@@ -180,6 +173,14 @@ def test_template_render_and_watch(tmp_path):
             assert "server 1 svc-a" in out and "server 2 svc-b" in out
             assert "count=2" in out
             assert st.queries == ["SELECT id, text FROM tests ORDER BY id"]
+
+            # Zero-row query must keep its real column names (to_csv header).
+            tpl.write_text(
+                "<%= sql(\"SELECT id, text FROM tests WHERE id > 99\").to_csv() %>"
+            )
+            await st.write()
+            out = (tmp_path / "out.conf").read_text()
+            assert out.strip() == "id,text"
         finally:
             await a.stop()
 

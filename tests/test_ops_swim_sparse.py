@@ -1,0 +1,191 @@
+"""Sparse (exception-table) SWIM kernel tests.
+
+Re-runs the dense kernel's churn scenarios against the O(N·K) kernel and
+adds sparse-specific coverage: bounded-table eviction priority, the merge
+invariants, and the 100k memory budget the kernel exists for.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops import swim, swim_sparse
+
+
+def cfg_for(n, **kw):
+    kw.setdefault("view_capacity", 16)
+    return swim.SwimConfig(n_nodes=n, **kw)
+
+
+def run_rounds(state, cfg, start, count, seed=0):
+    key = jax.random.PRNGKey(seed)
+    for r in range(start, start + count):
+        key, sub = jax.random.split(key)
+        state = swim_sparse.swim_round(state, sub, jnp.int32(r), cfg)
+    return state
+
+
+def test_impl_dispatch():
+    assert swim.impl(swim.SwimConfig(n_nodes=4)) is swim
+    assert swim.impl(cfg_for(4)) is swim_sparse
+
+
+def test_stable_cluster_stays_accurate_and_empty():
+    cfg = cfg_for(16)
+    state = swim_sparse.init_state(cfg)
+    state = run_rounds(state, cfg, 0, 10)
+    assert int(swim_sparse.mismatches(state)) == 0
+    assert int(jnp.max(state.incarnation)) == 0
+    # A quiet cluster gossips only alive@inc0 == baseline: no exceptions.
+    assert int(jnp.sum(state.exc_tgt >= 0)) == 0
+
+
+def test_dead_node_detected_and_spread():
+    cfg = cfg_for(24, suspect_rounds=2, gossip_fanout=3)
+    state = swim_sparse.init_state(cfg)
+    state = run_rounds(state, cfg, 0, 4)
+    kill = jnp.zeros(24, bool).at[5].set(True)
+    state = swim_sparse.apply_churn(state, kill, jnp.zeros(24, bool))
+    state = run_rounds(state, cfg, 4, 30, seed=1)
+    sev = swim.packed_sev(swim_sparse.beliefs_about(state, 5))
+    live = np.asarray(state.alive)
+    believed_down = np.asarray(sev == swim.SEV_DOWN)
+    assert believed_down[live].all(), "all live nodes must see node 5 as down"
+    assert int(swim_sparse.mismatches(state)) == 0
+
+
+def test_revived_node_rejoins_with_bumped_incarnation():
+    cfg = cfg_for(16, suspect_rounds=2)
+    state = swim_sparse.init_state(cfg)
+    kill = jnp.zeros(16, bool).at[3].set(True)
+    state = swim_sparse.apply_churn(state, kill, jnp.zeros(16, bool))
+    state = run_rounds(state, cfg, 0, 25, seed=2)
+    assert int(
+        swim.packed_sev(swim_sparse.beliefs_about(state, 3))[0]
+    ) == swim.SEV_DOWN
+    revive = jnp.zeros(16, bool).at[3].set(True)
+    state = swim_sparse.apply_churn(
+        state, jnp.zeros(16, bool), revive, jax.random.PRNGKey(9)
+    )
+    assert int(state.incarnation[3]) == 1
+    state = run_rounds(state, cfg, 25, 30, seed=3)
+    sev = swim.packed_sev(swim_sparse.beliefs_about(state, 3))
+    live = np.asarray(state.alive)
+    assert np.asarray(sev < swim.SEV_DOWN)[live].all(), "rejoin must spread"
+    assert int(swim_sparse.mismatches(state)) == 0
+
+
+def test_false_suspicion_refuted_under_loss():
+    cfg = cfg_for(16, suspect_rounds=4, loss_prob=0.3)
+    state = swim_sparse.init_state(cfg)
+    state = run_rounds(state, cfg, 0, 20, seed=4)
+    calm = cfg_for(16, suspect_rounds=4, loss_prob=0.0)
+    state = run_rounds(state, calm, 20, 20, seed=5)
+    assert int(swim_sparse.mismatches(state)) == 0
+    assert bool(state.alive.all())
+
+
+def test_matches_dense_on_churn_storm():
+    """Same scenario on both kernels: both must converge to the same truth.
+
+    Bit-identical views are not required (the sparse kernel caps per-round
+    view intake), but post-storm both must reach zero mismatches and agree
+    on which nodes are down.
+    """
+    n = 32
+    dense_cfg = swim.SwimConfig(n_nodes=n, suspect_rounds=2)
+    sparse_cfg = cfg_for(n, suspect_rounds=2)
+    ds = swim.init_state(dense_cfg)
+    ss = swim_sparse.init_state(sparse_cfg)
+    key = jax.random.PRNGKey(7)
+    rng = np.random.default_rng(7)
+    r = 0
+    for burst in range(3):
+        kill_np = rng.random(n) < 0.15
+        kill = jnp.asarray(kill_np)
+        none = jnp.zeros(n, bool)
+        key, kc = jax.random.split(key)
+        ds = swim.apply_churn(ds, kill, none, kc)
+        ss = swim_sparse.apply_churn(ss, kill, none, kc)
+        for _ in range(25):
+            key, sub = jax.random.split(key)
+            ds = swim.swim_round(ds, sub, jnp.int32(r), dense_cfg)
+            ss = swim_sparse.swim_round(ss, sub, jnp.int32(r), sparse_cfg)
+            r += 1
+    assert int(swim.mismatches(ds)) == 0
+    assert int(swim_sparse.mismatches(ss)) == 0
+    assert np.array_equal(np.asarray(ds.alive), np.asarray(ss.alive))
+
+
+def test_merge_one_invariants():
+    # Unique target per row; max-merge on hit; eviction keeps severe entries.
+    et = jnp.array([[0, 2, -1]], jnp.int32)
+    ep = jnp.array(
+        [[swim.pack(jnp.uint32(1), swim.SEV_ALIVE),
+          swim.pack(jnp.uint32(0), swim.SEV_DOWN), 0]], jnp.uint32
+    )
+    # Hit: raise belief about 0.
+    t = jnp.array([0], jnp.int32)
+    p = jnp.array([int(swim.pack(jnp.uint32(3), swim.SEV_ALIVE))], jnp.uint32)
+    et2, ep2, raised = swim_sparse._merge_one(
+        et, ep, t, p, jnp.array([True])
+    )
+    assert bool(raised[0])
+    assert int(swim_sparse._lookup(et2, ep2, t)[0]) == int(p[0])
+    assert int(jnp.sum(et2 == 0)) == 1  # no duplicate slot
+    # Insert into the free slot.
+    t3 = jnp.array([5], jnp.int32)
+    p3 = jnp.array([int(swim.pack(jnp.uint32(0), swim.SEV_SUSPECT))], jnp.uint32)
+    et3, ep3, raised3 = swim_sparse._merge_one(
+        et2, ep2, t3, p3, jnp.array([True])
+    )
+    assert bool(raised3[0]) and int(swim_sparse._lookup(et3, ep3, t3)[0]) == int(p3[0])
+    # Table now full: an alive entry must be evicted before suspect/down.
+    t4 = jnp.array([7], jnp.int32)
+    p4 = jnp.array([int(swim.pack(jnp.uint32(0), swim.SEV_DOWN))], jnp.uint32)
+    et4, ep4, raised4 = swim_sparse._merge_one(
+        et3, ep3, t4, p4, jnp.array([True])
+    )
+    assert bool(raised4[0])
+    kept = set(np.asarray(et4[0]).tolist())
+    assert 7 in kept and 2 in kept and 5 in kept  # down/suspect survive
+    assert 0 not in kept  # the alive@inc3 exception was the evictee
+    # Weakest incoming vs full severe table: dropped, not evicted.
+    t5 = jnp.array([9], jnp.int32)
+    p5 = jnp.array([int(swim.pack(jnp.uint32(0), swim.SEV_ALIVE))], jnp.uint32)
+    _, _, raised5 = swim_sparse._merge_one(
+        et4, ep4, t5, p5, jnp.array([True])
+    )
+    assert not bool(raised5[0])
+
+
+def test_memory_budget_100k():
+    # The point of the kernel: the membership plane at 100k nodes must fit
+    # in a fraction of one chip's HBM. ~0.5 KiB/node at K=64.
+    cfg = swim.SwimConfig(n_nodes=100_000, view_capacity=64)
+    per_node = swim_sparse.state_bytes_per_node(cfg)
+    assert per_node <= 1024
+    assert per_node * cfg.n_nodes <= 110 * 2**20  # ≤ ~105 MiB total
+
+
+def test_engine_integration_sparse():
+    """The full cluster engine (all three planes) over the sparse kernel:
+    the dense churn_32 scenario must converge identically in outcome."""
+    import dataclasses
+
+    from corrosion_tpu.models import baselines
+    from corrosion_tpu.sim import simulate
+
+    cfg, topo, sched = baselines.churn_32(rounds=200, samples=32)
+    cfg = dataclasses.replace(
+        cfg, swim=dataclasses.replace(cfg.swim, view_capacity=16)
+    )
+    final, curves = simulate(cfg, topo, sched, seed=1)
+    m = curves["mismatches"]
+    assert m.max() > 0, "churn must actually cause belief divergence"
+    assert m[-1] == 0, "membership converges after the storm"
+    alive = np.asarray(final.swim.alive)
+    contig = np.asarray(final.data.contig)[alive]
+    heads = np.asarray(final.data.head)
+    assert (contig == heads[None, :]).all()

@@ -42,9 +42,11 @@ def test_sharded_run_is_bit_identical():
             np.asarray(getattr(final_s.data.cells, name)),
             err_msg=f"cells.{name}",
         )
-    np.testing.assert_array_equal(
-        np.asarray(final_u.swim.view), np.asarray(final_s.swim.view)
-    )
+    # wan_100k uses the sparse SWIM kernel; compare every membership leaf.
+    for u_leaf, s_leaf in zip(
+        jax.tree.leaves(final_u.swim), jax.tree.leaves(final_s.swim)
+    ):
+        np.testing.assert_array_equal(np.asarray(u_leaf), np.asarray(s_leaf))
     np.testing.assert_array_equal(
         np.asarray(final_u.vis_round), np.asarray(final_s.vis_round)
     )

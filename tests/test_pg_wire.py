@@ -116,3 +116,15 @@ def test_pg_select_insert_and_parity(tmp_path):
             await a.stop()
 
     run(main())
+
+
+def test_split_statements_quote_aware():
+    from corrosion_tpu.agent.pg import _split_statements
+
+    assert _split_statements("SELECT 1; SELECT 2;") == ["SELECT 1", "SELECT 2"]
+    # ';' inside string literals must not split (real PG accepts these).
+    assert _split_statements(
+        "INSERT INTO t VALUES (1, 'a;b'); SELECT 'x;''y;' ;"
+    ) == ["INSERT INTO t VALUES (1, 'a;b')", "SELECT 'x;''y;'"]
+    assert _split_statements('SELECT ";" AS "a;b"') == ['SELECT ";" AS "a;b"']
+    assert _split_statements("  ;;  ") == []
