@@ -85,6 +85,14 @@ async def _handle(agent: "Agent", session: Session, msg: dict) -> None:
         sql = msg.get("schema_sql", "")
         changed = agent.store.apply_schema(sql) if sql else []
         await session.send({"reloaded": changed})
+    elif cmd == "metrics":
+        await session.send({"metrics": agent.metrics.snapshot()})
+    elif cmd == "trace":
+        await session.send(
+            {"spans": agent.tracer.recent(
+                limit=msg.get("limit", 100), name=msg.get("name")
+            )}
+        )
     else:
         await session.send({"error": f"unknown command {cmd!r}"})
     await session.send({"done": True})

@@ -31,11 +31,13 @@ from corrosion_tpu.core.bookkeeping import (
     generate_sync,
 )
 from corrosion_tpu.core.changes import chunk_changes
-from corrosion_tpu.core.hlc import HLC
+from corrosion_tpu.core.hlc import HLC, ts_physical_ms
 from corrosion_tpu.core.intervals import RangeSet
 from corrosion_tpu.core.values import Change, ExecResponse, ExecResult, Statement
 from corrosion_tpu.utils.locks import LockRegistry
+from corrosion_tpu.utils.metrics import MetricsRegistry
 from corrosion_tpu.utils.spawn import TaskRegistry
+from corrosion_tpu.utils.tracing import Tracer
 from corrosion_tpu.utils.tripwire import Tripwire
 
 
@@ -57,6 +59,24 @@ class AgentConfig:
     ingest_batch: int = 1000  # handle_changes batching (agent.rs:2450-2518)
     ingest_linger: float = 0.05
     admin_uds: str = ""  # unix socket path for admin RPC ("" = disabled)
+    tls: "AgentTls | None" = None  # gossip-plane TLS (None = plaintext)
+    prometheus_addr: str = ""  # host:port for /metrics ("" = disabled)
+    trace_export_path: str = ""  # JSON-lines span export ("" = in-memory)
+
+
+@dataclass
+class AgentTls:
+    """Gossip-plane TLS material (peer.rs:132-313; agent/tls.py builds the
+    contexts). ``mtls`` requires client certs on inbound and presents
+    ``client_cert``/``client_key`` on outbound."""
+
+    cert: str
+    key: str
+    ca: str | None = None
+    client_cert: str | None = None
+    client_key: str | None = None
+    mtls: bool = False
+    insecure: bool = False
 
 
 @dataclass
@@ -76,11 +96,42 @@ class Agent:
         self.actor_id = self.store.site_id.hex()
         self.bookie = Bookie()
         self.hlc = HLC()
-        self.transport = Transport()
+        if cfg.tls is not None:
+            from corrosion_tpu.agent import tls as tls_mod
+
+            self.transport = Transport(
+                ssl_server=tls_mod.server_ssl_context(
+                    cfg.tls.cert, cfg.tls.key, cfg.tls.ca,
+                    require_client_cert=cfg.tls.mtls,
+                ),
+                ssl_client=tls_mod.client_ssl_context(
+                    cfg.tls.ca, cfg.tls.client_cert, cfg.tls.client_key,
+                    insecure=cfg.tls.insecure,
+                ),
+            )
+        else:
+            self.transport = Transport()
         self.members = Members(self.actor_id)
         self.tasks = TaskRegistry()
         self.tripwire = Tripwire()
         self.lock_registry = LockRegistry()
+        self.metrics = MetricsRegistry()
+        self.tracer = Tracer(
+            service=f"corrosion-{self.actor_id[:8]}",
+            export_path=cfg.trace_export_path or None,
+        )
+        self._prom_server = None
+        # Hot-path metric handles, resolved once.
+        self._m_recv_lag = self.metrics.histogram(
+            "corro_broadcast_recv_lag_seconds",
+            "HLC age of received changesets (agent.rs:1238-1240)",
+        )
+        self._m_applied = self.metrics.counter(
+            "corro_changes_applied", "changesets applied to the store"
+        )
+        self._m_buffered = self.metrics.counter(
+            "corro_changes_buffered", "partial changesets buffered"
+        )
         self.store.lock_registry = self.lock_registry
         self._admin_server = None
         self.gossip_addr: tuple[str, int] | None = None
@@ -147,6 +198,14 @@ class Agent:
             from corrosion_tpu.agent.admin import start_admin
 
             await start_admin(self, self.cfg.admin_uds)
+        if self.cfg.prometheus_addr:
+            from corrosion_tpu.agent.config import parse_addr
+            from corrosion_tpu.utils.metrics import serve_prometheus
+
+            host, port = parse_addr(self.cfg.prometheus_addr)
+            self._prom_server, self.prometheus_addr = await serve_prometheus(
+                self.metrics, host, port
+            )
         for addr in self.cfg.bootstrap:
             await self.swim.announce(tuple(addr))
 
@@ -159,6 +218,9 @@ class Agent:
             self._api_server.close()
         if self._admin_server is not None:
             self._admin_server.close()
+        if self._prom_server is not None:
+            self._prom_server.close()
+        self.tracer.close()
         self.store.close()
 
     # -- write path (make_broadcastable_changes) ------------------------------
@@ -235,8 +297,19 @@ class Agent:
     # -- broadcast loop (broadcast/mod.rs:356-567) ----------------------------
 
     async def _broadcast_loop(self) -> None:
+        pending_gauge = self.metrics.gauge(
+            "corro_broadcast_pending", "pending-broadcast queue depth"
+        )
+        members_gauge = self.metrics.gauge(
+            "corro_gossip_members", "peers currently believed alive"
+        )
+        sent_ctr = self.metrics.counter(
+            "corro_broadcast_sent", "broadcast frames transmitted"
+        )
         while not self.tripwire.tripped:
             await asyncio.sleep(self.cfg.broadcast_interval)
+            pending_gauge.set(len(self._pending))
+            members_gauge.set(len(self.members.alive()))
             if not self._pending:
                 continue
             pending, self._pending = self._pending, []
@@ -257,6 +330,7 @@ class Agent:
                     await self.transport.send_frame(
                         m.addr, pb.frame
                     )
+                    sent_ctr.inc()
                 pb.tx_left -= 1
                 if pb.tx_left > 0:
                     self._pending.append(pb)
@@ -285,6 +359,7 @@ class Agent:
             self._process_changes(batch)
 
     def _process_changes(self, batch: list[tuple[dict, str]]) -> None:
+        now_ms = int(time.time() * 1000)
         for msg, source in batch:
             actor = msg["actor"]
             if actor == self.actor_id:
@@ -295,6 +370,10 @@ class Agent:
             booked = self.bookie.for_actor(actor)
             if booked.contains(version, seqs):
                 continue  # already known (agent.rs:1817-1843 dedupe)
+            self._m_recv_lag.observe(
+                max(now_ms - ts_physical_ms(msg["ts"]), 0) / 1000.0,
+                source=source,
+            )
             self.hlc.update_with_timestamp(msg["ts"])
             changes = [Change.from_tuple(tuple(t)) for t in msg["changes"]]
             complete = seqs[0] == 0 and seqs[1] >= last_seq
@@ -302,6 +381,7 @@ class Agent:
             if complete and not isinstance(known, Partial):
                 self._apply_complete(actor, version, changes, last_seq, msg["ts"])
             else:
+                self._m_buffered.inc(source=source)
                 self._buffer_partial(
                     actor, version, changes, seqs, last_seq, msg["ts"]
                 )
@@ -312,6 +392,7 @@ class Agent:
 
     def _apply_complete(self, actor, version, changes, last_seq, ts) -> None:
         self.store.apply_changes(changes)
+        self._m_applied.inc()
         booked = self.bookie.for_actor(actor)
         dbv = changes[0].db_version if changes else 0
         booked.insert(
@@ -397,18 +478,33 @@ class Agent:
         if not peers:
             return
         peers = peers[: self.cfg.sync_peers]
+        needs_gauge = self.metrics.gauge(
+            "corro_sync_needs", "version gaps at last sync generation"
+        )
+        sess_hist = self.metrics.histogram(
+            "corro_sync_client_seconds", "client-side sync session duration"
+        )
         for m in peers:
             # Regenerate per peer: changesets ingested from the previous
             # peer shrink what we ask the next one for (the reference's
             # scheduler dedups in-flight needs across peers,
             # peer.rs:1108-1223).
             my_state = generate_sync(self.bookie, self.actor_id)
+            needs_gauge.set(my_state.need_len())
+            # Cross-node trace propagation: the session span's traceparent
+            # travels in the wire protocol (SyncTraceContextV1, sync.rs:32-67
+            # injected peer.rs:941-944).
+            span = self.tracer.span("sync_client", peer=m.actor_id[:8])
+            span.__enter__()
+            t_start = time.monotonic()
             session = await self.transport.open_session(
                 m.addr,
                 {"t": "sync_start", "actor": self.actor_id,
-                 "clock": self.hlc.new_timestamp()},
+                 "clock": self.hlc.new_timestamp(),
+                 "trace": span.traceparent},
             )
             if session is None:
+                span.__exit__(None, None, None)
                 continue
             try:
                 reply = await session.recv(timeout=5.0)
@@ -439,27 +535,34 @@ class Agent:
                             booked.insert_many(s, e, CLEARED)
             finally:
                 session.close()
+                sess_hist.observe(time.monotonic() - t_start)
+                span.__exit__(None, None, None)
             # Let the ingest batcher absorb this peer's changesets before
             # computing the next peer's (smaller) request.
             await asyncio.sleep(self.cfg.ingest_linger * 2)
 
     async def _serve_sync(self, session: Session, start: dict) -> None:
-        """Server side (peer.rs:1289-1527)."""
-        self.hlc.update_with_timestamp(start.get("clock", 0))
-        state = generate_sync(self.bookie, self.actor_id)
-        await session.send(
-            {"t": "sync_state", "state": _state_to_wire(state),
-             "clock": self.hlc.new_timestamp()}
-        )
-        req = await session.recv(timeout=5.0)
-        if req and req.get("t") == "sync_request":
-            for actor, needs in _needs_from_wire(req["needs"]).items():
-                booked = self.bookie.get(actor)
-                if booked is None:
-                    continue
-                for need in needs:
-                    await self._serve_need(session, actor, booked, need)
-        await session.send({"t": "sync_done"})
+        """Server side (peer.rs:1289-1527). Continues the client's trace via
+        the frame's traceparent (extracted like peer.rs:1296-1298)."""
+        with self.tracer.span(
+            "sync_server", traceparent=start.get("trace"),
+            peer=str(start.get("actor", ""))[:8],
+        ):
+            self.hlc.update_with_timestamp(start.get("clock", 0))
+            state = generate_sync(self.bookie, self.actor_id)
+            await session.send(
+                {"t": "sync_state", "state": _state_to_wire(state),
+                 "clock": self.hlc.new_timestamp()}
+            )
+            req = await session.recv(timeout=5.0)
+            if req and req.get("t") == "sync_request":
+                for actor, needs in _needs_from_wire(req["needs"]).items():
+                    booked = self.bookie.get(actor)
+                    if booked is None:
+                        continue
+                    for need in needs:
+                        await self._serve_need(session, actor, booked, need)
+            await session.send({"t": "sync_done"})
 
     async def _serve_need(self, session, actor, booked, need) -> None:
         if isinstance(need, FullNeed):

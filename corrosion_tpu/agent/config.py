@@ -36,6 +36,16 @@ class GossipConfig:
     max_transmissions: int = 4
     probe_interval_ms: int = 250
     sync_interval_ms: int = 500
+    # TLS (config.rs GossipConfig.tls; flat here so the CORRO_GOSSIP__*
+    # env overlay reaches every knob — a nested [gossip.tls] table in the
+    # TOML maps onto these in Config.load).
+    tls_cert_file: str | None = None
+    tls_key_file: str | None = None
+    tls_ca_file: str | None = None
+    tls_insecure: bool = False
+    tls_mtls: bool = False  # require + verify client certs
+    tls_client_cert_file: str | None = None
+    tls_client_key_file: str | None = None
 
 
 @dataclass
@@ -84,6 +94,17 @@ class Config:
             ("log", cfg.log), ("consul", cfg.consul),
         ):
             for k, v in data.get(section, {}).items():
+                if k == "tls" and isinstance(v, dict):
+                    # [gossip.tls] nested table → flat tls_* fields.
+                    for tk, tv in v.items():
+                        if isinstance(tv, dict):  # [gossip.tls.client]
+                            for ck, cv in tv.items():
+                                flat = f"tls_{tk}_{ck}"
+                                if hasattr(obj, flat):
+                                    setattr(obj, flat, cv)
+                        elif hasattr(obj, f"tls_{tk}"):
+                            setattr(obj, f"tls_{tk}", tv)
+                    continue
                 if hasattr(obj, k):
                     setattr(obj, k, v)
         cfg._apply_env(env if env is not None else dict(os.environ))

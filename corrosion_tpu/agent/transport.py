@@ -161,12 +161,19 @@ async def read_frame(reader: asyncio.StreamReader) -> dict | None:
 
 
 class Transport:
-    """Pooled one-shot sender + session opener + inbound server."""
+    """Pooled one-shot sender + session opener + inbound server.
 
-    def __init__(self) -> None:
+    Optional TLS (agent/tls.py): pass an ``ssl.SSLContext`` for the server
+    (inbound gossip) and/or client (outbound) side — the rustls configs of
+    peer.rs:132-313. mTLS comes from the contexts themselves.
+    """
+
+    def __init__(self, ssl_server=None, ssl_client=None) -> None:
         self._pool: dict[tuple[str, int], tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
         self._locks: dict[tuple[str, int], asyncio.Lock] = {}
         self._server: asyncio.AbstractServer | None = None
+        self._ssl_server = ssl_server
+        self._ssl_client = ssl_client
 
     # -- outbound ------------------------------------------------------------
 
@@ -191,7 +198,7 @@ class Transport:
         """Dedicated connection for a sync exchange (bi-stream analogue)."""
         try:
             reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(*addr), timeout
+                asyncio.open_connection(*addr, ssl=self._ssl_client), timeout
             )
             writer.write(encode_frame(first))
             await writer.drain()
@@ -204,7 +211,7 @@ class Transport:
             self._drop(addr)
         if addr not in self._pool:
             reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(*addr), 5.0
+                asyncio.open_connection(*addr, ssl=self._ssl_client), 5.0
             )
             self._pool[addr] = (reader, writer)
         return self._pool[addr]
@@ -243,7 +250,9 @@ class Transport:
             finally:
                 session.close()
 
-        self._server = await asyncio.start_server(on_conn, host, port)
+        self._server = await asyncio.start_server(
+            on_conn, host, port, ssl=self._ssl_server
+        )
         sock = self._server.sockets[0].getsockname()
         return sock[0], sock[1]
 

@@ -62,6 +62,16 @@ def _build_parser() -> argparse.ArgumentParser:
 
     cs = sub.add_parser("consul", help="consul bridge")
     cs.add_argument("consul_cmd", choices=["sync"])
+
+    # command/tls.rs:1-94: `corrosion tls {ca,server,client} generate`
+    tl = sub.add_parser("tls", help="certificate generation")
+    tl.add_argument("tls_kind", choices=["ca", "server", "client"])
+    tl.add_argument("tls_cmd", choices=["generate"])
+    tl.add_argument("host", nargs="?", default=None,
+                    help="server SAN host (server generate)")
+    tl.add_argument("--dir", default=".", help="output directory")
+    tl.add_argument("--ca-dir", default=".",
+                    help="directory holding ca_cert.pem/ca_key.pem")
     return p
 
 
@@ -96,6 +106,22 @@ async def _dispatch(args, cfg: Config) -> int:
 
         site = restore(args.backup, args.db, self_actor_id=args.self_actor_id)
         print(f"restored {args.db} (actor {site.hex()})")
+        return 0
+    if args.command == "tls":
+        from corrosion_tpu.agent import tls as tls_mod
+
+        if args.tls_kind == "ca":
+            paths = tls_mod.generate_ca(args.dir)
+        elif args.tls_kind == "server":
+            if not args.host:
+                print("tls server generate requires a host", file=sys.stderr)
+                return 2
+            paths = tls_mod.generate_server_cert(
+                args.dir, args.ca_dir, args.host
+            )
+        else:
+            paths = tls_mod.generate_client_cert(args.dir, args.ca_dir)
+        print(f"wrote {paths.cert} and {paths.key}")
         return 0
     if args.command == "sync":
         frames = await _admin(cfg, {"c": "sync"})
@@ -136,6 +162,31 @@ async def _run_agent(cfg: Config) -> int:
 
     gossip_host, gossip_port = parse_addr(cfg.gossip.addr)
     api_host, api_port = parse_addr(cfg.api.addr)
+    tls_cfg = None
+    if not cfg.gossip.plaintext:
+        # Fail closed: demanding TLS without cert material is a config
+        # error, not a silent plaintext fallback.
+        if not (cfg.gossip.tls_cert_file and cfg.gossip.tls_key_file):
+            raise SystemExit(
+                "gossip.plaintext = false requires tls_cert_file and "
+                "tls_key_file ([gossip.tls] cert_file/key_file)"
+            )
+        from corrosion_tpu.agent.agent import AgentTls
+
+        tls_cfg = AgentTls(
+            cert=cfg.gossip.tls_cert_file,
+            key=cfg.gossip.tls_key_file,
+            ca=cfg.gossip.tls_ca_file,
+            client_cert=cfg.gossip.tls_client_cert_file,
+            client_key=cfg.gossip.tls_client_key_file,
+            mtls=cfg.gossip.tls_mtls,
+            insecure=cfg.gossip.tls_insecure,
+        )
+    elif cfg.gossip.tls_cert_file:
+        raise SystemExit(
+            "gossip TLS material configured but plaintext = true — set "
+            "gossip.plaintext = false to enable TLS"
+        )
     acfg = AgentConfig(
         data_dir=os.path.dirname(cfg.db.path) or ".",
         gossip_host=gossip_host,
@@ -148,6 +199,8 @@ async def _run_agent(cfg: Config) -> int:
         sync_interval=cfg.gossip.sync_interval_ms / 1000.0,
         max_transmissions=cfg.gossip.max_transmissions,
         admin_uds=cfg.admin.uds_path,
+        tls=tls_cfg,
+        prometheus_addr=cfg.telemetry.prometheus_addr or "",
     )
     agent = Agent(acfg)
     agent.subs = SubsManager(agent.store)

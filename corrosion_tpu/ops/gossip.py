@@ -89,14 +89,25 @@ class Topology(NamedTuple):
     writer_nodes: jax.Array  # i32[W] node hosting each writer stream
     writer_of_node: jax.Array  # i32[N] writer index or -1
     sync_phase: jax.Array  # i32[N] per-node jitter offset for sync cadence
+    # Balanced sync cohorts (None = unscheduled): row c lists the nodes
+    # whose sync timer fires when (round + phase) % interval == 0 lands on
+    # phase class c, padded with -1. With cohorts the whole sync round runs
+    # on cohort-sized tensors — a sync_interval× cut in per-round work.
+    sync_cohorts: jax.Array | None = None
 
 
 def make_topology(
-    region_sizes: list[int], writer_nodes, seed: int = 0, region_rtt=None
+    region_sizes: list[int], writer_nodes, seed: int = 0, region_rtt=None,
+    sync_interval: int | None = None,
 ) -> Topology:
     """Build a topology; ``region_rtt`` defaults to a ring-1 flat geography
     (everything near but not ring 0). Pass an [R, R] matrix of ring classes
-    0-5, or "geo" for a synthetic circle geography with graded rings."""
+    0-5, or "geo" for a synthetic circle geography with graded rings.
+
+    ``sync_interval`` (must match GossipConfig.sync_interval) switches the
+    sync plane to balanced cohorts: nodes are split into ``interval`` equal
+    phase classes, and each round only that round's class syncs, on
+    cohort-sized tensors."""
     import numpy as np
 
     n = int(sum(region_sizes))
@@ -126,7 +137,22 @@ def make_topology(
     writer_nodes = np.asarray(writer_nodes, np.int32)
     won = np.full(n, -1, np.int32)
     won[writer_nodes] = np.arange(len(writer_nodes), dtype=np.int32)
-    phase = np.random.default_rng(seed).integers(0, 1 << 30, n).astype(np.int32)
+    rng = np.random.default_rng(seed)
+    if sync_interval is None:
+        phase = rng.integers(0, 1 << 30, n).astype(np.int32)
+        cohorts = None
+    else:
+        # Balanced phases: every residue class gets ⌈n/interval⌉ or ⌊…⌋
+        # members; cohort row c = the nodes due when (round + phase) %
+        # interval == 0 selects class c, i.e. phase == c.
+        perm = rng.permutation(n).astype(np.int32)
+        phase = np.empty(n, np.int32)
+        phase[perm] = np.arange(n, dtype=np.int32) % sync_interval
+        nc = -(-n // sync_interval)  # ceil
+        cohorts = np.full((sync_interval, nc), -1, np.int32)
+        for c in range(sync_interval):
+            members = np.nonzero(phase == c)[0].astype(np.int32)
+            cohorts[c, : len(members)] = members
     return Topology(
         region=jnp.asarray(region),
         region_start=jnp.asarray(rstart),
@@ -135,6 +161,7 @@ def make_topology(
         writer_nodes=jnp.asarray(writer_nodes),
         writer_of_node=jnp.asarray(won),
         sync_phase=jnp.asarray(phase),
+        sync_cohorts=None if cohorts is None else jnp.asarray(cohorts),
     )
 
 
@@ -417,76 +444,113 @@ def sync_round(
     rng: jax.Array,
     cfg: GossipConfig,
 ) -> tuple[DataState, dict]:
-    """Anti-entropy pull sessions for nodes whose jittered timer is due.
+    """Anti-entropy pull sessions for nodes whose sync timer is due.
 
-    Need-aware multi-peer selection, mirroring the reference's sync peer
-    choice (corro-agent/src/agent.rs:2383-2423): score ``sync_candidates``
-    sampled peers (half ring-0/same-region, half cluster-wide) by how many
-    versions they hold that we lack (need desc), tie-break toward ring 0
-    (ring asc), and pull from the top ``sync_peers`` under one shared
-    session budget — the reference's 3-10 peers ordered by need.
+    With cohort scheduling (make_topology(sync_interval=...)) the round's
+    due set is one statically-shaped cohort and every tensor in the session
+    is cohort-sized — a sync_interval× cut in work and memory vs computing
+    over all N rows. Without cohorts, all N rows are processed with a due
+    mask (the jittered-phase model).
     """
-    n = cfg.n_nodes
-    nodes = jnp.arange(n)
-    k_near, k_far = jax.random.split(rng)
+    if topo.sync_cohorts is not None:
+        if topo.sync_cohorts.shape[0] != cfg.sync_interval:
+            raise ValueError(
+                f"topology cohorts were built for sync_interval="
+                f"{topo.sync_cohorts.shape[0]} but cfg.sync_interval="
+                f"{cfg.sync_interval}; rebuild make_topology with the "
+                f"matching interval"
+            )
+        cohort = jnp.mod(-round_idx, jnp.int32(cfg.sync_interval))
+        rows = topo.sync_cohorts[cohort]  # i32[R], -1 padded
+        row_ok = (rows >= 0) & alive[jnp.maximum(rows, 0)]
+        return _sync_rows(
+            data, topo, alive, partition, jnp.maximum(rows, 0), row_ok,
+            rng, cfg,
+        )
+    nodes = jnp.arange(cfg.n_nodes)
     due = alive & (
         (round_idx + topo.sync_phase) % jnp.int32(cfg.sync_interval) == 0
     )
+    return _sync_rows(data, topo, alive, partition, nodes, due, rng, cfg)
+
+
+def _sync_rows(
+    data: DataState,
+    topo: Topology,
+    alive: jax.Array,
+    partition: jax.Array,
+    rows: jax.Array,  # i32[R] node id per participating row (unique)
+    row_ok: jax.Array,  # bool[R] live + unpadded
+    rng: jax.Array,
+    cfg: GossipConfig,
+) -> tuple[DataState, dict]:
+    """One anti-entropy session per row (corro-agent/src/agent.rs:2383-2423
+    peer choice; peer.rs:925-1286 parallel_sync): score ``sync_candidates``
+    sampled peers (half ring-0/same-region, half cluster-wide) by how many
+    versions they hold that we lack (need desc), tie-break toward ring 0
+    (ring asc), and pull from the top ``sync_peers`` under one shared
+    session budget — the reference's 3-10 peers ordered by need."""
+    n = cfg.n_nodes
+    r = rows.shape[0]
+    k_near, k_far = jax.random.split(rng)
+    region_r = topo.region[rows]
+    contig0 = data.contig[rows]  # u32[R, W]
+    seen_r = data.seen[rows]
 
     # Candidate sample: same-region ("ring 0") and uniform far peers.
     c_near = cfg.sync_candidates // 2
     c_far = cfg.sync_candidates - c_near
-    near = topo.region_start[:, None] + jax.random.randint(
-        k_near, (n, c_near), 0, 1 << 30
-    ) % jnp.maximum(topo.region_size[:, None], 1)
-    far = jax.random.randint(k_far, (n, c_far), 0, n)
-    cand = jnp.concatenate([near, far], axis=1)  # i32[N, C]
+    near = topo.region_start[rows][:, None] + jax.random.randint(
+        k_near, (r, c_near), 0, 1 << 30
+    ) % jnp.maximum(topo.region_size[rows][:, None], 1)
+    far = jax.random.randint(k_far, (r, c_far), 0, n)
+    cand = jnp.concatenate([near, far], axis=1)  # i32[R, C]
     ok_c = (
-        due[:, None]
+        row_ok[:, None]
         & alive[cand]
-        & (cand != nodes[:, None])
-        & ~partition[topo.region[:, None], topo.region[cand]]
+        & (cand != rows[:, None])
+        & ~partition[region_r[:, None], topo.region[cand]]
     )
 
     # Candidate need scoring. Exact mode computes, per candidate, the count
-    # of versions the candidate holds that we lack — an [N, W] transient per
-    # candidate, too much HBM at N = W = 10k+ — so large configs use a
-    # total-progress digest instead (sum of watermarks, like ranking peers
-    # by advertised heads). Selection is heuristic either way; the grant
-    # loop below recomputes the exact deficit for the chosen peers.
+    # of versions the candidate holds that we lack — an [R, W] transient per
+    # candidate — while very large row counts fall back to a total-progress
+    # digest (ranking peers by advertised heads). Selection is heuristic
+    # either way; the grant loop below recomputes the exact deficit for the
+    # chosen peers. Cohorts keep R = N / sync_interval, so even the 100k
+    # config scores exactly.
     c_count = cfg.sync_candidates
-    exact = cfg.n_nodes * cfg.n_writers * c_count <= (1 << 27)
-    seen = data.seen
+    exact = r * cfg.n_writers * c_count <= (1 << 27)
     need_cols = []
-    total = None if exact else jnp.sum(data.contig, axis=1, dtype=jnp.uint32)
+    total = None
+    if not exact:
+        total = jnp.sum(data.contig, axis=1, dtype=jnp.uint32)
+        total_r = total[rows]
     for c in range(c_count):
         if exact:
-            cc = data.contig[cand[:, c]]  # [N, W]
+            cc = data.contig[cand[:, c]]  # [R, W]
             need_cols.append(
                 jnp.sum(
-                    (cc - jnp.minimum(cc, data.contig)).astype(jnp.uint32),
+                    (cc - jnp.minimum(cc, contig0)).astype(jnp.uint32),
                     axis=-1,
                     dtype=jnp.int32,
                 )
             )
+            # Scoring reads the candidate's state — that digest also carries
+            # its heads, so adopt them (the reference learns heads from every
+            # SyncState exchange, not only from peers it pulls from).
+            seen_r = jnp.maximum(
+                seen_r, jnp.where(ok_c[:, c, None], data.seen[cand[:, c]], 0)
+            )
         else:
             tc = total[cand[:, c]]
             need_cols.append(
-                jnp.maximum(tc - jnp.minimum(tc, total), 0).astype(jnp.int32)
+                jnp.maximum(tc - jnp.minimum(tc, total_r), 0).astype(jnp.int32)
             )
-        if exact:
-            # Scoring reads the candidate's state — that digest also carries
-            # its heads, so adopt them (the reference learns heads from every
-            # SyncState exchange, not only from peers it pulls from). In
-            # digest mode this [N, W] gather per candidate is the memory
-            # blowup we are avoiding; selected peers still share heads below.
-            seen = jnp.maximum(
-                seen, jnp.where(ok_c[:, c, None], data.seen[cand[:, c]], 0)
-            )
-    defc = jnp.stack(need_cols, axis=1)  # i32[N, C]
+    defc = jnp.stack(need_cols, axis=1)  # i32[R, C]
 
     # RTT ring of each candidate (members.rs:33 buckets via region pairs).
-    ring = topo.region_rtt[topo.region[:, None], topo.region[cand]]
+    ring = topo.region_rtt[region_r[:, None], topo.region[cand]]
     # Candidates are sampled with replacement; mask duplicate columns so a
     # single peer cannot occupy several of the top slots (and soak up
     # sync_peers x chunk from one source).
@@ -499,45 +563,49 @@ def sync_round(
     # ordering only breaks need ties.
     score = jnp.where(ok_c & ~dup & (defc > 0), defc * 8 + (5 - ring), -1)
     order = jnp.argsort(-score, axis=1, stable=True)[:, : cfg.sync_peers]
-    sel = jnp.take_along_axis(cand, order, axis=1)  # i32[N, S]
+    sel = jnp.take_along_axis(cand, order, axis=1)  # i32[R, S]
     sel_ok = jnp.take_along_axis(score, order, axis=1) > 0
 
     # Pull from selected peers in need order under one shared budget.
-    contig = data.contig
-    budget_left = jnp.full((n,), cfg.sync_budget, jnp.int32)
+    contig_r = contig0
+    budget_left = jnp.full((r,), cfg.sync_budget, jnp.int32)
     for s in range(cfg.sync_peers):
         p = sel[:, s]
         ok_s = sel_ok[:, s]
-        p_contig = data.contig[p]  # [N, W]
-        deficit = (p_contig - jnp.minimum(p_contig, contig)).astype(jnp.uint32)
-        per_w = jnp.minimum(deficit, jnp.uint32(cfg.sync_chunk)).astype(jnp.int32)
+        p_contig = data.contig[p]  # [R, W]
+        deficit = (p_contig - jnp.minimum(p_contig, contig_r)).astype(
+            jnp.uint32
+        )
+        per_w = jnp.minimum(deficit, jnp.uint32(cfg.sync_chunk)).astype(
+            jnp.int32
+        )
         per_w = jnp.where(ok_s[:, None], per_w, 0)
         cum = jnp.cumsum(per_w, axis=1)
         grant = jnp.clip(
             budget_left[:, None] - (cum - per_w), 0, per_w
         ).astype(jnp.uint32)
-        contig = contig + grant
+        contig_r = contig_r + grant
         budget_left = budget_left - jnp.sum(grant, axis=1, dtype=jnp.int32)
         if not exact:
-            seen = jnp.maximum(
-                seen, jnp.where(ok_s[:, None], data.seen[p], 0)
+            seen_r = jnp.maximum(
+                seen_r, jnp.where(ok_s[:, None], data.seen[p], 0)
             )
-    seen = jnp.maximum(seen, contig)
+    seen_r = jnp.maximum(seen_r, contig_r)
 
     cells = data.cells
     n_merges = jnp.uint32(0)
     if cfg.n_cells > 0:
-        # Materialize every granted version: enumerate the per-(node, writer)
+        # Materialize every granted version: enumerate the per-(row, writer)
         # grant ranges into flat (node, writer, version) triples — the
         # changeset replay the server streams in the reference
         # (peer.rs:610-666) — and scatter-merge their derived cells.
-        gr = (contig - data.contig).astype(jnp.int32)  # [N, W]
-        cum = jnp.cumsum(gr, axis=1)  # [N, W]
-        total = cum[:, -1]  # [N] <= sync_budget
+        gr = (contig_r - contig0).astype(jnp.int32)  # [R, W]
+        cum = jnp.cumsum(gr, axis=1)  # [R, W]
+        total_g = cum[:, -1]  # [R] <= sync_budget
         e = jnp.arange(cfg.sync_budget, dtype=jnp.int32)  # [B]
         w_idx = jax.vmap(
             lambda c: jnp.searchsorted(c, e, side="right")
-        )(cum)  # [N, B] writer owning granted unit e
+        )(cum)  # [R, B] writer owning granted unit e
         w_idx = jnp.minimum(w_idx, cfg.n_writers - 1)
         prev = jnp.where(
             w_idx > 0,
@@ -545,23 +613,32 @@ def sync_round(
             0,
         )
         ver = (
-            jnp.take_along_axis(data.contig, w_idx, axis=1)
+            jnp.take_along_axis(contig0, w_idx, axis=1)
             + 1
             + (e[None, :] - prev).astype(jnp.uint32)
         )
-        mask = e[None, :] < total[:, None]  # [N, B]
+        mask = e[None, :] < total_g[:, None]  # [R, B]
         cells, n_merges = _merge_versions(
             cells,
-            jnp.repeat(nodes, cfg.sync_budget),
+            jnp.repeat(rows, cfg.sync_budget),
             w_idx.reshape(-1).astype(jnp.uint32),
             ver.reshape(-1),
             mask.reshape(-1),
             cfg,
         )
 
+    # Scatter the session results back into the full tables; rows that did
+    # not participate keep their state (dropped writes).
+    idx = jnp.where(row_ok, rows, n)
+    contig = data.contig.at[idx].set(contig_r, mode="drop")
+    seen = data.seen.at[idx].max(seen_r, mode="drop")
+
     stats = {
-        "applied_sync": jnp.sum(contig - data.contig, dtype=jnp.uint32),
-        # Due nodes with at least one reachable candidate (whether or not
+        "applied_sync": jnp.sum(
+            jnp.where(row_ok[:, None], contig_r - contig0, 0),
+            dtype=jnp.uint32,
+        ),
+        # Due rows with at least one reachable candidate (whether or not
         # any need was found) — matches the pre-multi-peer meaning.
         "sessions": jnp.sum(jnp.any(ok_c, axis=1)),
         "cell_merges": n_merges,

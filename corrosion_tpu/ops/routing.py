@@ -44,7 +44,10 @@ def bounded_intake(
     run_first = jnp.where(
         jnp.concatenate([jnp.array([True]), s_key[1:] != s_key[:-1]]), idxs, 0
     )
-    run_first = jax.lax.associative_scan(jnp.maximum, run_first)
+    # lax.cummax, not associative_scan: the latter's recursive odd/even
+    # decomposition makes XLA:TPU compile time explode at multi-million
+    # element sizes (the 100k-node configs), while cummax lowers flat.
+    run_first = jax.lax.cummax(run_first, axis=0)
     rank = idxs - run_first
     ok = (s_key < n_rows) & (rank < k)
     slot = jnp.where(ok, s_key * k + rank, n_rows * k)
@@ -69,17 +72,19 @@ def segmented_prefix_and(flags: jax.Array, seg_start: jax.Array) -> jax.Array:
     """Per-segment running AND of ``flags`` (segments marked by seg_start).
 
     out[i] = AND of flags[j] for j from the segment's first element to i.
-    Classic segmented-scan combine, associative:
-      (f1, s1) ⊕ (f2, s2) = (f2 if s2 else f1 & f2, s1 | s2)
+    Expressed with cummax + cumsum instead of a segmented associative_scan
+    (whose recursive lowering blows up XLA:TPU compile time at the
+    multi-million element sizes of the 100k-node configs): the AND holds
+    iff no False occurs between the segment start and i.
     """
-
-    def combine(a, b):
-        f1, s1 = a
-        f2, s2 = b
-        return jnp.where(s2, f2, f1 & f2), s1 | s2
-
-    out, _ = jax.lax.associative_scan(combine, (flags, seg_start))
-    return out
+    m = flags.shape[0]
+    if m == 0:
+        return flags
+    idx = jnp.arange(m)
+    start = jax.lax.cummax(jnp.where(seg_start, idx, 0), axis=0)
+    bad = jnp.cumsum((~flags).astype(jnp.int32))  # inclusive False count
+    bad_before = bad[start] - (~flags[start]).astype(jnp.int32)
+    return (bad - bad_before) == 0
 
 
 def rebuild_bounded_queue(

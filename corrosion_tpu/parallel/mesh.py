@@ -46,6 +46,10 @@ def shard_topology(topo: Topology, mesh: Mesh, axis: str = "nodes") -> Topology:
         writer_nodes=_put(topo.writer_nodes, mesh, r),
         writer_of_node=_put(topo.writer_of_node, mesh, n),
         sync_phase=_put(topo.sync_phase, mesh, n),
+        sync_cohorts=(
+            None if topo.sync_cohorts is None
+            else _put(topo.sync_cohorts, mesh, r)
+        ),
     )
 
 

@@ -1,0 +1,247 @@
+"""Metrics facade + Prometheus text exposition.
+
+The reference uses the `metrics` crate with a Prometheus exporter (custom
+histogram buckets 1 ms-60 s, corrosion/src/command/agent.rs:65-85) and ~45
+documented series (doc/telemetry/prometheus.md). This module provides the
+same shape: process-local registries of counters/gauges/histograms with
+label sets, rendered in the Prometheus text format, served by a tiny
+asyncio HTTP endpoint when `[telemetry] prometheus_addr` is configured.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from dataclasses import dataclass, field
+
+# command/agent.rs:70-80: 1 ms … 60 s
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _label_key(labels: dict[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str = ""
+    _values: dict[tuple, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# TYPE {self.name} counter"]
+        if self.help:
+            out.insert(0, f"# HELP {self.name} {self.help}")
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+        if len(out) <= (2 if self.help else 1):
+            out.append(f"{self.name} 0")
+        return out
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str = ""
+    _values: dict[tuple, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def add(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def get(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# TYPE {self.name} gauge"]
+        if self.help:
+            out.insert(0, f"# HELP {self.name} {self.help}")
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+        if len(out) <= (2 if self.help else 1):
+            out.append(f"{self.name} 0")
+        return out
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str = ""
+    buckets: tuple = DEFAULT_BUCKETS
+    _counts: dict[tuple, list] = field(default_factory=dict)
+    _sums: dict[tuple, float] = field(default_factory=dict)
+    _totals: dict[tuple, int] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, **labels: str) -> int:
+        return self._totals.get(_label_key(labels), 0)
+
+    def quantile(self, q: float, **labels: str) -> float:
+        """Approximate quantile from bucket boundaries (diagnostics)."""
+        key = _label_key(labels)
+        total = self._totals.get(key, 0)
+        if total == 0:
+            return float("nan")
+        target = q * total
+        for i, b in enumerate(self.buckets):
+            if self._counts[key][i] >= target:
+                return b
+        return float("inf")
+
+    def render(self) -> list[str]:
+        out = [f"# TYPE {self.name} histogram"]
+        if self.help:
+            out.insert(0, f"# HELP {self.name} {self.help}")
+        for key in sorted(self._totals):
+            for i, b in enumerate(self.buckets):
+                lk = key + (("le", f"{b:g}"),)
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(lk)} "
+                    f"{self._counts[key][i]}"
+                )
+            lk = key + (("le", "+Inf"),)
+            out.append(
+                f"{self.name}_bucket{_fmt_labels(lk)} {self._totals[key]}"
+            )
+            out.append(
+                f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]:g}"
+            )
+            out.append(
+                f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}"
+            )
+        return out
+
+
+class MetricsRegistry:
+    """Per-agent metric registry (the `metrics` facade role)."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help))
+
+    def histogram(
+        self, name: str, help: str = "", buckets: tuple = DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help, buckets))
+
+    def _get(self, name: str, mk):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = mk()
+            return m
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """Flat dict for the admin RPC / tests."""
+        out: dict[str, float] = {}
+        for name, m in self._metrics.items():
+            if isinstance(m, (Counter, Gauge)):
+                for key, v in m._values.items():
+                    out[name + _fmt_labels(key)] = v
+            elif isinstance(m, Histogram):
+                for key, t in m._totals.items():
+                    out[name + "_count" + _fmt_labels(key)] = t
+                    out[name + "_sum" + _fmt_labels(key)] = m._sums[key]
+        return out
+
+
+async def serve_prometheus(
+    registry: MetricsRegistry, host: str, port: int
+) -> tuple[asyncio.AbstractServer, tuple[str, int]]:
+    """Minimal GET /metrics endpoint (setup_prometheus, command/agent.rs:65)."""
+
+    async def on_conn(reader: asyncio.StreamReader, writer):
+        try:
+            line = await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            body = registry.render().encode()
+            status = (
+                b"HTTP/1.1 200 OK\r\n"
+                if b"/metrics" in line or b"GET / " in line
+                else b"HTTP/1.1 404 Not Found\r\n"
+            )
+            writer.write(
+                status
+                + b"content-type: text/plain; version=0.0.4\r\n"
+                + f"content-length: {len(body)}\r\n\r\n".encode()
+                + (body if status.startswith(b"HTTP/1.1 200") else b"")
+            )
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    server = await asyncio.start_server(on_conn, host, port)
+    sock = server.sockets[0].getsockname()
+    return server, (sock[0], sock[1])
+
+
+class StepTimer:
+    """Wall-clock section timer feeding a histogram (tokio-metrics role)."""
+
+    def __init__(self, hist: Histogram, **labels: str) -> None:
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self._t0, **self.labels)
+        return False

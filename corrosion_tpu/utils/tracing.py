@@ -1,0 +1,152 @@
+"""Span tracing with cross-node propagation.
+
+The reference instruments everything with `tracing` spans and exports OTLP
+(corrosion/src/main.rs:64-117); sync sessions carry W3C traceparent inside
+the wire protocol (SyncTraceContextV1, corro-types/src/sync.rs:32-67,
+injected peer.rs:941-944, extracted peer.rs:1296-1298). This module is the
+in-process analogue: explicit span context managers backed by contextvars,
+a bounded in-memory ring of finished spans (plus an optional JSON-lines
+file export — there is no egress for a collector), and W3C
+traceparent strings for carrying trace context across agents in sync
+frames.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "corro_current_span", default=None
+)
+
+
+@dataclass
+class Span:
+    tracer: "Tracer"
+    name: str
+    trace_id: str  # 32 hex chars
+    span_id: str  # 16 hex chars
+    parent_id: str | None
+    attrs: dict = field(default_factory=dict)
+    start_ns: int = 0
+    end_ns: int = 0
+    _token: object = None
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def __enter__(self) -> "Span":
+        self.start_ns = time.time_ns()
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.end_ns = time.time_ns()
+        if exc_type is not None:
+            self.attrs["error"] = repr(exc)
+        _current_span.reset(self._token)
+        self.tracer._record(self)
+        return False
+
+    @property
+    def traceparent(self) -> str:
+        """W3C traceparent header value (version 00, sampled)."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def to_json_obj(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_ns": self.start_ns,
+            "duration_us": (self.end_ns - self.start_ns) // 1000,
+            "attrs": self.attrs,
+        }
+
+
+class Tracer:
+    """Per-agent tracer: bounded finished-span ring + optional file export."""
+
+    def __init__(
+        self, service: str = "corrosion-tpu", capacity: int = 4096,
+        export_path: str | None = None,
+    ) -> None:
+        self.service = service
+        self.finished: deque[Span] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._export_path = export_path
+        self._export_f = None
+
+    def span(self, name: str, traceparent: str | None = None, **attrs) -> Span:
+        """Open a span. Parentage: explicit ``traceparent`` (remote
+        continuation) > ambient current span > fresh trace."""
+        parent = _current_span.get()
+        if traceparent is not None:
+            ctx = parse_traceparent(traceparent)
+            trace_id = ctx[0] if ctx else os.urandom(16).hex()
+            parent_id = ctx[1] if ctx else None
+        elif parent is not None:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        else:
+            trace_id = os.urandom(16).hex()
+            parent_id = None
+        return Span(
+            tracer=self,
+            name=name,
+            trace_id=trace_id,
+            span_id=os.urandom(8).hex(),
+            parent_id=parent_id,
+            attrs=dict(attrs),
+        )
+
+    def current_traceparent(self) -> str | None:
+        span = _current_span.get()
+        return span.traceparent if span is not None else None
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self.finished.append(span)
+            if self._export_path is not None:
+                if self._export_f is None:
+                    self._export_f = open(self._export_path, "a")
+                self._export_f.write(
+                    json.dumps(span.to_json_obj(), default=str) + "\n"
+                )
+                self._export_f.flush()
+
+    def recent(self, limit: int = 100, name: str | None = None) -> list[dict]:
+        with self._lock:
+            spans = list(self.finished)
+        if name is not None:
+            spans = [s for s in spans if s.name == name]
+        return [s.to_json_obj() for s in spans[-limit:]]
+
+    def close(self) -> None:
+        if self._export_f is not None:
+            self._export_f.close()
+            self._export_f = None
+
+
+def parse_traceparent(value: str) -> tuple[str, str] | None:
+    """(trace_id, span_id) from a W3C traceparent, or None if malformed."""
+    parts = value.split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, _flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id

@@ -1,0 +1,70 @@
+"""Per-kernel compile/step timing at a given N (bisection for the 100k path).
+
+Usage: compile_bisect.py [n] [stage]
+  stage: swim | bcast | sync | all (default all)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu import models
+from corrosion_tpu.ops import gossip as gossip_ops
+from corrosion_tpu.ops import swim as swim_ops
+
+
+def timed(label, fn):
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t1 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t2 = time.perf_counter()
+    print(
+        f"[{label}] compile+first={t1 - t0:.1f}s step={(t2 - t1) * 1000:.0f}ms",
+        flush=True,
+    )
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    stage = sys.argv[2] if len(sys.argv) > 2 else "all"
+    cfg, topo, sched = models.wan_100k(n=n, rounds=4, samples=16)
+    print(f"platform={jax.devices()[0].platform} n={n}", flush=True)
+    key = jax.random.PRNGKey(0)
+
+    if stage in ("swim", "all"):
+        impl = swim_ops.impl(cfg.swim)
+        sw = impl.init_state(cfg.swim)
+        timed("swim", lambda: impl.swim_round(sw, key, jnp.int32(0), cfg.swim))
+
+    if stage in ("bcast", "sync", "all"):
+        data = gossip_ops.init_data(cfg.gossip)
+        alive = jnp.ones(cfg.n_nodes, bool)
+        n_regions = int(np.asarray(topo.region).max()) + 1
+        part = jnp.zeros((n_regions, n_regions), bool)
+        if stage in ("bcast", "all"):
+            writes = jnp.asarray(sched.writes[0], jnp.uint32)
+            timed(
+                "bcast",
+                lambda: gossip_ops.broadcast_round(
+                    data, topo, alive, part, writes, key, cfg.gossip
+                ),
+            )
+        if stage in ("sync", "all"):
+            timed(
+                "sync",
+                lambda: gossip_ops.sync_round(
+                    data, topo, alive, part, jnp.int32(0), key, cfg.gossip
+                ),
+            )
+
+
+if __name__ == "__main__":
+    main()

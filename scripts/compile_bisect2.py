@@ -1,0 +1,78 @@
+"""Micro-bisection: compile each sparse-SWIM sub-operation at N on TPU."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.ops import routing, swim_sparse
+from corrosion_tpu.ops.swim import SwimConfig
+
+
+def timed(label, fn):
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t1 = time.perf_counter()
+    print(f"[{label}] compile+first={t1 - t0:.1f}s", flush=True)
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    which = sys.argv[2] if len(sys.argv) > 2 else "all"
+    cfg = SwimConfig(n_nodes=n, view_capacity=64)
+    g, u, k = cfg.gossip_fanout, cfg.backlog, cfg.view_capacity
+    m = n * g * u
+    key = jax.random.PRNGKey(0)
+    print(f"platform={jax.devices()[0].platform} n={n} m={m}", flush=True)
+
+    if which in ("intake", "all"):
+        recv = jax.random.randint(key, (m,), 0, n)
+        valid = jnp.ones((m,), bool)
+        tgt = jax.random.randint(key, (m,), 0, n)
+        pkd = jax.random.randint(key, (m,), 0, 1000).astype(jnp.uint32)
+        f = jax.jit(
+            lambda r, v, t, p: routing.bounded_intake(r, v, (t, p), n, g * u)
+        )
+        timed("bounded_intake", lambda: f(recv, valid, tgt, pkd))
+
+    if which in ("merge", "all"):
+        st = swim_sparse.init_state(cfg)
+        tgts = jax.random.randint(key, (n, g * u), 0, n)
+        pkds = jax.random.randint(key, (n, g * u), 0, 1000).astype(jnp.uint32)
+        valids = jnp.ones((n, g * u), bool)
+        f = jax.jit(swim_sparse._merge_scan)
+        timed(
+            "merge_scan48",
+            lambda: f(st.exc_tgt, st.exc_pkd, tgts, pkds, valids),
+        )
+
+    if which in ("one", "all"):
+        st = swim_sparse.init_state(cfg)
+        t1 = jax.random.randint(key, (n,), 0, n)
+        p1 = jax.random.randint(key, (n,), 0, 1000).astype(jnp.uint32)
+        f = jax.jit(swim_sparse._merge_one)
+        timed(
+            "merge_one",
+            lambda: f(st.exc_tgt, st.exc_pkd, t1, p1, jnp.ones((n,), bool)),
+        )
+
+    if which in ("rebuild", "all"):
+        c = k + 60
+        co = jnp.ones((n, c), bool)
+        cx = jax.random.randint(key, (n, c), 0, 6)
+        ct = jax.random.randint(key, (n, c), 0, n)
+        cp = jax.random.randint(key, (n, c), 0, 1000).astype(jnp.uint32)
+        f = jax.jit(
+            lambda co, cx, ct, cp: routing.rebuild_bounded_queue(
+                co, cx, (ct, cp, cx), u
+            )
+        )
+        timed("rebuild_queue", lambda: f(co, cx, ct, cp))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,206 @@
+"""Tracing + metrics subsystem tests.
+
+Covers the §5 observability surface: metric registry semantics, Prometheus
+text exposition over HTTP, span parentage, W3C traceparent propagation
+through a real 2-agent sync session (the SyncTraceContextV1 behavior,
+sync.rs:32-67), the HLC-lag histogram, and the admin RPC metrics/trace
+commands.
+"""
+
+import asyncio
+import urllib.request
+
+import pytest
+
+from corrosion_tpu.utils import metrics as M
+from corrosion_tpu.utils import tracing as T
+from corrosion_tpu.agent.testing import launch_test_agent, poll_until
+from corrosion_tpu.core.values import Statement
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_counter_gauge_histogram_render():
+    reg = M.MetricsRegistry()
+    c = reg.counter("corro_test_total", "help text")
+    c.inc()
+    c.inc(2, source="sync")
+    g = reg.gauge("corro_depth")
+    g.set(3)
+    g.add(2)
+    h = reg.histogram("corro_lat_seconds")
+    for v in (0.002, 0.02, 0.2, 2.0):
+        h.observe(v)
+    text = reg.render()
+    assert "# TYPE corro_test_total counter" in text
+    assert "corro_test_total 1" in text
+    assert 'corro_test_total{source="sync"} 2' in text
+    assert "corro_depth 5" in text
+    assert 'corro_lat_seconds_bucket{le="0.0025"} 1' in text
+    assert 'corro_lat_seconds_bucket{le="+Inf"} 4' in text
+    assert "corro_lat_seconds_count 4" in text
+    assert h.count() == 4
+    assert h.quantile(0.5) <= 0.1
+    # Same name returns the same metric (facade semantics).
+    assert reg.counter("corro_test_total") is c
+
+
+def test_prometheus_http_endpoint():
+    async def main():
+        reg = M.MetricsRegistry()
+        reg.counter("corro_up").inc()
+        server, (host, port) = await M.serve_prometheus(reg, "127.0.0.1", 0)
+        try:
+            body = await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics"
+                ).read().decode()
+            )
+            assert "corro_up 1" in body
+        finally:
+            server.close()
+
+    run(main())
+
+
+def test_span_parentage_and_traceparent():
+    tr = T.Tracer()
+    with tr.span("outer", kind="test") as outer:
+        assert tr.current_traceparent() == outer.traceparent
+        with tr.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    spans = tr.recent()
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    assert spans[0]["attrs"] == {}
+    assert spans[1]["attrs"] == {"kind": "test"}
+
+    # Remote continuation via traceparent string.
+    tp = outer.traceparent
+    with tr.span("remote", traceparent=tp) as remote:
+        assert remote.trace_id == outer.trace_id
+        assert remote.parent_id == outer.span_id
+
+
+def test_traceparent_parsing():
+    ok = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+    assert T.parse_traceparent(ok) == ("a" * 32, "b" * 16)
+    for bad in (
+        "", "garbage", "00-short-span-01",
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # all-zero trace id
+        "00-" + "z" * 32 + "-" + "b" * 16 + "-01",  # non-hex
+    ):
+        assert T.parse_traceparent(bad) is None
+
+
+def test_span_records_errors():
+    tr = T.Tracer()
+    with pytest.raises(RuntimeError):
+        with tr.span("failing"):
+            raise RuntimeError("boom")
+    (span,) = tr.recent()
+    assert "boom" in span["attrs"]["error"]
+
+
+def test_trace_export_file(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tr = T.Tracer(export_path=path)
+    with tr.span("exported"):
+        pass
+    tr.close()
+    import json
+
+    lines = [json.loads(x) for x in open(path)]
+    assert lines[0]["name"] == "exported"
+    assert lines[0]["duration_us"] >= 0
+
+
+def test_agents_propagate_trace_and_count_metrics(tmp_path):
+    """2-agent cluster: a sync session's server span must continue the
+    client's trace (same trace_id); HLC-lag histogram and applied counters
+    must tick; admin metrics/trace commands must serve them."""
+
+    async def main():
+        a = await launch_test_agent(
+            str(tmp_path / "a"), admin_uds=str(tmp_path / "a.sock"),
+            sync_interval=0.3,
+        )
+        b = await launch_test_agent(
+            str(tmp_path / "b"), bootstrap=[a.gossip_addr],
+            sync_interval=0.3,
+        )
+        try:
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'obs')"]]
+            )
+
+            async def converged():
+                _, rows = b.agent.store.query(
+                    Statement("SELECT count(*) FROM tests")
+                )
+                return rows[0][0] == 1
+
+            await poll_until(converged, timeout=20)
+
+            # Give at least one full sync session time to complete.
+            async def has_server_span():
+                return [
+                    s for s in b.agent.tracer.recent(name="sync_server")
+                ] or [s for s in a.agent.tracer.recent(name="sync_server")]
+
+            server_spans = await poll_until(has_server_span, timeout=20)
+            all_client = (
+                a.agent.tracer.recent(name="sync_client")
+                + b.agent.tracer.recent(name="sync_client")
+            )
+            client_traces = {s["trace_id"] for s in all_client}
+            assert any(
+                s["trace_id"] in client_traces and s["parent_id"]
+                for s in server_spans
+            ), "server sync span must continue a client trace"
+
+            # HLC lag histogram observed the inbound changeset.
+            snap = b.agent.metrics.snapshot()
+            lag_keys = [
+                k for k in snap
+                if k.startswith("corro_broadcast_recv_lag_seconds_count")
+            ]
+            assert lag_keys and sum(snap[k] for k in lag_keys) >= 1
+            assert any(
+                k.startswith("corro_changes_applied") for k in snap
+            )
+
+            # Admin RPC surfaces.
+            from corrosion_tpu.agent.admin import AdminClient
+
+            cli = AdminClient(str(tmp_path / "a.sock"))
+            (mframe,) = await cli.call({"c": "metrics"})
+            assert isinstance(mframe["metrics"], dict)
+            (tframe,) = await cli.call({"c": "trace", "limit": 5})
+            assert isinstance(tframe["spans"], list)
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+def test_agent_prometheus_endpoint(tmp_path):
+    async def main():
+        a = await launch_test_agent(
+            str(tmp_path / "a"), prometheus_addr="127.0.0.1:0"
+        )
+        try:
+            host, port = a.agent.prometheus_addr
+            body = await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics"
+                ).read().decode()
+            )
+            assert "corro_gossip_members" in body
+        finally:
+            await a.stop()
+
+    run(main())
