@@ -48,7 +48,7 @@ def main() -> None:
         n, rounds = 512, 60
     cfg, topo, sched = models.merge_10k(n=n, rounds=rounds, samples=256)
 
-    chunk = 20  # bound single device executions (watchdog-safe)
+    chunk = 12  # bound single device executions (watchdog-safe)
     t0 = time.perf_counter()
     final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=chunk)
     jax.block_until_ready(final.data.contig)

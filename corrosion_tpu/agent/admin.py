@@ -85,6 +85,11 @@ async def _handle(agent: "Agent", session: Session, msg: dict) -> None:
         sql = msg.get("schema_sql", "")
         changed = agent.store.apply_schema(sql) if sql else []
         await session.send({"reloaded": changed})
+    elif cmd == "restore":
+        actor = await agent.restore_online(
+            msg["path"], self_actor_id=bool(msg.get("self_actor_id"))
+        )
+        await session.send({"restored": True, "actor_id": actor})
     elif cmd == "metrics":
         await session.send({"metrics": agent.metrics.snapshot()})
     elif cmd == "trace":

@@ -121,6 +121,7 @@ class Agent:
             export_path=cfg.trace_export_path or None,
         )
         self._prom_server = None
+        self.pool = None  # SplitPool, started with the event loop
         # Hot-path metric handles, resolved once.
         self._m_recv_lag = self.metrics.histogram(
             "corro_broadcast_recv_lag_seconds",
@@ -177,6 +178,10 @@ class Agent:
                 )
 
     async def start(self) -> None:
+        from corrosion_tpu.agent.pool import SplitPool
+
+        self.pool = SplitPool(self.store)
+        self.pool.start()
         self.gossip_addr = await self.transport.serve(
             self.cfg.gossip_host, self.cfg.gossip_port, self._on_gossip
         )
@@ -220,16 +225,40 @@ class Agent:
             self._admin_server.close()
         if self._prom_server is not None:
             self._prom_server.close()
+        if self.pool is not None:
+            await self.pool.close()
         self.tracer.close()
         self.store.close()
 
     # -- write path (make_broadcastable_changes) ------------------------------
 
     def execute(self, statements: list[Statement]) -> ExecResponse:
+        """Synchronous local write (tests, tooling): store txn inline."""
         t0 = time.monotonic()
         results, dbv, last_seq, changes = self.store.execute_transaction(
             statements
         )
+        return self._finish_local_write(results, dbv, last_seq, changes, t0)
+
+    async def execute_async(self, statements: list[Statement]) -> ExecResponse:
+        """API-path local write: the SQLite transaction runs on the
+        SplitPool's writer at HIGH priority (pool.write_priority ≈
+        `pool.write_priority()` at public/mod.rs:41), keeping the event
+        loop free; bookkeeping/subs/broadcast stay loop-confined."""
+        t0 = time.monotonic()
+        if self.pool is not None:
+            results, dbv, last_seq, changes = await self.pool.write_priority(
+                lambda: self.store.execute_transaction(statements)
+            )
+        else:
+            results, dbv, last_seq, changes = self.store.execute_transaction(
+                statements
+            )
+        return self._finish_local_write(results, dbv, last_seq, changes, t0)
+
+    def _finish_local_write(
+        self, results, dbv, last_seq, changes, t0
+    ) -> ExecResponse:
         if dbv and changes:
             ts = self.hlc.new_timestamp()
             booked = self.bookie.for_actor(self.actor_id)
@@ -253,13 +282,51 @@ class Agent:
             results=results, time=time.monotonic() - t0
         )
 
+    async def restore_online(
+        self, backup_path: str, self_actor_id: bool = False
+    ) -> str:
+        """Swap in a backup while running (`corrosion restore` against a
+        live node; sqlite3-restore's role). The content swap runs on the
+        SplitPool writer — serialized with every other write — then the
+        agent re-reads identity/schema and rebuilds its bookkeeping.
+        Returns the actor id now in effect."""
+        from corrosion_tpu.agent.backup import online_restore
+
+        def do() -> None:
+            # One pooled job: swap, retire stale readers, reload — so no
+            # queued write can ever run between the content swap and the
+            # store reopening on the restored content. The fcntl locks
+            # exclude OTHER processes; same-process readers are quiesced
+            # by the caller (pool read slots) and the write lock below.
+            with self.store._wlock("online_restore"):
+                online_restore(
+                    backup_path, self.store.path, self_actor_id=self_actor_id
+                )
+                if self.pool is not None:
+                    self.pool.flush_read_conns()
+            self.store.reload_after_restore()
+
+        if self.pool is not None:
+            async with await self.pool.quiesce_reads():
+                await self.pool.write_priority(do)
+        else:
+            do()
+        self.actor_id = self.store.site_id.hex()
+        self.bookie = Bookie()
+        self._rehydrate()
+        return self.actor_id
+
     def _persist_bookkeeping(self, actor, version, dbv, last_seq, ts) -> None:
-        self.store.conn.execute(
-            "INSERT OR REPLACE INTO __corro_bookkeeping"
-            " (actor_id, start_version, end_version, db_version, last_seq, ts)"
-            " VALUES (?, ?, NULL, ?, ?, ?)",
-            (bytes.fromhex(actor), version, dbv, last_seq, ts),
-        )
+        # Under the writer lock: the pool writer thread may hold an open
+        # BEGIN IMMEDIATE on this connection, and joining a foreign
+        # transaction would tie this row's fate to it.
+        with self.store._wlock("persist_bookkeeping"):
+            self.store.conn.execute(
+                "INSERT OR REPLACE INTO __corro_bookkeeping"
+                " (actor_id, start_version, end_version, db_version, last_seq, ts)"
+                " VALUES (?, ?, NULL, ?, ?, ?)",
+                (bytes.fromhex(actor), version, dbv, last_seq, ts),
+            )
 
     def _changeset_frame(self, actor, version, changes, seqs, last_seq, ts):
         return {
@@ -356,10 +423,46 @@ class Agent:
                     batch.append(self._ingest.get_nowait())
                 except asyncio.QueueEmpty:
                     await asyncio.sleep(0.005)
-            self._process_changes(batch)
+            await self._process_changes(batch)
 
-    def _process_changes(self, batch: list[tuple[dict, str]]) -> None:
+    async def _store_write(self, fn):
+        """Run store-only work on the pool writer (NORMAL tier — the change
+        ingest class, agent.rs:2450); inline when the pool isn't up.
+        Bookie and subscription state stay event-loop-confined."""
+        if self.pool is not None:
+            return await self.pool.write(fn)
+        return fn()
+
+    async def _process_changes(self, batch: list[tuple[dict, str]]) -> None:
+        """One writer transaction per ingest batch (process_multiple_changes,
+        agent.rs:1847-1851): complete changesets accumulate and flush as a
+        single pooled store job; partial-version buffering (rare) flushes
+        the pending run first, then takes its own pooled job. Bookie and
+        subscription state stay loop-confined throughout. Duplicate copies
+        of a changeset inside ONE accumulation window bypass the dedupe
+        check; the CRDT store and bookie inserts are idempotent, so that
+        only costs the double work, never correctness."""
         now_ms = int(time.time() * 1000)
+        pending: list[tuple[str, int, list[Change], int, int]] = []
+
+        async def flush() -> None:
+            if not pending:
+                return
+            flat = [ch for _, _, changes, _, _ in pending for ch in changes]
+            await self._store_write(
+                lambda: self.store.apply_changes(flat)
+            )
+            for actor, version, changes, last_seq, ts in pending:
+                self._m_applied.inc()
+                dbv = changes[0].db_version if changes else 0
+                self.bookie.for_actor(actor).insert(
+                    version, Current(db_version=dbv, last_seq=last_seq, ts=ts)
+                )
+                self._persist_bookkeeping(actor, version, dbv, last_seq, ts)
+                if self.subs is not None:
+                    self.subs.match_changes(changes)
+            pending.clear()
+
         for msg, source in batch:
             actor = msg["actor"]
             if actor == self.actor_id:
@@ -379,19 +482,21 @@ class Agent:
             complete = seqs[0] == 0 and seqs[1] >= last_seq
             known = booked.get(version)
             if complete and not isinstance(known, Partial):
-                self._apply_complete(actor, version, changes, last_seq, msg["ts"])
+                pending.append((actor, version, changes, last_seq, msg["ts"]))
             else:
+                await flush()
                 self._m_buffered.inc(source=source)
-                self._buffer_partial(
+                await self._buffer_partial(
                     actor, version, changes, seqs, last_seq, msg["ts"]
                 )
             if source == "broadcast":
                 # Rebroadcast applied changesets (agent.rs:2040-2057).
                 pb = dict(msg)
                 self._queue_broadcast(pb)
+        await flush()
 
-    def _apply_complete(self, actor, version, changes, last_seq, ts) -> None:
-        self.store.apply_changes(changes)
+    async def _apply_complete(self, actor, version, changes, last_seq, ts) -> None:
+        await self._store_write(lambda: self.store.apply_changes(changes))
         self._m_applied.inc()
         booked = self.bookie.for_actor(actor)
         dbv = changes[0].db_version if changes else 0
@@ -402,7 +507,9 @@ class Agent:
         if self.subs is not None:
             self.subs.match_changes(changes)
 
-    def _buffer_partial(self, actor, version, changes, seqs, last_seq, ts) -> None:
+    async def _buffer_partial(
+        self, actor, version, changes, seqs, last_seq, ts
+    ) -> None:
         """process_incomplete_version: stash rows + seq ranges; apply once
         gap-free (agent.rs:2063-2151, 1667-1806)."""
         booked = self.bookie.for_actor(actor)
@@ -415,41 +522,53 @@ class Agent:
                 seqs=RangeSet([tuple(seqs)]), last_seq=last_seq, ts=ts
             )
             booked.insert(version, partial)
-        c = self.store.conn
-        for ch in changes:
-            c.execute(
-                "INSERT OR IGNORE INTO __corro_buffered_changes VALUES"
-                " (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
-                (
-                    bytes.fromhex(actor), version, ch.table, ch.pk, ch.cid,
-                    ch.val, ch.col_version, ch.db_version, ch.seq,
-                    ch.site_id, ch.cl,
-                ),
-            )
-        c.execute(
-            "INSERT OR REPLACE INTO __corro_seq_bookkeeping VALUES"
-            " (?, ?, ?, ?, ?, ?)",
-            (bytes.fromhex(actor), version, seqs[0], seqs[1], last_seq, ts),
-        )
-        if partial.is_complete():
-            rows = c.execute(
-                "SELECT tbl, pk, cid, val, col_version, db_version, seq,"
-                " site_id, cl FROM __corro_buffered_changes"
-                " WHERE actor_id = ? AND version = ? ORDER BY seq",
-                (bytes.fromhex(actor), version),
-            ).fetchall()
+        promote = partial.is_complete()
+
+        def db_work():
+            c = self.store.conn
+            with self.store._wlock("buffer_partial"):
+                for ch in changes:
+                    c.execute(
+                        "INSERT OR IGNORE INTO __corro_buffered_changes VALUES"
+                        " (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                        (
+                            bytes.fromhex(actor), version, ch.table, ch.pk,
+                            ch.cid, ch.val, ch.col_version, ch.db_version,
+                            ch.seq, ch.site_id, ch.cl,
+                        ),
+                    )
+                c.execute(
+                    "INSERT OR REPLACE INTO __corro_seq_bookkeeping VALUES"
+                    " (?, ?, ?, ?, ?, ?)",
+                    (bytes.fromhex(actor), version, seqs[0], seqs[1],
+                     last_seq, ts),
+                )
+                if not promote:
+                    return None
+                rows = c.execute(
+                    "SELECT tbl, pk, cid, val, col_version, db_version, seq,"
+                    " site_id, cl FROM __corro_buffered_changes"
+                    " WHERE actor_id = ? AND version = ? ORDER BY seq",
+                    (bytes.fromhex(actor), version),
+                ).fetchall()
+                c.execute(
+                    "DELETE FROM __corro_buffered_changes"
+                    " WHERE actor_id = ? AND version = ?",
+                    (bytes.fromhex(actor), version),
+                )
+                c.execute(
+                    "DELETE FROM __corro_seq_bookkeeping"
+                    " WHERE actor_id = ? AND version = ?",
+                    (bytes.fromhex(actor), version),
+                )
+                return rows
+
+        rows = await self._store_write(db_work)
+        if rows is not None:
             all_changes = [Change.from_tuple(tuple(r)) for r in rows]
-            c.execute(
-                "DELETE FROM __corro_buffered_changes"
-                " WHERE actor_id = ? AND version = ?",
-                (bytes.fromhex(actor), version),
+            await self._apply_complete(
+                actor, version, all_changes, last_seq, ts
             )
-            c.execute(
-                "DELETE FROM __corro_seq_bookkeeping"
-                " WHERE actor_id = ? AND version = ?",
-                (bytes.fromhex(actor), version),
-            )
-            self._apply_complete(actor, version, all_changes, last_seq, ts)
 
     # -- SWIM loop -------------------------------------------------------------
 

@@ -145,12 +145,14 @@ async def _route(agent, writer, method, path, query, body) -> bool:
     (and must close when the stream ends)."""
     if method == "POST" and path == "/v1/transactions":
         stmts = [Statement.parse(o) for o in _json_body(body)]
-        resp = agent.execute(stmts)
+        resp = await agent.execute_async(stmts)
         _json_resp(writer, 200, resp.to_json_obj())
         return True
     if method == "POST" and path == "/v1/queries":
         stmt = Statement.parse(_json_body(body))
-        cols, rows = agent.store.query(stmt)
+        # Pooled snapshot read (SplitPool read pool): large results never
+        # stall the gossip loops.
+        cols, rows = await agent.pool.query(stmt)
         await _start_stream(writer)
         await _stream_chunk(
             writer, json.dumps({"columns": cols}).encode() + b"\n"

@@ -179,13 +179,13 @@ async def _simple_query(agent: "Agent", writer, sql: str) -> None:
             continue
         try:
             if _is_query(translated):
-                cols, rows = agent.store.query(Statement(translated))
+                cols, rows = await agent.pool.query(Statement(translated))
                 writer.write(_row_description(cols))
                 for row in rows:
                     writer.write(_data_row(row))
                 writer.write(_command_complete(f"SELECT {len(rows)}"))
             else:
-                resp = agent.execute([Statement(translated)])
+                resp = await agent.execute_async([Statement(translated)])
                 n = sum(r.rows_affected for r in resp.results)
                 word = translated.split(None, 1)[0].upper()
                 tag = f"INSERT 0 {n}" if word == "INSERT" else f"{word} {n}"

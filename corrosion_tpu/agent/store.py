@@ -104,7 +104,19 @@ class Store:
         self.site_id = site_id
         self._write_lock = threading.Lock()
         self.lock_registry = None  # optional utils.locks.LockRegistry
-        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self._open_connections()
+        self._tables: dict[str, TableInfo] = {}
+        self._migrate()
+        # Adopt the PERSISTED identity: on a pre-existing database the
+        # INSERT OR IGNORE in _migrate keeps the original site_id, and the
+        # triggers stamp changes with the meta row — a restarted node must
+        # read its own local writes back with that id, not the fresh one
+        # the caller passed (ActorId = crsql_site_id(), agent.rs:115-120).
+        self._adopt_persisted_site_id()
+        self._load_schema()
+
+    def _open_connections(self) -> None:
+        self.conn = sqlite3.connect(self.path, check_same_thread=False)
         # Explicit transaction control (BEGIN IMMEDIATE below); the library's
         # implicit-transaction mode would fight it.
         self.conn.isolation_level = None
@@ -116,17 +128,34 @@ class Store:
         # seam (init_cr_conn, corro-types/src/sqlite.rs:87-105). When the
         # built extension is absent the pure-Python merge path is used.
         self.native_crdt = native.load_crdt_extension(self.conn)
-        self._tables: dict[str, TableInfo] = {}
-        self._migrate()
         # Dedicated read connection (the read pool's role): WAL snapshot
         # isolation from in-flight write transactions.
-        self.read_conn = sqlite3.connect(path, check_same_thread=False)
+        self.read_conn = sqlite3.connect(self.path, check_same_thread=False)
         self.read_conn.isolation_level = None
         self.read_conn.create_function(
             "corro_pack", -1, _sql_pack, deterministic=True
         )
         native.load_crdt_extension(self.read_conn)
-        self._load_schema()
+
+    def _adopt_persisted_site_id(self) -> None:
+        (db_site,) = self.conn.execute(
+            "SELECT value FROM __corro_meta WHERE key='site_id'"
+        ).fetchone()
+        self.site_id = bytes(db_site)
+
+    def reload_after_restore(self) -> None:
+        """Re-adopt identity + schema after an online restore swapped the
+        database content (sqlite3-restore's seam). SQLite page caches do
+        not track external same-inode rewrites in WAL mode, so the store's
+        own connections are reopened; the locked swap still protects other
+        connections' in-flight reads while it happens."""
+        with self._wlock("reload_after_restore"):
+            self.conn.close()
+            self.read_conn.close()
+            self._open_connections()
+            self._adopt_persisted_site_id()
+            self._tables = {}
+            self._load_schema()
 
     def close(self) -> None:
         self.conn.close()

@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 
 from corrosion_tpu.agent.config import Config, parse_addr
@@ -37,12 +38,17 @@ def _build_parser() -> argparse.ArgumentParser:
     b.add_argument("out")
     b.add_argument("--db", required=True)
 
-    r = sub.add_parser("restore", help="swap a backup into place (offline)")
+    r = sub.add_parser("restore", help="swap a backup into place")
     r.add_argument("backup")
     r.add_argument("--db", required=True)
     r.add_argument(
         "--self-actor-id", action="store_true",
         help="keep the backup's actor identity instead of assigning fresh",
+    )
+    r.add_argument(
+        "--online", action="store_true",
+        help="restore into a RUNNING agent via the admin socket (SQLite "
+        "file locks held during the swap)",
     )
 
     s = sub.add_parser("sync", help="sync protocol utilities")
@@ -102,6 +108,14 @@ async def _dispatch(args, cfg: Config) -> int:
         print(f"backed up {args.db} -> {args.out}")
         return 0
     if args.command == "restore":
+        if args.online:
+            frames = await _admin(
+                cfg,
+                {"c": "restore", "path": os.path.abspath(args.backup),
+                 "self_actor_id": args.self_actor_id},
+            )
+            print(f"restored online (actor {frames[0]['actor_id']})")
+            return 0
         from corrosion_tpu.agent.backup import restore
 
         site = restore(args.backup, args.db, self_actor_id=args.self_actor_id)

@@ -146,11 +146,27 @@ def merge_10k(n: int = 10_000, rounds: int = 120, samples: int = 256,
         n,
         writers=writers,
         regions=[n // 8] * 8,
-        sync_interval=10,
-        sync_budget=512,
-        sync_chunk=32,
+        sync_interval=5,
+        # The reference's parallel_sync streams every requested need per
+        # session (chunked adaptively, peer.rs:925-1286). With the widened
+        # broadcast below carrying ~98% of deliveries, sessions typically
+        # need a few hundred versions; 1024 keeps the worst-case cell
+        # enumeration (R x budget triples per round) affordable while far
+        # exceeding the steady-state need (512 saturated and never drained).
+        sync_budget=1024,
+        sync_chunk=128,
+        # Under a cluster-wide write storm the pending queue churns (fresh
+        # versions evict older ones before their retransmission budgets are
+        # spent), so spread needs width: more far targets + deeper queues.
+        fanout_near=3,
+        fanout_far=3,
+        queue=24,
         n_cells=1024,
         cells_per_write=2,
+        # Sparse membership: the dense u32[N, N] view plus its scatter
+        # temporaries dominate peak HBM at 10k when combined with the
+        # [N, W] data plane in one round graph.
+        swim_kw={"view_capacity": 64},
     )
     rng = np.random.default_rng(seed)
     writes = (rng.random((rounds, n)) < 0.01).astype(np.uint32)

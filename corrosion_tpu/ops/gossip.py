@@ -520,7 +520,7 @@ def _sync_rows(
     # chosen peers. Cohorts keep R = N / sync_interval, so even the 100k
     # config scores exactly.
     c_count = cfg.sync_candidates
-    exact = r * cfg.n_writers * c_count <= (1 << 27)
+    exact = r * cfg.n_writers * c_count <= (1 << 25)
     need_cols = []
     total = None
     if not exact:
@@ -566,12 +566,26 @@ def _sync_rows(
     sel = jnp.take_along_axis(cand, order, axis=1)  # i32[R, S]
     sel_ok = jnp.take_along_axis(score, order, axis=1) > 0
 
-    # Pull from selected peers in need order under one shared budget.
+    # Pull from selected peers in need order under one shared budget, plus
+    # one origin-targeted pull: the writer behind the row's largest known
+    # head gap certainly holds its own versions, so "needle" versions with
+    # few replicas are always reachable (the reference syncs with peers
+    # chosen by per-actor need — the origin actor is the canonical holder).
+    gap = (seen_r - jnp.minimum(seen_r, contig0)).astype(jnp.int32)  # [R, W]
+    w_star = jnp.argmax(gap, axis=1)  # [R]
+    origin = topo.writer_nodes[w_star]
+    origin_ok = (
+        row_ok
+        & (jnp.max(gap, axis=1) > 0)
+        & alive[origin]
+        & (origin != rows)
+        & ~partition[region_r, topo.region[origin]]
+    )
+    pulls = [(sel[:, s], sel_ok[:, s]) for s in range(cfg.sync_peers)]
+    pulls.append((origin, origin_ok))
     contig_r = contig0
     budget_left = jnp.full((r,), cfg.sync_budget, jnp.int32)
-    for s in range(cfg.sync_peers):
-        p = sel[:, s]
-        ok_s = sel_ok[:, s]
+    for p, ok_s in pulls:
         p_contig = data.contig[p]  # [R, W]
         deficit = (p_contig - jnp.minimum(p_contig, contig_r)).astype(
             jnp.uint32

@@ -205,17 +205,16 @@ async def serve_prometheus(
                 h = await reader.readline()
                 if h in (b"\r\n", b"\n", b""):
                     break
-            body = registry.render().encode()
+            ok = b"/metrics" in line or b"GET / " in line
+            body = registry.render().encode() if ok else b""
             status = (
-                b"HTTP/1.1 200 OK\r\n"
-                if b"/metrics" in line or b"GET / " in line
-                else b"HTTP/1.1 404 Not Found\r\n"
+                b"HTTP/1.1 200 OK\r\n" if ok else b"HTTP/1.1 404 Not Found\r\n"
             )
             writer.write(
                 status
                 + b"content-type: text/plain; version=0.0.4\r\n"
                 + f"content-length: {len(body)}\r\n\r\n".encode()
-                + (body if status.startswith(b"HTTP/1.1 200") else b"")
+                + body
             )
             await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
