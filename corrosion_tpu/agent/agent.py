@@ -195,6 +195,9 @@ class Agent:
         from corrosion_tpu.agent.api import serve_api
 
         self.api_addr = await serve_api(self)
+        if self.subs is not None:
+            # Restore persisted subscriptions (agent.rs:373-419).
+            self.subs.restore()
         self.tasks.spawn(self._swim_loop(), name="swim_loop")
         self.tasks.spawn(self._broadcast_loop(), name="broadcast_loop")
         self.tasks.spawn(self._ingest_loop(), name="handle_changes")
@@ -238,7 +241,12 @@ class Agent:
         results, dbv, last_seq, changes = self.store.execute_transaction(
             statements
         )
-        return self._finish_local_write(results, dbv, last_seq, changes, t0)
+        resp, persist = self._finish_local_write(
+            results, dbv, last_seq, changes, t0
+        )
+        if persist is not None:
+            persist()
+        return resp
 
     async def execute_async(self, statements: list[Statement]) -> ExecResponse:
         """API-path local write: the SQLite transaction runs on the
@@ -254,11 +262,18 @@ class Agent:
             results, dbv, last_seq, changes = self.store.execute_transaction(
                 statements
             )
-        return self._finish_local_write(results, dbv, last_seq, changes, t0)
+        resp, persist = self._finish_local_write(
+            results, dbv, last_seq, changes, t0
+        )
+        if persist is not None:
+            await self._store_write(persist)
+        return resp
 
-    def _finish_local_write(
-        self, results, dbv, last_seq, changes, t0
-    ) -> ExecResponse:
+    def _finish_local_write(self, results, dbv, last_seq, changes, t0):
+        """Loop-confined bookkeeping; returns (response, persist_closure) —
+        the closure is store-only work the caller runs on the pool writer
+        (or inline, for the sync path)."""
+        persist = None
         if dbv and changes:
             ts = self.hlc.new_timestamp()
             booked = self.bookie.for_actor(self.actor_id)
@@ -266,11 +281,17 @@ class Agent:
             booked.insert(
                 version, Current(db_version=dbv, last_seq=last_seq, ts=ts)
             )
-            self._persist_bookkeeping(
-                self.actor_id, version, dbv, last_seq, ts
-            )
-            if self.subs is not None:
+            dirty = (
                 self.subs.match_changes(changes)
+                if self.subs is not None else []
+            )
+            actor = self.actor_id
+
+            def persist() -> None:
+                self._persist_bookkeeping(actor, version, dbv, last_seq, ts)
+                if self.subs is not None:
+                    self.subs.persist_watermarks_sync(dirty)
+
             # Chunk and queue for dissemination (public/mod.rs:128-187).
             for chunk, (s, e) in chunk_changes(changes, last_seq):
                 self._queue_broadcast(
@@ -278,8 +299,9 @@ class Agent:
                         self.actor_id, version, chunk, (s, e), last_seq, ts
                     )
                 )
-        return ExecResponse(
-            results=results, time=time.monotonic() - t0
+        return (
+            ExecResponse(results=results, time=time.monotonic() - t0),
+            persist,
         )
 
     async def restore_online(
@@ -307,13 +329,17 @@ class Agent:
             self.store.reload_after_restore()
 
         if self.pool is not None:
-            async with await self.pool.quiesce_reads():
+            async with self.pool.quiesce_reads():
                 await self.pool.write_priority(do)
         else:
             do()
         self.actor_id = self.store.site_id.hex()
         self.bookie = Bookie()
         self._rehydrate()
+        if self.subs is not None:
+            # Backups strip __corro_subs (node-local): recreate it and
+            # re-persist this node's live subscriptions.
+            self.subs.reinit_after_restore()
         return self.actor_id
 
     def _persist_bookkeeping(self, actor, version, dbv, last_seq, ts) -> None:
@@ -449,18 +475,34 @@ class Agent:
             if not pending:
                 return
             flat = [ch for _, _, changes, _, _ in pending for ch in changes]
-            await self._store_write(
-                lambda: self.store.apply_changes(flat)
-            )
-            for actor, version, changes, last_seq, ts in pending:
+            # All bookkeeping rows ride the same pooled job as the merge:
+            # no store write ever runs on the event loop.
+            rows = [
+                (actor, version, changes[0].db_version if changes else 0,
+                 last_seq, ts)
+                for actor, version, changes, last_seq, ts in pending
+            ]
+
+            def db_work() -> None:
+                self.store.apply_changes(flat)
+                for actor, version, dbv, last_seq, ts in rows:
+                    self._persist_bookkeeping(actor, version, dbv, last_seq, ts)
+
+            await self._store_write(db_work)
+            dirty: list[tuple[str, int]] = []
+            for (actor, version, changes, last_seq, ts), (_, _, dbv, _, _) in zip(
+                pending, rows
+            ):
                 self._m_applied.inc()
-                dbv = changes[0].db_version if changes else 0
                 self.bookie.for_actor(actor).insert(
                     version, Current(db_version=dbv, last_seq=last_seq, ts=ts)
                 )
-                self._persist_bookkeeping(actor, version, dbv, last_seq, ts)
                 if self.subs is not None:
-                    self.subs.match_changes(changes)
+                    dirty.extend(self.subs.match_changes(changes))
+            if dirty:
+                await self._store_write(
+                    lambda: self.subs.persist_watermarks_sync(dirty)
+                )
             pending.clear()
 
         for msg, source in batch:
@@ -496,16 +538,23 @@ class Agent:
         await flush()
 
     async def _apply_complete(self, actor, version, changes, last_seq, ts) -> None:
-        await self._store_write(lambda: self.store.apply_changes(changes))
-        self._m_applied.inc()
-        booked = self.bookie.for_actor(actor)
         dbv = changes[0].db_version if changes else 0
-        booked.insert(
+
+        def db_work() -> None:
+            self.store.apply_changes(changes)
+            self._persist_bookkeeping(actor, version, dbv, last_seq, ts)
+
+        await self._store_write(db_work)
+        self._m_applied.inc()
+        self.bookie.for_actor(actor).insert(
             version, Current(db_version=dbv, last_seq=last_seq, ts=ts)
         )
-        self._persist_bookkeeping(actor, version, dbv, last_seq, ts)
         if self.subs is not None:
-            self.subs.match_changes(changes)
+            dirty = self.subs.match_changes(changes)
+            if dirty:
+                await self._store_write(
+                    lambda: self.subs.persist_watermarks_sync(dirty)
+                )
 
     async def _buffer_partial(
         self, actor, version, changes, seqs, last_seq, ts

@@ -28,7 +28,9 @@ SHM_LOCK_LEN = 8
 
 # Node-local tables a backup must not carry into another node
 # (main.rs:176-216 strips members + local bookkeeping rewrite).
-NODE_LOCAL_TABLES = ("__corro_members",)
+# Subscriptions are per-node state too: a restored node must keep ITS
+# subscriptions, not adopt the backup origin's.
+NODE_LOCAL_TABLES = ("__corro_members", "__corro_subs")
 
 
 def backup(db_path: str, out_path: str) -> None:

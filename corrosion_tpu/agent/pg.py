@@ -8,14 +8,18 @@ SELECTs run on the store's read connection; writes run through
 Agent.execute so version allocation, bookkeeping, and dissemination are
 identical to the HTTP path (the parity that matters, lib.rs write path).
 
-Everything is typed as text on the wire (like psql's default rendering);
-the extended query protocol (parse/bind) is not implemented — psql's simple
-protocol and most drivers' simple modes work.
+Everything is typed as text on the wire (like psql's default rendering).
+Both protocol flows are served: the simple-query flow ('Q') and the
+extended flow (Parse/Bind/Describe/Execute/Close/Sync/Flush — what libpq's
+PQexecParams and most drivers send), with PG's ``$N`` placeholders
+translated to SQLite ``?N``. Text parameter/result format only; a client
+requesting binary gets a clean protocol error.
 """
 
 from __future__ import annotations
 
 import asyncio
+import re
 import struct
 from typing import TYPE_CHECKING
 
@@ -27,6 +31,12 @@ if TYPE_CHECKING:
 SSL_REQUEST = 80877103
 PROTOCOL_V3 = 196608
 TEXT_OID = 25
+
+# Parameter OIDs we coerce from text (ints/floats/bool); everything else
+# stays a string and relies on SQLite column affinity.
+_INT_OIDS = {20, 21, 23, 26}
+_FLOAT_OIDS = {700, 701, 1700}
+_BOOL_OID = 16
 
 
 def _msg(tag: bytes, payload: bytes) -> bytes:
@@ -95,8 +105,61 @@ def translate_pg_sql(sql: str) -> str:
     return s
 
 
+def translate_placeholders(sql: str) -> str:
+    """PG ``$N`` → SQLite ``?N``, outside string/identifier literals."""
+    out: list[str] = []
+    quote: str | None = None
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if quote is not None:
+            out.append(ch)
+            if ch == quote:
+                quote = None
+            i += 1
+        elif ch in ("'", '"'):
+            quote = ch
+            out.append(ch)
+            i += 1
+        elif ch == "$":
+            m = re.match(r"\$(\d+)", sql[i:])
+            if m:
+                out.append("?" + m.group(1))
+                i += len(m.group(0))
+            else:
+                out.append(ch)
+                i += 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+class _Prepared:
+    def __init__(self, sql: str, param_oids: list[int]):
+        self.raw = sql
+        self.translated = translate_pg_sql(translate_placeholders(sql))
+        self.param_oids = param_oids
+
+
+class _Portal:
+    def __init__(self, prepared: _Prepared, params: list):
+        self.prepared = prepared
+        self.params = params
+        self.described: tuple[list[str], list[tuple]] | None = None
+
+
+class _PgError(Exception):
+    def __init__(self, message: str, code: str = "XX000"):
+        super().__init__(message)
+        self.code = code
+
+
 async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
     async def on_conn(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        prepared: dict[str, _Prepared] = {}
+        portals: dict[str, _Portal] = {}
+        in_error = False  # extended-protocol error state: skip until Sync
         try:
             await _handshake(reader, writer)
             writer.write(_msg(b"R", struct.pack(">I", 0)))  # AuthenticationOk
@@ -106,6 +169,7 @@ async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
                 ("client_encoding", "UTF8"),
             ):
                 writer.write(_msg(b"S", _cstr(k) + _cstr(v)))
+            writer.write(_msg(b"K", struct.pack(">II", 1, 0)))  # BackendKeyData
             writer.write(_ready())
             await writer.drain()
             while True:
@@ -115,9 +179,27 @@ async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
                 if tag == b"X":
                     break
                 if tag == b"Q":
-                    await _simple_query(
-                        agent, writer, payload[:-1].decode()
-                    )
+                    in_error = False
+                    await _simple_query(agent, writer, payload[:-1].decode())
+                elif tag == b"S":  # Sync: end of extended batch
+                    in_error = False
+                    portals.clear()
+                    writer.write(_ready())
+                elif tag == b"H":  # Flush
+                    pass
+                elif in_error:
+                    pass  # discard until Sync (protocol error recovery)
+                elif tag in (b"P", b"B", b"D", b"E", b"C"):
+                    try:
+                        await _extended(
+                            agent, writer, tag, payload, prepared, portals
+                        )
+                    except _PgError as e:
+                        writer.write(_error(str(e), e.code))
+                        in_error = True
+                    except Exception as e:
+                        writer.write(_error(str(e)))
+                        in_error = True
                 else:
                     writer.write(
                         _error(f"unsupported message {tag!r}", "0A000")
@@ -132,6 +214,167 @@ async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
     server = await asyncio.start_server(on_conn, host, port)
     sock = server.sockets[0].getsockname()
     return server, (sock[0], sock[1])
+
+
+def _read_cstr(buf: bytes, off: int) -> tuple[str, int]:
+    end = buf.index(b"\x00", off)
+    return buf[off:end].decode(), end + 1
+
+
+async def _extended(
+    agent: "Agent", writer, tag: bytes, payload: bytes,
+    prepared: dict[str, _Prepared], portals: dict[str, _Portal],
+) -> None:
+    """One extended-protocol message (the pgwire flows of corro-pg's
+    on_query/on_describe handlers, lib.rs:474-1769)."""
+    if tag == b"P":  # Parse: name, query, param oids
+        name, off = _read_cstr(payload, 0)
+        query, off = _read_cstr(payload, off)
+        (n_oids,) = struct.unpack_from(">H", payload, off)
+        off += 2
+        oids = [
+            struct.unpack_from(">I", payload, off + 4 * i)[0]
+            for i in range(n_oids)
+        ]
+        prepared[name] = _Prepared(query, oids)
+        writer.write(_msg(b"1", b""))  # ParseComplete
+        return
+
+    if tag == b"B":  # Bind: portal, stmt, formats, params, result formats
+        portal_name, off = _read_cstr(payload, 0)
+        stmt_name, off = _read_cstr(payload, off)
+        stmt = prepared.get(stmt_name)
+        if stmt is None:
+            raise _PgError(f"unknown prepared statement {stmt_name!r}", "26000")
+        (n_fmt,) = struct.unpack_from(">H", payload, off)
+        off += 2
+        fmts = [
+            struct.unpack_from(">H", payload, off + 2 * i)[0]
+            for i in range(n_fmt)
+        ]
+        off += 2 * n_fmt
+        (n_params,) = struct.unpack_from(">H", payload, off)
+        off += 2
+        params: list = []
+        for i in range(n_params):
+            (plen,) = struct.unpack_from(">i", payload, off)
+            off += 4
+            if plen < 0:
+                params.append(None)
+                continue
+            raw = payload[off : off + plen]
+            off += plen
+            fmt = fmts[i] if i < len(fmts) else (fmts[0] if len(fmts) == 1 else 0)
+            if fmt != 0:
+                raise _PgError("binary parameter format not supported", "0A000")
+            oid = stmt.param_oids[i] if i < len(stmt.param_oids) else 0
+            params.append(_coerce_param(raw.decode(), oid))
+        (n_rfmt,) = struct.unpack_from(">H", payload, off)
+        off += 2
+        rfmts = [
+            struct.unpack_from(">H", payload, off + 2 * i)[0]
+            for i in range(n_rfmt)
+        ]
+        if any(f != 0 for f in rfmts):
+            raise _PgError("binary result format not supported", "0A000")
+        portals[portal_name] = _Portal(stmt, params)
+        writer.write(_msg(b"2", b""))  # BindComplete
+        return
+
+    if tag == b"D":  # Describe: 'S' statement | 'P' portal
+        kind, name = payload[0:1], _read_cstr(payload, 1)[0]
+        if kind == b"S":
+            stmt = prepared.get(name)
+            if stmt is None:
+                raise _PgError(f"unknown prepared statement {name!r}", "26000")
+            body = struct.pack(">H", len(stmt.param_oids))
+            for oid in stmt.param_oids:
+                body += struct.pack(">I", oid or TEXT_OID)
+            writer.write(_msg(b"t", body))  # ParameterDescription
+            cols = _try_describe(agent, stmt)
+            writer.write(_row_description(cols) if cols else _msg(b"n", b""))
+            return
+        portal = portals.get(name)
+        if portal is None:
+            raise _PgError(f"unknown portal {name!r}", "34000")
+        if _is_query(portal.prepared.translated):
+            cols, rows = await agent.pool.query(
+                Statement(portal.prepared.translated, params=portal.params)
+            )
+            portal.described = (cols, rows)
+            writer.write(_row_description(cols))
+        else:
+            writer.write(_msg(b"n", b""))  # NoData
+        return
+
+    if tag == b"E":  # Execute: portal, max rows (portal suspension unsupported)
+        name, off = _read_cstr(payload, 0)
+        portal = portals.get(name)
+        if portal is None:
+            raise _PgError(f"unknown portal {name!r}", "34000")
+        sql = portal.prepared.translated
+        if not sql:
+            writer.write(_command_complete("SET"))
+            return
+        if _is_query(sql):
+            if portal.described is not None:
+                cols, rows = portal.described
+            else:
+                cols, rows = await agent.pool.query(
+                    Statement(sql, params=portal.params)
+                )
+            for row in rows:
+                writer.write(_data_row(row))
+            writer.write(_command_complete(f"SELECT {len(rows)}"))
+        else:
+            resp = await agent.execute_async(
+                [Statement(sql, params=portal.params)]
+            )
+            bad = [r for r in resp.results if r.error]
+            if bad:
+                raise _PgError(bad[0].error)
+            n = sum(r.rows_affected or 0 for r in resp.results)
+            word = sql.split(None, 1)[0].upper()
+            tag_word = f"INSERT 0 {n}" if word == "INSERT" else f"{word} {n}"
+            writer.write(_command_complete(tag_word))
+        return
+
+    if tag == b"C":  # Close statement/portal
+        kind, name = payload[0:1], _read_cstr(payload, 1)[0]
+        (prepared if kind == b"S" else portals).pop(name, None)
+        writer.write(_msg(b"3", b""))  # CloseComplete
+        return
+
+
+def _coerce_param(text: str, oid: int):
+    try:
+        if oid in _INT_OIDS:
+            return int(text)
+        if oid in _FLOAT_OIDS:
+            return float(text)
+        if oid == _BOOL_OID:
+            return text in ("t", "true", "1", "on", "y", "yes")
+    except ValueError:
+        pass
+    return text
+
+
+def _try_describe(agent: "Agent", stmt: _Prepared) -> list[str] | None:
+    """Result columns for Describe(statement): probe with a LIMIT-0 wrapper
+    and NULL params; None (→ NoData) when the probe cannot run."""
+    if not _is_query(stmt.translated):
+        return None
+    n_params = max(
+        (int(m) for m in re.findall(r"\?(\d+)", stmt.translated)), default=0
+    )
+    try:
+        cur = agent.store.read_conn.execute(
+            f"SELECT * FROM ({stmt.translated}) LIMIT 0",
+            tuple([None] * n_params),
+        )
+        return [d[0] for d in cur.description] if cur.description else None
+    except Exception:
+        return None
 
 
 async def _handshake(reader, writer) -> None:

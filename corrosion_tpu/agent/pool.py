@@ -35,7 +35,6 @@ QUEUE_DEPTHS = {HIGH: 256, NORMAL: 512, LOW: 1024}  # agent.rs:399-421
 class _Job:
     fn: Callable[[], Any]
     future: asyncio.Future
-    loop: asyncio.AbstractEventLoop
 
 
 class SplitPool:
@@ -96,7 +95,7 @@ class SplitPool:
         if self._closed:
             raise RuntimeError("pool closed")
         loop = asyncio.get_running_loop()
-        job = _Job(fn=fn, future=loop.create_future(), loop=loop)
+        job = _Job(fn=fn, future=loop.create_future())
         await self._queues[priority].put(job)  # bounded: backpressure
         self._kick.set()
         return await job.future
@@ -117,6 +116,12 @@ class SplitPool:
             self._current = job
             try:
                 result = await asyncio.to_thread(job.fn)
+            except asyncio.CancelledError:
+                # close() cancelled us mid-job: fail the caller before the
+                # cancellation unwinds, or it would await forever.
+                if not job.future.done():
+                    job.future.set_exception(RuntimeError("pool closed"))
+                raise
             except Exception as e:  # propagate to the caller only
                 if not job.future.done():
                     job.future.set_exception(e)
@@ -152,10 +157,10 @@ class SplitPool:
         finally:
             self._put_conn(conn)
 
-    async def quiesce_reads(self):
-        """Acquire every read slot: no pooled read runs until released.
-        Returns an async context manager (used around online restore, where
-        same-process readers are not excluded by the fcntl file locks)."""
+    def quiesce_reads(self):
+        """Async context manager acquiring every read slot: no pooled read
+        runs until it exits (used around online restore, where same-process
+        readers are not excluded by the fcntl file locks)."""
         sem, n = self._read_sem, self._n_read
 
         class _Quiesce:

@@ -104,6 +104,7 @@ class Store:
         self.site_id = site_id
         self._write_lock = threading.Lock()
         self.lock_registry = None  # optional utils.locks.LockRegistry
+        self._retired_read_conns: list[sqlite3.Connection] = []
         self._open_connections()
         self._tables: dict[str, TableInfo] = {}
         self._migrate()
@@ -147,11 +148,14 @@ class Store:
         """Re-adopt identity + schema after an online restore swapped the
         database content (sqlite3-restore's seam). SQLite page caches do
         not track external same-inode rewrites in WAL mode, so the store's
-        own connections are reopened; the locked swap still protects other
-        connections' in-flight reads while it happens."""
+        own connections are reopened. The old READ connection is retired,
+        not closed: event-loop code (subscription evaluation, pg describe)
+        may be mid-query on it from another thread, and closing a live
+        connection under a cursor raises in the reader — the retired
+        handle drains naturally and is closed with the store."""
         with self._wlock("reload_after_restore"):
             self.conn.close()
-            self.read_conn.close()
+            self._retired_read_conns.append(self.read_conn)
             self._open_connections()
             self._adopt_persisted_site_id()
             self._tables = {}
@@ -160,6 +164,12 @@ class Store:
     def close(self) -> None:
         self.conn.close()
         self.read_conn.close()
+        for c in self._retired_read_conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self._retired_read_conns.clear()
 
     def _wlock(self, label: str):
         """Writer lock, registered for lock diagnostics when a registry is

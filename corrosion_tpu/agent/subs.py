@@ -90,8 +90,11 @@ class MatcherHandle:
     """One materialized subscription; fan-out to any number of listeners
     (the broadcast::Sender per sub, api/public/pubsub.rs:117-180)."""
 
-    def __init__(self, store: Store, sql: str) -> None:
-        self.id = uuid.uuid4().hex
+    def __init__(
+        self, store: Store, sql: str, sub_id: str | None = None,
+        start_change_id: int = 0,
+    ) -> None:
+        self.id = sub_id or uuid.uuid4().hex
         self.sql = sql
         self.store = store
         self.tables = _referenced_tables(store.read_conn, sql)
@@ -104,7 +107,9 @@ class MatcherHandle:
         self.rows: dict[tuple, tuple] = {}  # identity key -> cells
         self.rowids: dict[tuple, int] = {}
         self._next_rowid = 1
-        self.change_id = 0
+        # Restored subs continue numbering where the persisted watermark
+        # left off (Matcher::restore, pubsub.rs:735-771).
+        self.change_id = start_change_id
         self.history: deque[QueryEventChange] = deque(maxlen=MAX_CHANGE_HISTORY)
         self._listeners: list[asyncio.Queue] = []
         self._run_initial()
@@ -217,6 +222,10 @@ class MatcherHandle:
             if oldest is not None and from_change + 1 < oldest:
                 # History truncated: restart with a snapshot.
                 from_change = None
+            elif oldest is None and from_change < self.change_id:
+                # No history but the watermark moved past the resume point
+                # (e.g. restored after a restart): snapshot restart.
+                from_change = None
         if from_change is None:
             events.append(QueryEventColumns(list(self.columns)))
             if not skip_rows:
@@ -248,28 +257,105 @@ class _WireEvent:
 
 
 class SubsManager:
-    """Query-text-keyed matcher registry (SubsManager, pubsub.rs:77-214)."""
+    """Query-text-keyed matcher registry (SubsManager, pubsub.rs:77-214).
+
+    Subscriptions persist to ``__corro_subs`` (id, sql, change_id watermark)
+    and are recreated at boot (agent.rs:373-419 + Matcher::restore,
+    pubsub.rs:735-771). Event history is in-memory only; a subscriber
+    resuming past the restored watermark gets a snapshot restart.
+    """
 
     def __init__(self, store: Store) -> None:
         self.store = store
         self._by_sql: dict[str, MatcherHandle] = {}
         self._by_id: dict[str, MatcherHandle] = {}
+        self._ensure_table()
+
+    def _ensure_table(self) -> None:
+        self.store.conn.execute(
+            "CREATE TABLE IF NOT EXISTS __corro_subs ("
+            " id TEXT PRIMARY KEY, sql TEXT NOT NULL,"
+            " change_id INTEGER NOT NULL DEFAULT 0) WITHOUT ROWID"
+        )
 
     def subscribe(self, sql: str) -> MatcherHandle:
         key = normalize_sql(sql)
         handle = self._by_sql.get(key)
         if handle is None:
             handle = MatcherHandle(self.store, sql)
-            self._by_sql[key] = handle
-            self._by_id[handle.id] = handle
+            self._register(key, handle)
+            with self.store._wlock("subs_persist"):
+                self.store.conn.execute(
+                    "INSERT OR REPLACE INTO __corro_subs VALUES (?, ?, ?)",
+                    (handle.id, sql, handle.change_id),
+                )
         return handle
+
+    def _register(self, key: str, handle: MatcherHandle) -> None:
+        self._by_sql[key] = handle
+        self._by_id[handle.id] = handle
+
+    def restore(self) -> list[str]:
+        """Recreate persisted subscriptions; returns restored ids. A query
+        that no longer parses (schema changed under it) is dropped, like
+        the reference pruning dead sub dbs at boot."""
+        restored = []
+        for sub_id, sql, change_id in self.store.conn.execute(
+            "SELECT id, sql, change_id FROM __corro_subs"
+        ).fetchall():
+            if sub_id in self._by_id:
+                continue
+            try:
+                handle = MatcherHandle(
+                    self.store, sql, sub_id=sub_id, start_change_id=change_id
+                )
+            except Exception:
+                with self.store._wlock("subs_prune"):
+                    self.store.conn.execute(
+                        "DELETE FROM __corro_subs WHERE id = ?", (sub_id,)
+                    )
+                continue
+            self._register(normalize_sql(sql), handle)
+            restored.append(sub_id)
+        return restored
 
     def get(self, sub_id: str) -> MatcherHandle | None:
         return self._by_id.get(sub_id)
 
-    def match_changes(self, changes: list[Change]) -> None:
+    def match_changes(
+        self, changes: list[Change]
+    ) -> list[tuple[str, int]]:
         """filter_matchable_change + candidate dispatch (pubsub.rs:162-214,
-        441)."""
+        441). Returns the (sub_id, change_id) watermarks that advanced;
+        callers persist them via ``persist_watermarks_sync`` — on the pool
+        writer when one exists, so the event loop never waits on the store
+        write lock."""
+        dirty = []
         for handle in self._by_id.values():
-            if handle.interested(changes):
-                handle.process()
+            if handle.interested(changes) and handle.process():
+                dirty.append((handle.id, handle.change_id))
+        return dirty
+
+    def persist_watermarks_sync(self, dirty: list[tuple[str, int]]) -> None:
+        if not dirty:
+            return
+        with self.store._wlock("subs_watermark"):
+            self.store.conn.executemany(
+                "UPDATE __corro_subs SET change_id = ? WHERE id = ?",
+                [(cid, sid) for sid, cid in dirty],
+            )
+
+    def reinit_after_restore(self) -> None:
+        """After an online restore the table reflects the BACKUP's origin
+        (or is absent — backups strip it as node-local): recreate it and
+        re-persist this node's live subscriptions + watermarks."""
+        self._ensure_table()
+        with self.store._wlock("subs_reinit"):
+            self.store.conn.execute("DELETE FROM __corro_subs")
+            self.store.conn.executemany(
+                "INSERT OR REPLACE INTO __corro_subs VALUES (?, ?, ?)",
+                [
+                    (h.id, h.sql, h.change_id)
+                    for h in self._by_id.values()
+                ],
+            )

@@ -268,3 +268,115 @@ def test_query_error_and_migration(tmp_path):
     from corrosion_tpu.agent.testing import TEST_SCHEMA
 
     run(main())
+
+
+def test_subscription_restored_after_restart(tmp_path):
+    """Persisted subs are recreated at boot with their change-id watermark
+    (agent.rs:373-419 + Matcher::restore); a subscriber resuming past the
+    watermark gets a snapshot restart instead of silent event loss."""
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        handle_id = None
+        try:
+            handle = a.agent.subs.subscribe("SELECT id, text FROM tests")
+            handle_id = handle.id
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'one')"]]
+            )
+            await poll_until(
+                lambda: _ready(a, handle_id), timeout=10
+            )
+            assert a.agent.subs.get(handle_id).change_id >= 1
+        finally:
+            await a.stop()
+
+        b = await launch_test_agent(str(tmp_path / "a"))
+        try:
+            restored = b.agent.subs.get(handle_id)
+            assert restored is not None, "sub must survive restart"
+            assert restored.sql == "SELECT id, text FROM tests"
+            assert restored.change_id >= 1  # watermark restored
+            assert restored.rows  # initial snapshot re-ran on restored data
+            # Resume from 0 (before the watermark, history gone): snapshot.
+            events = restored.backlog(from_change=0)
+            kinds = [e.to_json_obj() for e in events]
+            assert any("columns" in k for k in kinds)
+            assert any("eoq" in k for k in kinds)
+            # New changes keep numbering past the restored watermark.
+            before = restored.change_id
+            await b.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (2, 'two')"]]
+            )
+            await poll_until(
+                lambda: _past(b, handle_id, before), timeout=10
+            )
+        finally:
+            await b.stop()
+
+    async def _ready(agent, sid):
+        h = agent.agent.subs.get(sid)
+        return h is not None and h.change_id >= 1
+
+    async def _past(agent, sid, before):
+        return agent.agent.subs.get(sid).change_id > before
+
+    run(main())
+
+
+def test_stress_many_agents_randomized(tmp_path):
+    """stress_test analogue (agent.rs:3009): a 10-agent cluster bootstrapped
+    randomly, statements fired at random agents in concurrent chunks, then
+    every agent polled until the cluster-wide CRDT state converges."""
+    import random
+
+    async def main():
+        rng = random.Random(11)
+        agents = []
+        try:
+            first = await launch_test_agent(str(tmp_path / "a0"))
+            agents.append(first)
+            for i in range(1, 10):
+                peers = [rng.choice(agents).gossip_addr]
+                agents.append(
+                    await launch_test_agent(
+                        str(tmp_path / f"a{i}"), bootstrap=peers,
+                        sync_interval=0.4,
+                    )
+                )
+
+            async def fire(stmt_id: int):
+                target = rng.choice(agents)
+                await target.client.execute(
+                    [["INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+                      [stmt_id % 40, f"v{stmt_id}"]]]
+                )
+
+            # 150 statements in chunks of 10 concurrent.
+            for base in range(0, 150, 10):
+                await asyncio.gather(*[fire(base + j) for j in range(10)])
+
+            async def converged():
+                digests = set()
+                for t in agents:
+                    _, rows = t.agent.store.query(Statement(
+                        "SELECT group_concat(id || '=' || text, ',') FROM"
+                        " (SELECT id, text FROM tests ORDER BY id)"
+                    ))
+                    digests.add(rows[0][0])
+                return len(digests) == 1 and rows[0][0] is not None
+
+            await poll_until(converged, timeout=60, interval=0.5)
+            # Convergence must be to the LWW winner per row, identically
+            # everywhere — digest equality across 10 agents already implies
+            # it; sanity-check row count too.
+            _, rows = agents[0].agent.store.query(
+                Statement("SELECT count(*) FROM tests")
+            )
+            assert rows[0][0] == 40
+        finally:
+            await asyncio.gather(
+                *[t.stop() for t in agents], return_exceptions=True
+            )
+
+    run(main())

@@ -128,3 +128,140 @@ def test_split_statements_quote_aware():
     ) == ["INSERT INTO t VALUES (1, 'a;b')", "SELECT 'x;''y;'"]
     assert _split_statements('SELECT ";" AS "a;b"') == ['SELECT ";" AS "a;b"']
     assert _split_statements("  ;;  ") == []
+
+
+def _pg_msg(tag: bytes, payload: bytes) -> bytes:
+    import struct
+
+    return tag + struct.pack(">I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+def test_pg_extended_protocol(tmp_path):
+    """Parse/Bind/Describe/Execute/Sync — the libpq PQexecParams flow —
+    against a live agent, at the byte level (no PG client libs in-image)."""
+    import struct
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        from corrosion_tpu.agent.pg import serve_pg
+
+        server, (host, port) = await serve_pg(a.agent)
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            # Startup
+            startup = struct.pack(">I", 196608) + _cstr("user") + _cstr("t") + b"\x00"
+            writer.write(struct.pack(">I", len(startup) + 4) + startup)
+            await writer.drain()
+
+            async def read_msg():
+                header = await reader.readexactly(5)
+                tag = header[0:1]
+                (length,) = struct.unpack(">I", header[1:5])
+                return tag, await reader.readexactly(length - 4)
+
+            # Drain until ReadyForQuery
+            while (await read_msg())[0] != b"Z":
+                pass
+
+            # INSERT via extended flow with $1/$2 params (oids: int4, text)
+            parse = (_cstr("st1")
+                     + _cstr("INSERT INTO tests (id, text) VALUES ($1, $2)")
+                     + struct.pack(">H", 2)
+                     + struct.pack(">II", 23, 25))
+            bind = (_cstr("") + _cstr("st1")
+                    + struct.pack(">H", 1) + struct.pack(">H", 0)  # all text
+                    + struct.pack(">H", 2)
+                    + struct.pack(">i", 1) + b"7"
+                    + struct.pack(">i", 3) + b"ext"
+                    + struct.pack(">H", 0))
+            execute = _cstr("") + struct.pack(">i", 0)
+            writer.write(_pg_msg(b"P", parse) + _pg_msg(b"B", bind)
+                         + _pg_msg(b"E", execute) + _pg_msg(b"S", b""))
+            await writer.drain()
+            tags = []
+            while True:
+                tag, payload = await read_msg()
+                tags.append(tag)
+                if tag == b"C":
+                    assert payload.startswith(b"INSERT 0 1")
+                if tag == b"Z":
+                    break
+            assert tags[:3] == [b"1", b"2", b"C"]  # Parse/Bind/CommandComplete
+
+            # SELECT it back with a $1 param + Describe(portal)
+            parse = (_cstr("st2")
+                     + _cstr("SELECT id, text FROM tests WHERE id = $1")
+                     + struct.pack(">H", 1) + struct.pack(">I", 23))
+            bind = (_cstr("p2") + _cstr("st2")
+                    + struct.pack(">H", 0)  # default text format
+                    + struct.pack(">H", 1)
+                    + struct.pack(">i", 1) + b"7"
+                    + struct.pack(">H", 0))
+            describe = b"P" + _cstr("p2")
+            execute = _cstr("p2") + struct.pack(">i", 0)
+            writer.write(_pg_msg(b"P", parse) + _pg_msg(b"B", bind)
+                         + _pg_msg(b"D", describe) + _pg_msg(b"E", execute)
+                         + _pg_msg(b"S", b""))
+            await writer.drain()
+            saw = {}
+            while True:
+                tag, payload = await read_msg()
+                saw.setdefault(tag, payload)
+                if tag == b"Z":
+                    break
+            assert b"T" in saw  # RowDescription names the columns
+            assert b"id" in saw[b"T"] and b"text" in saw[b"T"]
+            assert b"D" in saw and b"ext" in saw[b"D"]  # the row came back
+            assert saw[b"C"].startswith(b"SELECT 1")
+
+            # Describe(statement) reports parameter oids; errors recover at Sync.
+            writer.write(_pg_msg(b"D", b"S" + _cstr("st2")) + _pg_msg(b"S", b""))
+            await writer.drain()
+            saw = {}
+            while True:
+                tag, payload = await read_msg()
+                saw.setdefault(tag, payload)
+                if tag == b"Z":
+                    break
+            assert b"t" in saw  # ParameterDescription
+            (n_oids,) = struct.unpack_from(">H", saw[b"t"], 0)
+            assert n_oids == 1
+
+            # Unknown statement -> error, then recovery after Sync.
+            bad_bind = (_cstr("") + _cstr("nope") + struct.pack(">H", 0)
+                        + struct.pack(">H", 0) + struct.pack(">H", 0))
+            writer.write(_pg_msg(b"B", bad_bind)
+                         + _pg_msg(b"E", _cstr("") + struct.pack(">i", 0))
+                         + _pg_msg(b"S", b""))
+            await writer.drain()
+            tags = []
+            while True:
+                tag, _ = await read_msg()
+                tags.append(tag)
+                if tag == b"Z":
+                    break
+            assert b"E" in tags  # ErrorResponse, Execute discarded
+            assert tags.count(b"E") == 1
+
+            writer.write(_pg_msg(b"X", b""))
+            writer.close()
+        finally:
+            server.close()
+            await a.stop()
+
+    run(main())
+
+
+def test_translate_placeholders():
+    from corrosion_tpu.agent.pg import translate_placeholders
+
+    assert translate_placeholders("SELECT $1, $2") == "SELECT ?1, ?2"
+    # $ inside literals must survive.
+    assert translate_placeholders("SELECT '$1', \"a$2\", $3") == (
+        "SELECT '$1', \"a$2\", ?3"
+    )
+    assert translate_placeholders("SELECT 1") == "SELECT 1"
