@@ -24,13 +24,25 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _time_plane(fn, *args, iters=5):
-    out = fn(*args)  # compile
-    jax.block_until_ready(jax.tree.leaves(out)[0])
+def _time_plane(step, carry, iters=10):
+    """Time a plane by scanning ``step`` inside ONE jitted computation:
+    per-call dispatch to the (remote) device costs hundreds of ms and
+    would otherwise dominate the measurement."""
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def scan(carry, n):
+        def body(c, i):
+            return step(c, i), ()
+
+        out, _ = jax.lax.scan(body, carry, jnp.arange(n))
+        return out
+
+    out = scan(carry, iters)  # compile
+    jax.block_until_ready(jax.tree.leaves(out))
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(jax.tree.leaves(out)[0])
+    out = scan(carry, iters)
+    jax.block_until_ready(jax.tree.leaves(out))
     return (time.perf_counter() - t0) / iters * 1000.0  # ms
 
 
@@ -69,26 +81,35 @@ def main() -> None:
     converged = bool((contig == heads[None, :]).all())
     cells_ok = bool(gossip_ops.cells_agree(final.data, cfg.gossip))
 
-    # Per-plane step-time breakdown on fresh state (isolated jitted calls).
-    data = gossip_ops.init_data(cfg.gossip)
-    sw = swim_ops.init_state(cfg.swim)
+    # Per-plane step-time breakdown on the run's FINAL state (fresh state
+    # would flatter sync — no deficits to score or grant), each measured
+    # as a jitted scan so remote-dispatch overhead doesn't pollute it.
+    data = final.data
+    swim_impl = swim_ops.impl(cfg.swim)
+    sw = final.swim
     alive = jnp.ones(cfg.n_nodes, bool)
     n_regions = int(np.asarray(topo.region).max()) + 1
     part = jnp.zeros((n_regions, n_regions), bool)
     writes = jnp.asarray(sched.writes[0], jnp.uint32)
     key = jax.random.PRNGKey(0)
     bcast_ms = _time_plane(
-        lambda: gossip_ops.broadcast_round(
-            data, topo, alive, part, writes, key, cfg.gossip
-        )
+        lambda d, i: gossip_ops.broadcast_round(
+            d, topo, alive, part, writes, jax.random.fold_in(key, i),
+            cfg.gossip,
+        )[0],
+        data,
     )
     sync_ms = _time_plane(
-        lambda: gossip_ops.sync_round(
-            data, topo, alive, part, jnp.int32(0), key, cfg.gossip
-        )
+        lambda d, i: gossip_ops.sync_round(
+            d, topo, alive, part, i, jax.random.fold_in(key, i), cfg.gossip
+        )[0],
+        data,
     )
     swim_ms = _time_plane(
-        lambda: swim_ops.swim_round(sw, key, jnp.int32(0), cfg.swim)
+        lambda s, i: swim_impl.swim_round(
+            s, jax.random.fold_in(key, i), i, cfg.swim
+        ),
+        sw,
     )
 
     state_bytes = sum(

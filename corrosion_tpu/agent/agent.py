@@ -241,11 +241,13 @@ class Agent:
         results, dbv, last_seq, changes = self.store.execute_transaction(
             statements
         )
-        resp, persist = self._finish_local_write(
+        resp, persist, frames = self._finish_local_write(
             results, dbv, last_seq, changes, t0
         )
         if persist is not None:
             persist()
+        for frame in frames:
+            self._queue_broadcast(frame)
         return resp
 
     async def execute_async(self, statements: list[Statement]) -> ExecResponse:
@@ -262,18 +264,26 @@ class Agent:
             results, dbv, last_seq, changes = self.store.execute_transaction(
                 statements
             )
-        resp, persist = self._finish_local_write(
+        resp, persist, frames = self._finish_local_write(
             results, dbv, last_seq, changes, t0
         )
         if persist is not None:
+            # Persist BEFORE dissemination: a frame on the wire whose
+            # version is not in __corro_bookkeeping could be re-allocated
+            # after a crash-restart — peers would dedupe the reused number
+            # and silently diverge.
             await self._store_write(persist)
+        for frame in frames:
+            self._queue_broadcast(frame)
         return resp
 
     def _finish_local_write(self, results, dbv, last_seq, changes, t0):
-        """Loop-confined bookkeeping; returns (response, persist_closure) —
-        the closure is store-only work the caller runs on the pool writer
-        (or inline, for the sync path)."""
+        """Loop-confined bookkeeping; returns (response, persist_closure,
+        broadcast_frames). The closure is store-only work the caller runs on
+        the pool writer (or inline for the sync path) — and MUST complete
+        before the frames are queued for dissemination."""
         persist = None
+        frames: list[dict] = []
         if dbv and changes:
             ts = self.hlc.new_timestamp()
             booked = self.bookie.for_actor(self.actor_id)
@@ -292,16 +302,18 @@ class Agent:
                 if self.subs is not None:
                     self.subs.persist_watermarks_sync(dirty)
 
-            # Chunk and queue for dissemination (public/mod.rs:128-187).
-            for chunk, (s, e) in chunk_changes(changes, last_seq):
-                self._queue_broadcast(
-                    self._changeset_frame(
-                        self.actor_id, version, chunk, (s, e), last_seq, ts
-                    )
+            # Chunk for dissemination (public/mod.rs:128-187); queued by
+            # the caller after the bookkeeping row is durable.
+            frames = [
+                self._changeset_frame(
+                    self.actor_id, version, chunk, (s, e), last_seq, ts
                 )
+                for chunk, (s, e) in chunk_changes(changes, last_seq)
+            ]
         return (
             ExecResponse(results=results, time=time.monotonic() - t0),
             persist,
+            frames,
         )
 
     async def restore_online(

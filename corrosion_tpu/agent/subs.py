@@ -298,7 +298,8 @@ class SubsManager:
     def restore(self) -> list[str]:
         """Recreate persisted subscriptions; returns restored ids. A query
         that no longer parses (schema changed under it) is dropped, like
-        the reference pruning dead sub dbs at boot."""
+        the reference pruning dead sub dbs at boot; transient failures
+        (e.g. a locked database) keep the row so the next boot retries."""
         restored = []
         for sub_id, sql, change_id in self.store.conn.execute(
             "SELECT id, sql, change_id FROM __corro_subs"
@@ -309,11 +310,17 @@ class SubsManager:
                 handle = MatcherHandle(
                     self.store, sql, sub_id=sub_id, start_change_id=change_id
                 )
-            except Exception:
-                with self.store._wlock("subs_prune"):
-                    self.store.conn.execute(
-                        "DELETE FROM __corro_subs WHERE id = ?", (sub_id,)
-                    )
+            except Exception as e:
+                msg = str(e).lower()
+                invalid = isinstance(e, ValueError) or (
+                    isinstance(e, sqlite3.Error)
+                    and ("no such" in msg or "syntax error" in msg)
+                )
+                if invalid:
+                    with self.store._wlock("subs_prune"):
+                        self.store.conn.execute(
+                            "DELETE FROM __corro_subs WHERE id = ?", (sub_id,)
+                        )
                 continue
             self._register(normalize_sql(sql), handle)
             restored.append(sub_id)

@@ -170,7 +170,10 @@ def merge_10k(n: int = 10_000, rounds: int = 120, samples: int = 256,
     )
     rng = np.random.default_rng(seed)
     writes = (rng.random((rounds, n)) < 0.01).astype(np.uint32)
-    writes[rounds - 40 :, :] = 0  # drain tail
+    # Drain tail, clamped so short runs still write (rounds - 40 would go
+    # negative and zero the whole schedule).
+    drain = min(40, max(rounds // 3, 1))
+    writes[rounds - drain :, :] = 0
     sched = Schedule(writes=writes).make_samples(samples)
     return cfg, topo, sched
 

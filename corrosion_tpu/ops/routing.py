@@ -72,18 +72,27 @@ def segmented_prefix_and(flags: jax.Array, seg_start: jax.Array) -> jax.Array:
     """Per-segment running AND of ``flags`` (segments marked by seg_start).
 
     out[i] = AND of flags[j] for j from the segment's first element to i.
-    Expressed with cummax + cumsum instead of a segmented associative_scan
-    (whose recursive lowering blows up XLA:TPU compile time at the
-    multi-million element sizes of the 100k-node configs): the AND holds
-    iff no False occurs between the segment start and i.
+    Flat [M] wrapper over the row-local formulation below.
     """
-    m = flags.shape[0]
-    if m == 0:
+    if flags.shape[0] == 0:
         return flags
-    idx = jnp.arange(m)
-    start = jax.lax.cummax(jnp.where(seg_start, idx, 0), axis=0)
-    bad = jnp.cumsum((~flags).astype(jnp.int32))  # inclusive False count
-    bad_before = bad[start] - (~flags[start]).astype(jnp.int32)
+    return segmented_prefix_and_rows(flags[None, :], seg_start[None, :])[0]
+
+
+def segmented_prefix_and_rows(
+    flags: jax.Array, seg_start: jax.Array
+) -> jax.Array:
+    """Row-local variant of ``segmented_prefix_and``: [N, K] inputs with
+    segments confined to each row (axis 1). Same cummax/cumsum formulation —
+    no associative_scan — vectorized across rows."""
+    k = flags.shape[1]
+    idx = jnp.arange(k)[None, :]
+    start = jax.lax.cummax(jnp.where(seg_start, idx, 0), axis=1)
+    bad = jnp.cumsum((~flags).astype(jnp.int32), axis=1)
+    take = jnp.take_along_axis
+    bad_before = take(bad, start, axis=1) - take(
+        (~flags).astype(jnp.int32), start, axis=1
+    )
     return (bad - bad_before) == 0
 
 

@@ -1,0 +1,93 @@
+"""Simulation checkpoint / resume + schedule (trace) persistence.
+
+The reference checkpoints by construction — all replication state lives in
+SQLite and rehydrates at boot (SURVEY.md §5, agent.rs:147-268). The sim
+analogue: a ClusterState snapshot plus the scripted Schedule IS a
+replayable trace. `simulate(state=...)` already chains runs and folds the
+absolute round index into each round's RNG key, so a save/resume sequence
+is bit-identical to an uninterrupted run (asserted in tests).
+
+Format: one .npz per snapshot — flat leaves keyed by pytree path, plus the
+structure fingerprint so loading against a mismatched config fails loudly
+instead of mis-zipping arrays.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+
+from corrosion_tpu.sim.engine import ClusterState, Schedule, init_cluster
+
+
+def _paths(tree) -> list[str]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [jax.tree_util.keystr(path) for path, _ in flat]
+
+
+def save_state(path: str, state: ClusterState) -> None:
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
+    arrays = {
+        f"leaf{idx}": np.asarray(leaf)
+        for idx, (_, leaf) in enumerate(leaves_with_paths)
+    }
+    arrays["__paths__"] = np.array(
+        json.dumps(_paths(state)).encode()
+    )
+    np.savez_compressed(path, **arrays)
+
+
+def load_state(path: str, cfg, n_samples: int) -> ClusterState:
+    """Load a snapshot written by ``save_state``; ``cfg``/``n_samples``
+    must describe the same cluster (shape + kernel selection)."""
+    with np.load(path) as data:
+        saved_paths = json.loads(bytes(data["__paths__"].item()).decode())
+        template = init_cluster(cfg, n_samples)
+        tmpl_paths = _paths(template)
+        if saved_paths != tmpl_paths:
+            raise ValueError(
+                "checkpoint structure does not match the config "
+                f"(saved {len(saved_paths)} leaves, config implies "
+                f"{len(tmpl_paths)}); was it written with a different "
+                "SwimConfig/GossipConfig?"
+            )
+        leaves = []
+        for idx, (tmpl_leaf, p) in enumerate(
+            zip(jax.tree.leaves(template), tmpl_paths)
+        ):
+            arr = data[f"leaf{idx}"]
+            if arr.shape != tmpl_leaf.shape:
+                raise ValueError(
+                    f"checkpoint leaf {p} has shape {arr.shape}, "
+                    f"config implies {tmpl_leaf.shape}"
+                )
+            leaves.append(arr.astype(tmpl_leaf.dtype))
+        treedef = jax.tree.structure(template)
+        return jax.tree.unflatten(treedef, leaves)
+
+
+def save_schedule(path: str, schedule: Schedule) -> None:
+    arrays = {"writes": schedule.writes}
+    for name in ("kill", "revive", "partition"):
+        v = getattr(schedule, name)
+        if v is not None:
+            arrays[name] = v
+    arrays["sample_writer"] = schedule.sample_writer
+    arrays["sample_ver"] = schedule.sample_ver
+    arrays["sample_round"] = schedule.sample_round
+    np.savez_compressed(path, **arrays)
+
+
+def load_schedule(path: str) -> Schedule:
+    with np.load(path) as data:
+        return Schedule(
+            writes=data["writes"],
+            kill=data["kill"] if "kill" in data else None,
+            revive=data["revive"] if "revive" in data else None,
+            partition=data["partition"] if "partition" in data else None,
+            sample_writer=data["sample_writer"],
+            sample_ver=data["sample_ver"],
+            sample_round=data["sample_round"],
+        )
