@@ -152,3 +152,36 @@ def _coerce(value: str, current: Any) -> Any:
 def parse_addr(addr: str) -> tuple[str, int]:
     host, _, port = addr.rpartition(":")
     return host or "127.0.0.1", int(port)
+
+
+def resolve_bootstrap(entries: list[str]) -> list[tuple[str, int]]:
+    """Expand bootstrap entries into peer addresses.
+
+    Plain ``host:port`` entries pass through. The reference's DNS resolver
+    syntax ``name:port@dns[:dns_port]`` (resolve_bootstrap,
+    corro-agent/src/agent.rs:1494-1586) resolves ``name`` and announces to
+    EVERY address it maps to; stdlib resolution is used (the custom-server
+    part of the syntax is accepted but the system resolver answers).
+    Unresolvable names are skipped — bootstrap keeps retrying via the
+    announce loop, matching the reference's tolerant startup.
+    """
+    import socket
+
+    out: list[tuple[str, int]] = []
+    for entry in entries:
+        spec, _, _dns = entry.partition("@")
+        host, port = parse_addr(spec)
+        if _dns:
+            try:
+                infos = socket.getaddrinfo(
+                    host, port, type=socket.SOCK_STREAM
+                )
+            except socket.gaierror:
+                continue
+            for info in infos:
+                addr = (info[4][0], port)
+                if addr not in out:
+                    out.append(addr)
+        else:
+            out.append((host, port))
+    return out

@@ -13,7 +13,7 @@ import json
 import os
 import sys
 
-from corrosion_tpu.agent.config import Config, parse_addr
+from corrosion_tpu.agent.config import Config, parse_addr, resolve_bootstrap
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -207,7 +207,7 @@ async def _run_agent(cfg: Config) -> int:
         gossip_port=gossip_port,
         api_host=api_host,
         api_port=api_port,
-        bootstrap=[parse_addr(b) for b in cfg.gossip.bootstrap],
+        bootstrap=resolve_bootstrap(cfg.gossip.bootstrap),
         schema_sql=cfg.schema_sql(),
         probe_interval=cfg.gossip.probe_interval_ms / 1000.0,
         sync_interval=cfg.gossip.sync_interval_ms / 1000.0,

@@ -68,23 +68,15 @@ def bounded_intake(
     return mask, tuple(outs)
 
 
-def segmented_prefix_and(flags: jax.Array, seg_start: jax.Array) -> jax.Array:
-    """Per-segment running AND of ``flags`` (segments marked by seg_start).
-
-    out[i] = AND of flags[j] for j from the segment's first element to i.
-    Flat [M] wrapper over the row-local formulation below.
-    """
-    if flags.shape[0] == 0:
-        return flags
-    return segmented_prefix_and_rows(flags[None, :], seg_start[None, :])[0]
-
-
 def segmented_prefix_and_rows(
     flags: jax.Array, seg_start: jax.Array
 ) -> jax.Array:
-    """Row-local variant of ``segmented_prefix_and``: [N, K] inputs with
-    segments confined to each row (axis 1). Same cummax/cumsum formulation —
-    no associative_scan — vectorized across rows."""
+    """Per-segment running AND of ``flags`` along each row.
+
+    [N, K] inputs with segments confined to a row (axis 1, marked by
+    seg_start): out[n, i] = AND of flags[n, j] from the segment's first
+    element to i. cummax/cumsum formulation — a segmented associative_scan
+    would blow up XLA:TPU compile time at message-plane sizes."""
     k = flags.shape[1]
     idx = jnp.arange(k)[None, :]
     start = jax.lax.cummax(jnp.where(seg_start, idx, 0), axis=1)

@@ -63,7 +63,12 @@ def load_state(path: str, cfg, n_samples: int) -> ClusterState:
                     f"checkpoint leaf {p} has shape {arr.shape}, "
                     f"config implies {tmpl_leaf.shape}"
                 )
-            leaves.append(arr.astype(tmpl_leaf.dtype))
+            if arr.dtype != tmpl_leaf.dtype:
+                raise ValueError(
+                    f"checkpoint leaf {p} has dtype {arr.dtype}, "
+                    f"config implies {tmpl_leaf.dtype}"
+                )
+            leaves.append(arr)
         treedef = jax.tree.structure(template)
         return jax.tree.unflatten(treedef, leaves)
 

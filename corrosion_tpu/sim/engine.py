@@ -181,6 +181,19 @@ def simulate(
     curve buffers. Results are identical either way — per-round RNG keys
     fold in the absolute round index.
     """
+    # The CRDT merge packs (cl, col_version) into one u32 (ops/crdt.py
+    # apply_changes): versions must stay below 2^24. Bound the reachable
+    # head conservatively at schedule-validation time so the domain is
+    # enforced loudly, not by silent bit bleed.
+    start_round = 0 if state is None else int(np.asarray(state.round))
+    max_head = (start_round + schedule.rounds) * max(
+        cfg.gossip.max_writes_per_round, 1
+    )
+    if cfg.gossip.n_cells > 0 and max_head >= (1 << 24):
+        raise ValueError(
+            f"reachable version head {max_head} exceeds the CRDT pack "
+            f"domain (< 2^24); shorten the run or disable the cell plane"
+        )
     if max_chunk is not None and schedule.rounds > max_chunk:
         cur = state
         curve_parts: list[dict] = []

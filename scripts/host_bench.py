@@ -1,0 +1,90 @@
+"""Host-agent benchmark: the reference's 2-node quick-start scenario.
+
+The only throughput number the reference publishes is a quick-start log
+excerpt: 2 changes synced in 0.0128 s ≈ 156 changes/s across a 2-node
+cluster (doc/quick-start.md:119, BASELINE.md). This script reproduces that
+scenario with REAL agents — two in-process nodes over real TCP loopback,
+writes on A via the HTTP API, convergence polled on B — and reports
+end-to-end replicated changes/s.
+
+Usage: python scripts/host_bench.py [n_changes] [batch]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+from corrosion_tpu.agent.testing import launch_test_agent, poll_until  # noqa: E402
+from corrosion_tpu.core.values import Statement  # noqa: E402
+
+
+async def main(n_changes: int, batch: int) -> None:
+    with tempfile.TemporaryDirectory() as d1, tempfile.TemporaryDirectory() as d2:
+        a = await launch_test_agent(d1, sync_interval=0.5)
+        b = await launch_test_agent(
+            d2, bootstrap=[a.gossip_addr], sync_interval=0.5
+        )
+        try:
+            # Warm the links + schema caches.
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (-1, 'warm')"]]
+            )
+
+            async def warm():
+                _, rows = b.agent.store.query(
+                    Statement("SELECT count(*) FROM tests")
+                )
+                return rows[0][0] == 1
+
+            await poll_until(warm, timeout=15)
+
+            t0 = time.monotonic()
+            for base in range(0, n_changes, batch):
+                stmts = [
+                    ["INSERT INTO tests (id, text) VALUES (?, ?)",
+                     [base + j, f"v{base + j}"]]
+                    for j in range(min(batch, n_changes - base))
+                ]
+                await a.client.execute(stmts)
+            write_done = time.monotonic()
+
+            async def converged():
+                _, rows = b.agent.store.query(
+                    Statement("SELECT count(*) FROM tests WHERE id >= 0")
+                )
+                return rows[0][0] == n_changes
+
+            await poll_until(converged, timeout=120, interval=0.02)
+            total = time.monotonic() - t0
+            print(
+                json.dumps(
+                    {
+                        "metric": "host_2node_replicated_changes_per_s",
+                        "value": round(n_changes / total, 1),
+                        "unit": "changes/s",
+                        # 156 changes/s = the reference's quick-start log
+                        # excerpt (doc/quick-start.md:119), its only
+                        # published throughput figure.
+                        "vs_baseline": round(n_changes / total / 156.0, 1),
+                        "n_changes": n_changes,
+                        "write_s": round(write_done - t0, 3),
+                        "end_to_end_s": round(total, 3),
+                    }
+                )
+            )
+        finally:
+            await b.stop()
+            await a.stop()
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    asyncio.run(main(n, batch))

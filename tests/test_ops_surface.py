@@ -225,3 +225,30 @@ def test_consul_diffing_basic_operations():
     assert sorted(s[0].split()[0] + " " + s[0].split()[2] for s in stmts4) == [
         "DELETE consul_checks", "DELETE consul_services",
     ]
+
+
+def test_resolve_bootstrap_dns_syntax(monkeypatch):
+    import socket
+
+    from corrosion_tpu.agent.config import resolve_bootstrap
+
+    # Deterministic resolver: no dependency on the host's DNS behavior
+    # (NXDOMAIN-hijacking resolvers would wildcard-resolve anything).
+    def fake_getaddrinfo(host, port, type=0):
+        if host == "seed.example":
+            return [
+                (socket.AF_INET, type, 6, "", ("10.0.0.1", port)),
+                (socket.AF_INET, type, 6, "", ("10.0.0.2", port)),
+                (socket.AF_INET, type, 6, "", ("10.0.0.1", port)),  # dup
+            ]
+        raise socket.gaierror("NXDOMAIN")
+
+    monkeypatch.setattr(socket, "getaddrinfo", fake_getaddrinfo)
+    # Plain entries pass through untouched (no resolution at all).
+    assert resolve_bootstrap(["10.0.0.9:8787"]) == [("10.0.0.9", 8787)]
+    # @dns resolves the name to every distinct address.
+    assert resolve_bootstrap(["seed.example:9999@dns"]) == [
+        ("10.0.0.1", 9999), ("10.0.0.2", 9999),
+    ]
+    # Unresolvable names are skipped, not fatal (announce loop retries).
+    assert resolve_bootstrap(["no.such.host.invalid:1@dns"]) == []
