@@ -49,6 +49,11 @@ class AgentConfig:
     api_host: str = "127.0.0.1"
     api_port: int = 0
     bootstrap: list[tuple[str, int]] = field(default_factory=list)
+    # Raw bootstrap specs ("host:port" or "name:port@dns") re-resolved by
+    # the announcer loop until peers appear — DNS may not be published yet
+    # at startup (resolve_bootstrap, agent.rs:1494-1586 + announcer
+    # backoff, agent.rs:726-768).
+    bootstrap_raw: list[str] = field(default_factory=list)
     schema_sql: str = ""
     probe_interval: float = 0.25
     broadcast_interval: float = 0.05  # flush tick (500 ms in the reference)
@@ -216,6 +221,26 @@ class Agent:
             )
         for addr in self.cfg.bootstrap:
             await self.swim.announce(tuple(addr))
+        if self.cfg.bootstrap_raw:
+            self.tasks.spawn(
+                self._bootstrap_loop(), name="bootstrap_announcer"
+            )
+
+    async def _bootstrap_loop(self) -> None:
+        """Re-resolve + re-announce bootstrap seeds with backoff until the
+        member list is non-empty (the announcer loop, agent.rs:726-768):
+        a seed name may not be DNS-published yet when this node starts."""
+        from corrosion_tpu.agent.config import resolve_bootstrap
+        from corrosion_tpu.utils.backoff import Backoff
+
+        backoff = Backoff(min_wait=1.0, max_wait=30.0)
+        while not self.tripwire.tripped:
+            if self.members.alive():
+                return  # joined; SWIM keeps the membership from here
+            for addr in resolve_bootstrap(self.cfg.bootstrap_raw):
+                if addr != self.gossip_addr:
+                    await self.swim.announce(addr)
+            await asyncio.sleep(next(backoff))
 
     async def stop(self) -> None:
         self.tripwire.trip()

@@ -39,6 +39,7 @@ from corrosion_tpu.core.values import (
     QueryEventColumns,
     QueryEventEndOfQuery,
     QueryEventRow,
+    unpack_columns,
 )
 
 MAX_CHANGE_HISTORY = 8192
@@ -101,6 +102,8 @@ class MatcherHandle:
         if not self.tables:
             raise ValueError("query reads no user tables")
         self._pk_prefix = 0
+        self._pk_table: str | None = None
+        self._local_membership = False
         self._exec_sql = sql
         self._maybe_inject_pks()
         self.columns: list[str] = []
@@ -132,14 +135,26 @@ class MatcherHandle:
         info = self.store.tables().get(table)
         if info is None:
             return
-        select_list, tail = m.group(1), m.group(3) or ""
+        select_list = m.group(1)
+        tail = (m.group(3) or "").rstrip().rstrip(";")
         if re.search(r"(?i)\b(count|sum|avg|min|max|group_concat)\s*\(", select_list):
             return
-        pk_cols = ", ".join(f'"{table}"."{c}"' for c in info.pk_cols)
+        pk_cols = ", ".join(
+            f'"{table}"."{c}" AS __pk{i}'
+            for i, c in enumerate(info.pk_cols)
+        )
         self._exec_sql = (
             f'SELECT {pk_cols}, {select_list} FROM "{table}"{tail}'
         )
         self._pk_prefix = len(info.pk_cols)
+        self._pk_table = table
+        # Candidate-only re-evaluation is sound only when a row's result
+        # membership depends on that row alone: LIMIT windows, GROUP BY,
+        # and subqueries make membership global — a change to one PK can
+        # evict another row, which only a full diff notices.
+        self._local_membership = not re.search(
+            r"(?i)\b(limit|group)\b|\(\s*select\b", tail
+        )
 
     def _evaluate(self) -> tuple[list[str], dict[tuple, tuple]]:
         cur = self.store.read_conn.execute(self._exec_sql)
@@ -166,10 +181,95 @@ class MatcherHandle:
     def interested(self, changes: list[Change]) -> bool:
         return any(ch.table in self.tables for ch in changes)
 
-    def process(self) -> list[QueryEventChange]:
-        """Re-evaluate and diff (the rewritten-query + EXCEPT diff of the
-        reference collapses to snapshot diffing here)."""
-        _, new_rows = self._evaluate()
+    # Candidate batches above this fall back to a full re-evaluation (one
+    # scan beats thousands of point lookups).
+    MAX_CANDIDATES = 512
+
+    def process(
+        self, changes: list[Change] | None = None
+    ) -> list[QueryEventChange]:
+        """Diff against the store and emit change events.
+
+        With PK identity and a change batch, only the candidate PKs are
+        re-evaluated (the reference's handle_candidates: temp PK tables +
+        rewritten per-table queries, pubsub.rs:1303-1570) — O(changed rows),
+        not O(result set). Other shapes (joins, aggregates, no batch) fall
+        back to full snapshot diffing.
+        """
+        candidates = self._candidate_keys(changes)
+        if candidates is None:
+            _, new_rows = self._evaluate()
+            events = self._diff_full(new_rows)
+        else:
+            events = self._diff_candidates(candidates)
+        for ev in events:
+            self.history.append(ev)
+            for q in self._listeners:
+                try:
+                    q.put_nowait(ev)
+                except asyncio.QueueFull:
+                    pass
+        return events
+
+    def _candidate_keys(self, changes) -> list[tuple] | None:
+        """Distinct changed identity keys, or None when incremental
+        evaluation does not apply (filter_matchable_change's role)."""
+        if changes is None or self._pk_prefix == 0 or not self._local_membership:
+            return None
+        keys: dict[tuple, None] = {}
+        for ch in changes:
+            if ch.table != self._pk_table:
+                if ch.table in self.tables:
+                    return None  # another dep table changed: full pass
+                continue
+            try:
+                keys[unpack_columns(ch.pk)] = None
+            except Exception:
+                return None
+        if len(keys) > self.MAX_CANDIDATES:
+            return None
+        return list(keys)
+
+    def _diff_candidates(self, keys: list[tuple]) -> list[QueryEventChange]:
+        if not keys:
+            return []
+        npk = self._pk_prefix
+        row_vals = ", ".join(
+            "(" + ", ".join("?" for _ in range(npk)) + ")" for _ in keys
+        )
+        # The injected pk prefix is aliased __pk0..__pkN-1, addressable
+        # through the wrapper for the candidate row-value filter.
+        where = "(" + ", ".join(
+            f'"__q"."__pk{i}"' for i in range(npk)
+        ) + ") IN (VALUES " + row_vals + ")"
+        sql = (
+            "SELECT * FROM (" + self._exec_sql + ") AS __q WHERE " + where
+        )
+        params = [v for key in keys for v in key]
+        cur = self.store.read_conn.execute(sql, params)
+        fresh = {
+            tuple(row[:npk]): tuple(row[npk:]) for row in cur.fetchall()
+        }
+        events: list[QueryEventChange] = []
+        for key in keys:
+            cells = fresh.get(key)
+            if cells is None:
+                if key in self.rows:
+                    events.append(
+                        self._emit(CHANGE_DELETE, key, self.rows.pop(key))
+                    )
+                    self.rowids.pop(key, None)
+            elif key not in self.rows:
+                self.rowids.setdefault(key, self._next_rowid)
+                self._next_rowid += 1
+                self.rows[key] = cells
+                events.append(self._emit(CHANGE_INSERT, key, cells))
+            elif self.rows[key] != cells:
+                self.rows[key] = cells
+                events.append(self._emit(CHANGE_UPDATE, key, cells))
+        return events
+
+    def _diff_full(self, new_rows) -> list[QueryEventChange]:
         events: list[QueryEventChange] = []
         for key, cells in new_rows.items():
             if key not in self.rows:
@@ -183,13 +283,6 @@ class MatcherHandle:
                 events.append(self._emit(CHANGE_DELETE, key, cells))
                 self.rowids.pop(key, None)
         self.rows = new_rows
-        for ev in events:
-            self.history.append(ev)
-            for q in self._listeners:
-                try:
-                    q.put_nowait(ev)
-                except asyncio.QueueFull:
-                    pass
         return events
 
     def _emit(self, kind, key, cells) -> QueryEventChange:
@@ -339,7 +432,7 @@ class SubsManager:
         write lock."""
         dirty = []
         for handle in self._by_id.values():
-            if handle.interested(changes) and handle.process():
+            if handle.interested(changes) and handle.process(changes):
                 dirty.append((handle.id, handle.change_id))
         return dirty
 

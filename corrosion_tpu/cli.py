@@ -208,6 +208,7 @@ async def _run_agent(cfg: Config) -> int:
         api_host=api_host,
         api_port=api_port,
         bootstrap=resolve_bootstrap(cfg.gossip.bootstrap),
+        bootstrap_raw=list(cfg.gossip.bootstrap),
         schema_sql=cfg.schema_sql(),
         probe_interval=cfg.gossip.probe_interval_ms / 1000.0,
         sync_interval=cfg.gossip.sync_interval_ms / 1000.0,

@@ -86,6 +86,10 @@ def churn_32(rounds: int = 400, samples: int = 128, seed: int = 1):
     writes = np.zeros((rounds, n), np.uint32)
     write_mask = rng.random((rounds, n)) < 0.02
     writes[write_mask] = 1
+    # Drain tail so the final state is a convergence check, not a snapshot
+    # of in-flight writes (clamped for short runs).
+    drain = min(40, max(rounds // 4, 1))
+    writes[rounds - drain :, :] = 0
     kill = np.zeros((rounds, n), bool)
     revive = np.zeros((rounds, n), bool)
     flappers = rng.choice(n, size=10, replace=False)

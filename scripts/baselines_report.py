@@ -1,0 +1,64 @@
+"""Measure every BASELINE scenario and print one JSON line per config.
+
+Configs 1-3 (3-node, 32-node churn, 1k anti-entropy) run here; config 4 is
+bench.py's headline and config 5 is scripts/wan100k_smoke.py — run those
+separately (they take minutes at full scale). Each line reports
+convergence + visibility so the five-scenario story is reproducible with
+three commands.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from corrosion_tpu import models  # noqa: E402
+from corrosion_tpu.ops import gossip  # noqa: E402
+from corrosion_tpu.sim import simulate, visibility_latencies  # noqa: E402
+
+
+def run(name, builder, **kw):
+    cfg, topo, sched = builder(**kw)
+    t0 = time.perf_counter()
+    final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=40)
+    jax.block_until_ready(final.data.contig)
+    wall = time.perf_counter() - t0
+    alive = np.asarray(final.swim.alive)
+    contig = np.asarray(final.data.contig)[alive]
+    heads = np.asarray(final.data.head)
+    lat = visibility_latencies(final, sched, cfg)
+    out = {
+        "config": name,
+        "nodes": cfg.n_nodes,
+        "rounds": sched.rounds,
+        "converged": bool((contig == heads[None, :]).all()),
+        "cells_converged": (
+            bool(gossip.cells_agree(final.data, cfg.gossip))
+            if cfg.gossip.n_cells else None
+        ),
+        "p50_s": round(lat["p50_s"], 2),
+        "p99_s": round(lat["p99_s"], 2),
+        "unseen": lat["unseen"],
+        "mismatches_final": int(curves["mismatches"][-1]),
+        "wall_s": round(wall, 1),
+    }
+    print(json.dumps(out), flush=True)
+
+
+def main() -> None:
+    print(
+        f"# platform={jax.devices()[0].platform}", file=sys.stderr, flush=True
+    )
+    run("1_three_node_1k_inserts", models.three_node)
+    run("2_churn_32", models.churn_32)
+    run("3_anti_entropy_1k", models.anti_entropy_1k)
+
+
+if __name__ == "__main__":
+    main()

@@ -380,3 +380,95 @@ def test_stress_many_agents_randomized(tmp_path):
             )
 
     run(main())
+
+
+def test_subscription_semicolon_and_limit_membership(tmp_path):
+    """Regression: a trailing ';' in the subscribed SQL must not break the
+    candidate path, and LIMIT queries must keep full-diff semantics (a row
+    evicted from the window without its own PK changing must be deleted)."""
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        try:
+            # Trailing semicolon + WHERE tail → candidate path must work.
+            h = a.agent.subs.subscribe("SELECT id, text FROM tests WHERE id > 0;")
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'x')"]]
+            )
+
+            async def got_insert():
+                return any(
+                    ev.kind == "insert" for ev in h.history
+                )
+
+            await poll_until(got_insert, timeout=10)
+
+            # LIMIT window: inserting a smaller id evicts the old row; the
+            # eviction must be emitted even though its PK never changed.
+            h2 = a.agent.subs.subscribe(
+                "SELECT id, text FROM tests2 ORDER BY id LIMIT 1"
+            )
+            assert not h2._local_membership
+            await a.client.execute(
+                [["INSERT INTO tests2 (id, text) VALUES (5, 'five')"]]
+            )
+
+            async def window_has_five():
+                return list(h2.rows) == [(5,)]
+
+            await poll_until(window_has_five, timeout=10)
+            await a.client.execute(
+                [["INSERT INTO tests2 (id, text) VALUES (2, 'two')"]]
+            )
+
+            async def window_swapped():
+                return list(h2.rows) == [(2,)]
+
+            await poll_until(window_swapped, timeout=10)
+            kinds = [ev.kind for ev in h2.history]
+            assert "delete" in kinds, kinds  # the evicted row was emitted
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_bootstrap_announcer_retries_until_join(tmp_path):
+    """A node whose seed name resolves only LATER must still join (the
+    announcer loop re-resolves with backoff, agent.rs:726-768)."""
+    import socket
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        real = a.gossip_addr
+        published = False
+        orig = socket.getaddrinfo
+
+        def fake(host, port, type=0, *args, **kw):
+            if host == "seed.later":
+                if not published:
+                    raise socket.gaierror("NXDOMAIN")
+                return [(socket.AF_INET, socket.SOCK_STREAM, 6, "",
+                         (real[0], port))]
+            return orig(host, port, type, *args, **kw)
+
+        socket.getaddrinfo = fake
+        try:
+            b = await launch_test_agent(
+                str(tmp_path / "b"),
+                bootstrap_raw=[f"seed.later:{real[1]}@dns"],
+            )
+            await asyncio.sleep(0.3)
+            assert not b.agent.members.alive(), "must not join before DNS"
+            published = True
+
+            async def joined():
+                return bool(b.agent.members.alive())
+
+            await poll_until(joined, timeout=30)
+            await b.stop()
+        finally:
+            socket.getaddrinfo = orig
+            await a.stop()
+
+    run(main())
