@@ -64,6 +64,11 @@ class AgentConfig:
     ingest_batch: int = 1000  # handle_changes batching (agent.rs:2450-2518)
     ingest_linger: float = 0.05
     admin_uds: str = ""  # unix socket path for admin RPC ("" = disabled)
+    # Compaction cadence. The reference runs clear_overwritten_versions
+    # every 300 s and batches empties for 120 s (agent.rs:86, :2520);
+    # scaled down to in-process test time.
+    compact_interval: float = 5.0
+    empties_flush_interval: float = 0.5
     tls: "AgentTls | None" = None  # gossip-plane TLS (None = plaintext)
     prometheus_addr: str = ""  # host:port for /metrics ("" = disabled)
     trace_export_path: str = ""  # JSON-lines span export ("" = in-memory)
@@ -144,6 +149,13 @@ class Agent:
         self.api_addr: tuple[str, int] | None = None
         self.swim: Swim | None = None
         self._pending: list[PendingBroadcast] = []
+        # Cleared version ranges awaiting persistence, batched like
+        # write_empties_loop (agent.rs:2522-2571).
+        self._empties: dict[str, RangeSet] = {}
+        self._m_cleared = self.metrics.counter(
+            "corro_versions_cleared",
+            "versions compacted to Cleared (clear_overwritten_versions)",
+        )
         self._ingest: asyncio.Queue = asyncio.Queue(maxsize=4096)
         self._addr_of: dict[str, tuple[str, int]] = {}
         self._api_server = None
@@ -207,6 +219,10 @@ class Agent:
         self.tasks.spawn(self._broadcast_loop(), name="broadcast_loop")
         self.tasks.spawn(self._ingest_loop(), name="handle_changes")
         self.tasks.spawn(self._sync_loop(), name="sync_loop")
+        self.tasks.spawn(
+            self._compact_loop(), name="clear_overwritten_versions"
+        )
+        self.tasks.spawn(self._empties_loop(), name="write_empties_loop")
         if self.cfg.admin_uds:
             from corrosion_tpu.agent.admin import start_admin
 
@@ -246,6 +262,13 @@ class Agent:
         self.tripwire.trip()
         await self.tasks.cancel_all()
         await self.tasks.wait_for_all_pending_handles(cap=5.0)
+        # Drain unpersisted cleared ranges (write_empties_loop drains its
+        # queue before shutdown, agent.rs:2558-2570).
+        if self._empties:
+            try:
+                await self._flush_empties()
+            except Exception:
+                pass
         self.transport.close()
         if self._api_server is not None:
             self._api_server.close()
@@ -656,6 +679,87 @@ class Agent:
                 actor, version, all_changes, last_seq, ts
             )
 
+    # -- compaction (clear_overwritten_versions + write_empties_loop) ----------
+
+    def _queue_empty(self, actor: str, start: int, end: int) -> None:
+        self._empties.setdefault(actor, RangeSet()).insert(start, end)
+
+    async def _compact_loop(self) -> None:
+        """Periodically find fully-overwritten versions and clear them
+        (clear_overwritten_versions, agent.rs:995-1126)."""
+        while not self.tripwire.tripped:
+            await asyncio.sleep(self.cfg.compact_interval)
+            try:
+                await self._compact_once()
+            except Exception:
+                pass
+
+    async def _compact_once(self) -> None:
+        for actor, booked in list(self.bookie.items()):
+            versions = booked.current_versions()  # db_version -> version
+            if not versions:
+                continue
+            site = bytes.fromhex(actor)
+            # Read-side probe (the reference uses a read txn off the writer,
+            # agent.rs:1046-1057); cheap enough to run on the loop here.
+            cleared_dbvs = self.store.find_cleared_versions(site)
+            to_clear = [
+                v for dbv, v in versions.items() if dbv in cleared_dbvs
+            ]
+            if not to_clear:
+                continue
+            for v in to_clear:
+                # Re-check Current: an interleaved await may have changed it.
+                if isinstance(booked.get(v), Current):
+                    booked.insert(v, CLEARED)
+                    self._m_cleared.inc()
+            # Queue each affected cleared RANGE (versions coalesce with
+            # neighbours already cleared) for batched persistence.
+            seen: set[tuple[int, int]] = set()
+            for v in to_clear:
+                for s, e in booked.cleared:
+                    if s <= v <= e and (s, e) not in seen:
+                        seen.add((s, e))
+                        self._queue_empty(actor, s, e)
+            await asyncio.sleep(0)  # yield between actors (agent.rs:1114)
+
+    async def _empties_loop(self) -> None:
+        """Batch queued cleared ranges into collapsed bookkeeping rows
+        (write_empties_loop, agent.rs:2522-2571)."""
+        while not self.tripwire.tripped:
+            await asyncio.sleep(self.cfg.empties_flush_interval)
+            if self._empties:
+                try:
+                    await self._flush_empties()
+                except Exception:
+                    pass
+
+    async def _flush_empties(self) -> None:
+        empties, self._empties = self._empties, {}
+
+        def db_work() -> None:
+            for actor, ranges in empties.items():
+                site = bytes.fromhex(actor)
+                for s, e in list(ranges):
+                    self.store.store_empty_changeset(site, s, e)
+
+        try:
+            # Background write tier, like process_completed_empties' low-pri
+            # txn.
+            if self.pool is not None:
+                await self.pool.write_low(db_work)
+            else:
+                db_work()
+        except Exception:
+            # A transient write failure (busy/disk) must not lose the batch:
+            # the bookie already says Cleared, so these ranges would never be
+            # rediscovered. Re-merge for the next flush tick.
+            for actor, ranges in empties.items():
+                dst = self._empties.setdefault(actor, RangeSet())
+                for s, e in ranges:
+                    dst.insert(s, e)
+            raise
+
     # -- SWIM loop -------------------------------------------------------------
 
     async def _swim_loop(self) -> None:
@@ -738,6 +842,10 @@ class Agent:
                         booked = self.bookie.for_actor(frame["actor"])
                         for s, e in frame["versions"]:
                             booked.insert_many(s, e, CLEARED)
+                            # Persist via the empties batcher so the range
+                            # survives restart (store path of
+                            # process_multiple_changes' empty handling).
+                            self._queue_empty(frame["actor"], s, e)
             finally:
                 session.close()
                 sess_hist.observe(time.monotonic() - t_start)

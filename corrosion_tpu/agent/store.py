@@ -34,6 +34,7 @@ import threading
 from dataclasses import dataclass
 
 from corrosion_tpu import native
+from corrosion_tpu.core.intervals import RangeSet
 from corrosion_tpu.core.values import (
     Change,
     Statement,
@@ -393,6 +394,13 @@ class Store:
             " seq INTEGER NOT NULL, site_id BLOB,"
             " PRIMARY KEY (pk, cid)) WITHOUT ROWID"
         )
+        # Compaction probes scan (site_id, db_version); the reference
+        # creates the same index for find_cleared_db_versions
+        # (agent.rs:3238-3239).
+        c.execute(
+            f"CREATE INDEX IF NOT EXISTS {_q(t + '__crdt_clock_site_dbv')}"
+            f" ON {_q(t + '__crdt_clock')} (site_id, db_version)"
+        )
         self._create_triggers(c, info)
 
     def _drop_triggers(self, c: sqlite3.Connection, info: TableInfo) -> None:
@@ -440,18 +448,33 @@ class Store:
             )
 
         # INSERT: resurrect-or-create the row's causal length, then record
-        # every data column (or a pk-only marker).
+        # every data column (or a pk-only marker). A resurrection retires the
+        # delete sentinel: its version stops being referenced by any clock
+        # row and becomes compactable (find_cleared_db_versions semantics,
+        # agent.rs:1250-1299).
         body = (
             f"INSERT INTO {rows_t} (pk, cl) VALUES ({pk_expr}, 1)"
             " ON CONFLICT (pk) DO UPDATE SET"
             "  cl = CASE WHEN cl % 2 = 0 THEN cl + 1 ELSE cl END;\n"
+            f"DELETE FROM {clock_t} WHERE pk = {pk_expr}"
+            f" AND cid = '{Change.DELETE_CID}';\n"
         )
         if info.data_cols:
             for col in info.data_cols:
                 body += cell_sql(col, pk_expr)
         else:
+            # PK-only rows keep a sentinel clock entry so their creating
+            # version stays "live" for compaction purposes: cr-sqlite models
+            # this with a __crsql_pko clock row — without it the version
+            # would look overwritten immediately and peers that missed the
+            # broadcast would never receive the row.
             body += (
                 "UPDATE __corro_meta SET value = value + 1 WHERE key='seq';\n"
+                f"INSERT INTO {clock_t} (pk, cid, col_version, db_version, seq, site_id)"
+                f" VALUES ({pk_expr}, '{Change.PKONLY_CID}', 1, {dbv}, {seq}, NULL)"
+                " ON CONFLICT (pk, cid) DO UPDATE SET"
+                "  db_version = excluded.db_version,"
+                "  seq = excluded.seq, site_id = NULL;\n"
                 "INSERT INTO __crdt_changes"
                 " (tbl, pk, cid, val, col_version, db_version, seq, site_id, cl)"
                 f" SELECT '{_qs(t)}', {pk_expr}, '{Change.PKONLY_CID}', NULL, 1,"
@@ -474,13 +497,19 @@ class Store:
                 f" BEGIN\n{cell_sql(col, pk_expr)}END"
             )
 
-        # DELETE: causal length goes even, clock clears, sentinel change.
+        # DELETE: causal length goes even, cell clocks clear, and a delete
+        # sentinel clock row keeps the tombstone's db_version live — cr-sqlite
+        # keeps a __crsql_del clock entry for exactly this reason: if the
+        # delete's version were compacted away, a peer that missed the delete
+        # broadcast would get "cleared" from sync and keep the row forever.
         c.execute(
             f"CREATE TRIGGER {_q(t + '__crdt_del')} AFTER DELETE ON {_q(t)}"
             f" {local_guard} BEGIN\n"
             f"UPDATE {rows_t} SET cl = cl + 1 WHERE pk = {old_pk_expr} AND cl % 2 = 1;\n"
             f"DELETE FROM {clock_t} WHERE pk = {old_pk_expr};\n"
             "UPDATE __corro_meta SET value = value + 1 WHERE key='seq';\n"
+            f"INSERT INTO {clock_t} (pk, cid, col_version, db_version, seq, site_id)"
+            f" VALUES ({old_pk_expr}, '{Change.DELETE_CID}', 1, {dbv}, {seq}, NULL);\n"
             "INSERT INTO __crdt_changes"
             " (tbl, pk, cid, val, col_version, db_version, seq, site_id, cl)"
             f" SELECT '{_qs(t)}', {old_pk_expr}, '{Change.DELETE_CID}', NULL, 1,"
@@ -574,6 +603,117 @@ class Store:
             Change.from_tuple(r) for r in self.conn.execute(sql, args).fetchall()
         ]
 
+    # -- compaction (clear_overwritten_versions, agent.rs:995-1299) ----------
+
+    def find_cleared_versions(self, site_id: bytes) -> set[int]:
+        """db_versions of ``site_id`` that no live clock row references —
+        every cell they wrote has been overwritten by a newer version
+        (find_cleared_db_versions, agent.rs:1250-1299). Delete/pk-only
+        sentinel clock rows keep tombstone versions live until superseded.
+        Local writes store NULL in clock site_id (like crsql ordinal 0), so
+        the probe uses ``IS ?``.
+        """
+        if not self._tables:
+            return set()
+        probe = None if site_id == self.site_id else site_id
+        parts: list[str] = []
+        params: list = [site_id]
+        for name in self._tables:
+            clock_t = _q(name + "__crdt_clock")
+            parts.append(
+                f"SELECT DISTINCT db_version FROM {clock_t} WHERE site_id IS ?"
+            )
+            params.append(probe)
+        sql = (
+            "SELECT DISTINCT db_version FROM __corro_bookkeeping"
+            " WHERE actor_id = ? AND db_version IS NOT NULL"
+            " EXCEPT SELECT db_version FROM ("
+            + " UNION ".join(parts)
+            + ")"
+        )
+        return {row[0] for row in self.read_conn.execute(sql, params)}
+
+    def store_empty_changeset(
+        self, actor_id: bytes, start: int, end: int
+    ) -> int:
+        """Collapse [start, end] into one cleared (db_version-less)
+        bookkeeping range row, merging overlapping/adjacent rows — the
+        range-collapsing DELETE+INSERT of store_empty_changeset
+        (agent.rs:1588-1664) — then prune the change log and partial
+        buffers those versions owned. Returns the number of range rows
+        written (1, or 0 if the merge produced nothing new)."""
+        c = self.conn
+        with self._wlock("store_empty_changeset"):
+            try:
+                c.execute("BEGIN IMMEDIATE")
+                # Overlap/adjacency predicate (store_empty_changeset's
+                # DELETE, agent.rs:1598-1614, with its straddle-the-start
+                # hole closed): current singles (end_version NULL) inside
+                # the range, and cleared ranges (end_version set) that
+                # overlap or touch [start-1, end+1] — contained, straddling
+                # either end, containing, or exactly adjacent.
+                pred = (
+                    " actor_id = ? AND ("
+                    "  (end_version IS NULL AND start_version BETWEEN ? AND ?)"
+                    "  OR (end_version IS NOT NULL AND start_version <= ?"
+                    "      AND end_version >= ?))"
+                )
+                args = (actor_id, start, end, end + 1, start - 1)
+                rows = c.execute(
+                    "SELECT start_version, end_version, db_version"
+                    " FROM __corro_bookkeeping WHERE" + pred,
+                    args,
+                ).fetchall()
+                merged = RangeSet([(start, end)])
+                for sv, ev, _dbv in rows:
+                    merged.insert(sv, ev if ev is not None else sv)
+                if len(merged) > 1:
+                    # Failsafe mirrored from the reference: deleting
+                    # non-contiguous ranges means bookkeeping is corrupt.
+                    raise StoreError(
+                        f"store_empty_changeset would merge non-contiguous"
+                        f" ranges: {list(merged)}"
+                    )
+                c.execute(
+                    "DELETE FROM __corro_bookkeeping WHERE" + pred, args
+                )
+                inserted = 0
+                for s, e in merged:
+                    c.execute(
+                        "INSERT INTO __corro_bookkeeping (actor_id,"
+                        " start_version, end_version, db_version, last_seq, ts)"
+                        " VALUES (?, ?, ?, NULL, NULL, NULL)",
+                        (actor_id, s, e),
+                    )
+                    inserted += 1
+                # Prune: the change log rows for the cleared db_versions (the
+                # actual space reclaim — the crsql vtab does this implicitly
+                # because overwritten clock rows vanish), and any stale
+                # partial buffers within the cleared span.
+                dbvs = [r[2] for r in rows if r[2] is not None]
+                if dbvs:
+                    qs = ",".join("?" for _ in dbvs)
+                    c.execute(
+                        f"DELETE FROM __crdt_changes WHERE site_id = ?"
+                        f" AND db_version IN ({qs})",
+                        (actor_id, *dbvs),
+                    )
+                c.execute(
+                    "DELETE FROM __corro_buffered_changes"
+                    " WHERE actor_id = ? AND version BETWEEN ? AND ?",
+                    (actor_id, start, end),
+                )
+                c.execute(
+                    "DELETE FROM __corro_seq_bookkeeping"
+                    " WHERE actor_id = ? AND version BETWEEN ? AND ?",
+                    (actor_id, start, end),
+                )
+                c.execute("COMMIT")
+            except Exception:
+                c.execute("ROLLBACK")
+                raise
+        return inserted
+
     # -- remote merge (process_multiple_changes, agent.rs:1809-2060) ---------
 
     def apply_changes(self, changes: list[Change]) -> int:
@@ -622,11 +762,17 @@ class Store:
             c.execute(f"DELETE FROM {clock_t} WHERE pk = ?", (ch.pk,))
             if ch.cl % 2 == 0:
                 self._delete_row(c, info, ch.pk)
-            else:
-                self._ensure_row(c, info, ch.pk)
-            if ch.cl % 2 == 0 or ch.cid in (
-                Change.DELETE_CID, Change.PKONLY_CID,
-            ):
+                # Tombstone sentinel: keeps the delete's db_version live in
+                # the clock so compaction can't clear it (see _create_crr).
+                self._upsert_clock_sentinel(c, clock_t, Change.DELETE_CID, ch)
+                self._log_change(c, ch)
+                return True
+            self._ensure_row(c, info, ch.pk)
+            if ch.cid in (Change.DELETE_CID, Change.PKONLY_CID):
+                if ch.cid == Change.PKONLY_CID:
+                    self._upsert_clock_sentinel(
+                        c, clock_t, Change.PKONLY_CID, ch
+                    )
                 self._log_change(c, ch)
                 return True
             # fall through: apply (and log) the cell in the fresh epoch
@@ -637,6 +783,7 @@ class Store:
                 return False  # delete sentinel for an epoch we've superseded
             if ch.cid == Change.PKONLY_CID:
                 self._ensure_row(c, info, ch.pk)
+                self._upsert_clock_sentinel(c, clock_t, Change.PKONLY_CID, ch)
                 self._log_change(c, ch)
                 return True
 
@@ -686,6 +833,18 @@ class Store:
         )
         self._log_change(c, ch)
         return True
+
+    def _upsert_clock_sentinel(
+        self, c: sqlite3.Connection, clock_t: str, cid: str, ch: Change
+    ) -> None:
+        c.execute(
+            f"INSERT INTO {clock_t} (pk, cid, col_version, db_version, seq, site_id)"
+            " VALUES (?, ?, 1, ?, ?, ?)"
+            " ON CONFLICT (pk, cid) DO UPDATE SET"
+            "  db_version = excluded.db_version,"
+            "  seq = excluded.seq, site_id = excluded.site_id",
+            (ch.pk, cid, ch.db_version, ch.seq, ch.site_id),
+        )
 
     def _log_change(self, c: sqlite3.Connection, ch: Change) -> None:
         # Keep the winning change re-servable for third-party sync

@@ -139,6 +139,9 @@ class MatcherHandle:
         tail = (m.group(3) or "").rstrip().rstrip(";")
         if re.search(r"(?i)\b(count|sum|avg|min|max|group_concat)\s*\(", select_list):
             return
+        if re.match(r"(?i)\s*distinct\b", select_list):
+            # Prepending PK columns to a DISTINCT list changes its meaning.
+            return
         pk_cols = ", ".join(
             f'"{table}"."{c}" AS __pk{i}'
             for i, c in enumerate(info.pk_cols)
@@ -149,11 +152,15 @@ class MatcherHandle:
         self._pk_prefix = len(info.pk_cols)
         self._pk_table = table
         # Candidate-only re-evaluation is sound only when a row's result
-        # membership depends on that row alone: LIMIT windows, GROUP BY,
-        # and subqueries make membership global — a change to one PK can
-        # evict another row, which only a full diff notices.
+        # VALUES and membership depend on that row alone: LIMIT windows,
+        # GROUP BY, and subqueries make membership global (a change to one
+        # PK can evict another row), and window functions / scalar
+        # subqueries in the select list make unchanged rows' values change
+        # — only a full diff notices either.
         self._local_membership = not re.search(
             r"(?i)\b(limit|group)\b|\(\s*select\b", tail
+        ) and not re.search(
+            r"(?i)\bover\s*\(|\(\s*select\b", select_list
         )
 
     def _evaluate(self) -> tuple[list[str], dict[tuple, tuple]]:

@@ -433,6 +433,43 @@ def test_subscription_semicolon_and_limit_membership(tmp_path):
     run(main())
 
 
+def test_subscription_window_function_full_diff(tmp_path):
+    """A window function's value on UNCHANGED rows shifts when other rows
+    change, so such queries must keep full-diff semantics — the candidate
+    path would leave stale row_number values behind."""
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        try:
+            h = a.agent.subs.subscribe(
+                "SELECT id, row_number() OVER (ORDER BY id) FROM tests"
+                " WHERE id > 0"
+            )
+            assert not h._local_membership
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (5, 'x')"],
+                 ["INSERT INTO tests (id, text) VALUES (7, 'y')"]]
+            )
+
+            async def two_rows():
+                return sorted(h.rows.values()) == [(5, 1), (7, 2)]
+
+            await poll_until(two_rows, timeout=10)
+            # Inserting a smaller id renumbers BOTH existing rows.
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'z')"]]
+            )
+
+            async def renumbered():
+                return sorted(h.rows.values()) == [(1, 1), (5, 2), (7, 3)]
+
+            await poll_until(renumbered, timeout=10)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
 def test_bootstrap_announcer_retries_until_join(tmp_path):
     """A node whose seed name resolves only LATER must still join (the
     announcer loop re-resolves with backoff, agent.rs:726-768)."""

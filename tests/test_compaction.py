@@ -1,0 +1,360 @@
+"""Version compaction: overwritten versions become Cleared ranges.
+
+Mirrors the reference's compaction pipeline — find_cleared_db_versions
+(agent.rs:1250-1299, unit-tested by test_in_memory_versions_compaction
+agent.rs:3224), store_empty_changeset's range collapsing (agent.rs:1588-1664,
+test_store_empty_changeset agent.rs:3603), and the clear_overwritten_versions
+/ write_empties_loop pair (agent.rs:995-1126, 2522-2571) — on the host agent.
+"""
+
+import asyncio
+import os
+import sqlite3
+
+from corrosion_tpu.agent.store import Store
+from corrosion_tpu.agent.testing import launch_test_agent, poll_until
+from corrosion_tpu.core.bookkeeping import Current
+from corrosion_tpu.core.values import Statement
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_store(tmp_path, name="s.db"):
+    store = Store(str(tmp_path / name), os.urandom(16))
+    store.apply_schema(
+        "CREATE TABLE foo (a INTEGER NOT NULL PRIMARY KEY, b INTEGER);"
+        "CREATE TABLE foo2 (a INTEGER NOT NULL PRIMARY KEY, b INTEGER);"
+    )
+    return store
+
+
+def book(store, version, dbv, actor=None):
+    store.conn.execute(
+        "INSERT INTO __corro_bookkeeping VALUES (?, ?, NULL, ?, 0, 0)",
+        (actor or store.site_id, version, dbv),
+    )
+
+
+def test_find_cleared_versions_reference_flow(tmp_path):
+    """The exact scenario of test_in_memory_versions_compaction
+    (agent.rs:3224): insert → delete keeps the tombstone's version live;
+    resurrection retires it."""
+    store = make_store(tmp_path)
+    site = store.site_id
+
+    _, dbv1, _, _ = store.execute_transaction(
+        [Statement("INSERT INTO foo (a) VALUES (1)")]
+    )
+    book(store, 1, dbv1)
+    _, dbv2, _, _ = store.execute_transaction([Statement("DELETE FROM foo")])
+    book(store, 2, dbv2)
+
+    to_clear = store.find_cleared_versions(site)
+    assert dbv1 in to_clear, "overwritten insert version is clearable"
+    assert dbv2 not in to_clear, "delete tombstone keeps its version live"
+
+    store.store_empty_changeset(site, 1, 1)
+    assert store.find_cleared_versions(site) == set()
+
+    # A write to an unrelated table clears nothing.
+    _, dbv3, _, _ = store.execute_transaction(
+        [Statement("INSERT INTO foo2 (a) VALUES (2)")]
+    )
+    book(store, 3, dbv3)
+    assert store.find_cleared_versions(site) == set()
+
+    # Resurrecting the row retires the delete sentinel: now (and only now)
+    # the delete's version is compactable.
+    _, dbv4, _, _ = store.execute_transaction(
+        [Statement("INSERT INTO foo (a) VALUES (1)")]
+    )
+    book(store, 4, dbv4)
+    to_clear = store.find_cleared_versions(site)
+    assert dbv2 in to_clear
+    assert dbv3 not in to_clear and dbv4 not in to_clear
+
+    store.store_empty_changeset(site, 2, 2)
+    assert store.find_cleared_versions(site) == set()
+
+
+def test_store_empty_changeset_collapses_ranges(tmp_path):
+    """Range collapsing per the reference's overlap/adjacency clauses
+    (agent.rs:1598-1614; test_store_empty_changeset agent.rs:3603)."""
+    store = make_store(tmp_path)
+    site = b"\x01" * 16
+    c = store.conn
+    for v in (1, 2, 3, 5):
+        c.execute(
+            "INSERT INTO __corro_bookkeeping VALUES (?, ?, NULL, ?, 0, 0)",
+            (site, v, 100 + v),
+        )
+    c.execute(
+        "INSERT INTO __corro_bookkeeping VALUES (?, 6, 8, NULL, NULL, NULL)",
+        (site,),
+    )
+    # Change-log rows for the current versions, to verify pruning.
+    for v in (1, 2, 3, 5):
+        c.execute(
+            "INSERT INTO __crdt_changes VALUES ('foo', X'00', 'b', NULL,"
+            " 1, ?, 0, ?, 1)",
+            (100 + v, site),
+        )
+
+    store.store_empty_changeset(site, 1, 2)
+    rows = set(
+        c.execute(
+            "SELECT start_version, end_version, db_version"
+            " FROM __corro_bookkeeping WHERE actor_id = ?",
+            (site,),
+        )
+    )
+    assert rows == {
+        (1, 2, None), (3, None, 103), (5, None, 105), (6, 8, None),
+    }
+
+    # [3,5] swallows the single at 3 and 5, the left-adjacent cleared [1,2]
+    # and the right-adjacent cleared [6,8] → one row [1,8].
+    store.store_empty_changeset(site, 3, 5)
+    rows = set(
+        c.execute(
+            "SELECT start_version, end_version, db_version"
+            " FROM __corro_bookkeeping WHERE actor_id = ?",
+            (site,),
+        )
+    )
+    assert rows == {(1, 8, None)}
+    # The cleared versions' change-log rows are pruned.
+    left = c.execute(
+        "SELECT count(*) FROM __crdt_changes WHERE site_id = ?", (site,)
+    ).fetchone()[0]
+    assert left == 0
+
+
+def test_store_empty_changeset_straddles_start(tmp_path):
+    """A persisted cleared range that straddles only the START of the new
+    range must merge, not survive as an overlapping second row (hole in the
+    reference's predicate, closed here)."""
+    store = make_store(tmp_path)
+    site = b"\x03" * 16
+    store.store_empty_changeset(site, 1, 10)
+    store.store_empty_changeset(site, 5, 20)
+    rows = set(
+        store.conn.execute(
+            "SELECT start_version, end_version FROM __corro_bookkeeping"
+            " WHERE actor_id = ?",
+            (site,),
+        )
+    )
+    assert rows == {(1, 20)}
+
+
+def test_store_empty_changeset_noncontiguous_failsafe(tmp_path):
+    store = make_store(tmp_path)
+    site = b"\x02" * 16
+    # Nothing adjacent: [10,10] over empty bookkeeping is fine...
+    assert store.store_empty_changeset(site, 10, 10) == 1
+    # ...and a second disjoint range stays a separate row (no failsafe trip).
+    assert store.store_empty_changeset(site, 20, 20) == 1
+    rows = set(
+        store.conn.execute(
+            "SELECT start_version, end_version FROM __corro_bookkeeping"
+            " WHERE actor_id = ?",
+            (site,),
+        )
+    )
+    assert rows == {(10, 10), (20, 20)}
+
+
+def test_agent_compacts_overwritten_versions(tmp_path):
+    """clear_overwritten_versions end-to-end: repeated overwrites of one row
+    collapse to a Cleared range in the bookie AND in persisted bookkeeping;
+    a late joiner receives the cleared span + only the live data."""
+
+    async def main():
+        a = await launch_test_agent(
+            str(tmp_path / "a"),
+            compact_interval=0.25,
+            empties_flush_interval=0.1,
+        )
+        try:
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'v0')"]]
+            )
+            for i in range(1, 6):
+                await a.client.execute(
+                    [["UPDATE tests SET text = ? WHERE id = 1", [f"v{i}"]]]
+                )
+
+            booked = a.agent.bookie.for_actor(a.agent.actor_id)
+            assert booked.last() == 6
+
+            async def compacted():
+                return booked.cleared.contains_range(1, 5)
+
+            await poll_until(compacted, timeout=10.0)
+            assert isinstance(booked.get(6), Current), (
+                "the live head version must survive compaction"
+            )
+
+            # Persisted: one collapsed NULL-db_version range row.
+            async def persisted():
+                rows = a.agent.store.conn.execute(
+                    "SELECT start_version, end_version FROM"
+                    " __corro_bookkeeping WHERE actor_id = ?"
+                    " AND db_version IS NULL",
+                    (a.agent.store.site_id,),
+                ).fetchall()
+                return (1, 5) in rows
+
+            await poll_until(persisted, timeout=10.0)
+
+            # Late joiner: gets the cleared span via sync, plus version 6's
+            # data — and ends with the right row.
+            b = await launch_test_agent(
+                str(tmp_path / "b"), bootstrap=[a.gossip_addr]
+            )
+            try:
+                async def b_caught_up():
+                    _, rows = await b.client.query(
+                        "SELECT text FROM tests WHERE id = 1"
+                    )
+                    return rows == [["v5"]]
+
+                await poll_until(b_caught_up, timeout=20.0)
+                bb = b.agent.bookie.get(a.agent.actor_id)
+                assert bb is not None
+                await poll_until(
+                    lambda: _async(bb.cleared.contains_range(1, 5)),
+                    timeout=10.0,
+                )
+            finally:
+                await b.stop()
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+async def _async(value):
+    return value
+
+
+def test_late_sync_after_delete_compaction(tmp_path):
+    """The tombstone-correctness scenario: B holds a row, goes offline, A
+    deletes it and compacts the INSERT version away. When B returns it must
+    still learn the delete — the sentinel keeps the delete's version
+    servable (cr-sqlite's __crsql_del clock row; find_cleared semantics
+    agent.rs:1250-1299)."""
+
+    async def main():
+        a = await launch_test_agent(
+            str(tmp_path / "a"),
+            compact_interval=0.25,
+            empties_flush_interval=0.1,
+        )
+        try:
+            b = await launch_test_agent(
+                str(tmp_path / "b"), bootstrap=[a.gossip_addr]
+            )
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (9, 'doomed')"]]
+            )
+
+            async def on_b():
+                _, rows = await b.client.query(
+                    "SELECT count(*) FROM tests WHERE id = 9"
+                )
+                return rows[0][0] == 1
+
+            await poll_until(on_b, timeout=20.0)
+            await b.stop()
+
+            await a.client.execute([["DELETE FROM tests WHERE id = 9"]])
+            booked = a.agent.bookie.for_actor(a.agent.actor_id)
+
+            async def insert_version_cleared():
+                return booked.cleared.contains(1)
+
+            await poll_until(insert_version_cleared, timeout=10.0)
+            # The delete version itself must NOT be cleared.
+            assert isinstance(booked.get(2), Current)
+
+            # B restarts with its stale copy; sync must deliver the delete.
+            b2 = await launch_test_agent(
+                str(tmp_path / "b"), bootstrap=[a.gossip_addr],
+                compact_interval=0.25, empties_flush_interval=0.1,
+            )
+            try:
+                async def row_gone():
+                    _, rows = await b2.client.query(
+                        "SELECT count(*) FROM tests WHERE id = 9"
+                    )
+                    return rows[0][0] == 0
+
+                await poll_until(row_gone, timeout=20.0)
+                # B held v1 as Current, so no sync_cleared arrives for it;
+                # B's OWN compaction notices the clock rows vanished when
+                # the delete applied and clears v1 locally — compaction is
+                # per-node, for every tracked actor (agent.rs:1005-1021).
+                bb = b2.agent.bookie.get(a.agent.actor_id)
+                assert bb is not None
+                await poll_until(
+                    lambda: _async(bb.cleared.contains(1)), timeout=10.0
+                )
+            finally:
+                await b2.stop()
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_cleared_ranges_survive_restart(tmp_path):
+    """Rehydration maps NULL-db_version bookkeeping rows back to Cleared
+    (agent.rs:147-268): restart after compaction keeps the collapsed state
+    and serves it to late joiners."""
+
+    async def main():
+        data = str(tmp_path / "a")
+        a = await launch_test_agent(
+            data, compact_interval=0.25, empties_flush_interval=0.1
+        )
+        actor = a.agent.actor_id
+        await a.client.execute(
+            [["INSERT INTO tests (id, text) VALUES (1, 'x')"]]
+        )
+        for i in range(4):
+            await a.client.execute(
+                [["UPDATE tests SET text = ? WHERE id = 1", [f"y{i}"]]]
+            )
+        booked = a.agent.bookie.for_actor(actor)
+        await poll_until(
+            lambda: _async(booked.cleared.contains_range(1, 4)), timeout=10.0
+        )
+        # Wait for the persisted collapse, then restart.
+        db_path = a.agent.store.conn.execute("PRAGMA database_list").fetchall()[0][2]
+        async def persisted():
+            chk = sqlite3.connect(db_path)
+            try:
+                return chk.execute(
+                    "SELECT count(*) FROM __corro_bookkeeping"
+                    " WHERE db_version IS NULL AND start_version = 1"
+                    " AND end_version = 4"
+                ).fetchone()[0] == 1
+            finally:
+                chk.close()
+        await poll_until(persisted, timeout=10.0)
+        await a.stop()
+
+        a2 = await launch_test_agent(data)
+        try:
+            assert a2.agent.actor_id == actor, "identity persists"
+            booked = a2.agent.bookie.for_actor(actor)
+            assert booked.cleared.contains_range(1, 4)
+            assert isinstance(booked.get(5), Current)
+        finally:
+            await a2.stop()
+
+    run(main())
