@@ -223,6 +223,7 @@ class Agent:
             self._compact_loop(), name="clear_overwritten_versions"
         )
         self.tasks.spawn(self._empties_loop(), name="write_empties_loop")
+        self.tasks.spawn(self._metrics_loop(), name="metrics_loop")
         if self.cfg.admin_uds:
             from corrosion_tpu.agent.admin import start_admin
 
@@ -759,6 +760,44 @@ class Agent:
                 for s, e in ranges:
                     dst.insert(s, e)
             raise
+
+    # -- periodic metrics (collect_metrics, agent.rs:1126-1187) ----------------
+
+    async def _metrics_loop(self) -> None:
+        """Per-table row counts, change-log size, and pool queue depths,
+        sampled on the read side every few seconds (the reference's
+        metrics_loop runs collect_metrics every 10 s)."""
+        rows_g = self.metrics.gauge(
+            "corro_db_table_rows", "rows per user table"
+        )
+        log_g = self.metrics.gauge(
+            "corro_db_change_log_rows", "rows in the __crdt_changes log"
+        )
+        queue_g = self.metrics.gauge(
+            "corro_sqlite_write_queue", "queued writer jobs per priority"
+        )
+        interval = max(self.cfg.compact_interval / 2, 0.5)
+        while not self.tripwire.tripped:
+            await asyncio.sleep(interval)
+            try:
+                # Full-table counts ride the read POOL (off the event
+                # loop): at millions of log rows an on-loop scan would
+                # stall gossip/API for its duration.
+                for name in self.store.tables():
+                    _, rows = await self.pool.query(
+                        Statement(f'SELECT count(*) FROM "{name}"')
+                    )
+                    rows_g.set(rows[0][0], table=name)
+                _, rows = await self.pool.query(
+                    Statement("SELECT count(*) FROM __crdt_changes")
+                )
+                log_g.set(rows[0][0])
+                for label, p in (("high", 0), ("normal", 1), ("low", 2)):
+                    queue_g.set(
+                        self.pool._queues[p].qsize(), priority=label
+                    )
+            except Exception:
+                pass
 
     # -- SWIM loop -------------------------------------------------------------
 

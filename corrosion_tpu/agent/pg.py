@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import re
+import sqlite3
 import struct
 from typing import TYPE_CHECKING
 
@@ -100,9 +101,163 @@ def translate_pg_sql(sql: str) -> str:
         return ""  # the agent wraps writes in its own transaction
     if upper.startswith("SET ") or upper.startswith("SHOW "):
         return ""
-    if upper == "SELECT VERSION()":
-        return "SELECT 'corrosion-tpu (PostgreSQL 14 compatible)' AS version"
+    # Session-introspection shims clients issue at connect time — applied
+    # only OUTSIDE string/identifier literals (an INSERT of the literal
+    # 'current_user' must pass through untouched).
+    s = _sub_unquoted(s, _SESSION_SHIMS)
     return s
+
+
+_SESSION_SHIMS = [
+    (re.compile(r"(?i)\bversion\s*\(\s*\)"),
+     "'corrosion-tpu (PostgreSQL 14 compatible)'"),
+    (re.compile(r"(?i)\bcurrent_database\s*\(\s*\)"), "'corrosion'"),
+    (re.compile(r"(?i)\bcurrent_schema\s*\(\s*\)"), "'public'"),
+    (re.compile(r"(?i)\bpg_backend_pid\s*\(\s*\)"), "1"),
+    (re.compile(r"(?i)\b(current_user|session_user)\b"), "'corrosion'"),
+]
+
+
+def _split_quoted(sql: str) -> list[tuple[bool, str]]:
+    """Split SQL into (is_quoted, segment) runs; quoted segments include
+    their delimiters and respect doubled-quote escapes."""
+    out: list[tuple[bool, str]] = []
+    cur: list[str] = []
+    quote: str | None = None
+    for ch in sql:
+        if quote is not None:
+            cur.append(ch)
+            if ch == quote:
+                out.append((True, "".join(cur)))
+                cur = []
+                quote = None
+        elif ch in ("'", '"'):
+            if cur:
+                out.append((False, "".join(cur)))
+            cur = [ch]
+            quote = ch
+        else:
+            cur.append(ch)
+    if cur:
+        out.append((quote is not None, "".join(cur)))
+    return out
+
+
+def _sub_unquoted(sql: str, subs) -> str:
+    parts = []
+    for quoted, seg in _split_quoted(sql):
+        if not quoted:
+            for pat, repl in subs:
+                seg = pat.sub(repl, seg)
+        parts.append(seg)
+    return "".join(parts)
+
+
+def _mentions_catalog(sql: str) -> bool:
+    return any(
+        _CATALOG_RE.search(seg)
+        for quoted, seg in _split_quoted(sql)
+        if not quoted
+    )
+
+
+# -- pg_catalog (the reference's vtabs: corro-pg/src/vtab/{pg_type 405,
+# pg_class 113, pg_namespace 108, pg_database 166, pg_range} LoC) ----------
+
+_CATALOG_RE = re.compile(
+    r"(?i)\b(?:pg_catalog\.)?"
+    r"(pg_type|pg_class|pg_namespace|pg_database|pg_range|pg_attribute"
+    r"|pg_tables)\b"
+)
+
+# (oid, typname, typlen): the types the wire layer speaks.
+_PG_TYPES = [
+    (16, "bool", 1), (17, "bytea", -1), (20, "int8", 8), (21, "int2", 2),
+    (23, "int4", 4), (25, "text", -1), (700, "float4", 4),
+    (701, "float8", 8), (1043, "varchar", -1), (1700, "numeric", -1),
+]
+_NS_CATALOG, _NS_PUBLIC = 11, 2200
+_FIRST_REL_OID = 16384
+
+
+def catalog_conn(agent: "Agent") -> sqlite3.Connection:
+    """A pg_catalog snapshot derived from the live schema, built as TEMP
+    tables on a fresh read connection to the real database — so catalog
+    queries can also join user tables, like the reference's virtual tables
+    (which live on every connection).
+
+    Per-query construction keeps it automatically in sync with migrations;
+    introspection traffic (psql \\d, ORM table listing at connect) is rare
+    enough that rebuild cost is irrelevant.
+    """
+    c = sqlite3.connect(agent.store.path)
+    c.executescript(
+        """
+        CREATE TEMP TABLE pg_type (oid INT, typname TEXT, typlen INT,
+          typtype TEXT, typnamespace INT);
+        CREATE TEMP TABLE pg_namespace (oid INT, nspname TEXT);
+        CREATE TEMP TABLE pg_database (oid INT, datname TEXT);
+        CREATE TEMP TABLE pg_class (oid INT, relname TEXT, relnamespace INT,
+          relkind TEXT);
+        CREATE TEMP TABLE pg_attribute (attrelid INT, attname TEXT,
+          atttypid INT, attnum INT, attnotnull INT, attisdropped INT);
+        CREATE TEMP TABLE pg_range (rngtypid INT, rngsubtype INT);
+        CREATE TEMP TABLE pg_tables (schemaname TEXT, tablename TEXT);
+        """
+    )
+    c.executemany(
+        "INSERT INTO pg_type VALUES (?, ?, ?, 'b', ?)",
+        [(o, n, l, _NS_CATALOG) for o, n, l in _PG_TYPES],
+    )
+    c.executemany(
+        "INSERT INTO pg_namespace VALUES (?, ?)",
+        [(_NS_CATALOG, "pg_catalog"), (_NS_PUBLIC, "public")],
+    )
+    c.execute("INSERT INTO pg_database VALUES (1, 'corrosion')")
+    oid = _FIRST_REL_OID
+    for name, info in sorted(agent.store.tables().items()):
+        c.execute(
+            "INSERT INTO pg_class VALUES (?, ?, ?, 'r')",
+            (oid, name, _NS_PUBLIC),
+        )
+        c.execute("INSERT INTO pg_tables VALUES ('public', ?)", (name,))
+        for attnum, col in enumerate(
+            [*info.pk_cols, *info.data_cols], start=1
+        ):
+            c.execute(
+                "INSERT INTO pg_attribute VALUES (?, ?, 25, ?, ?, 0)",
+                (oid, col, attnum, int(col in info.pk_cols)),
+            )
+        oid += 1
+    return c
+
+
+async def _run_query(
+    agent: "Agent", sql: str, params: list | None = None
+) -> tuple[list[str], list]:
+    """Route a read: queries touching pg_catalog names (outside string
+    literals) go to the catalog-snapshot connection — which also sees the
+    user tables — everything else to the agent's read pool."""
+    if _mentions_catalog(sql):
+        def run():
+            c = catalog_conn(agent)
+            try:
+                cur = c.execute(
+                    _sub_unquoted(sql, _CATALOG_PREFIX_STRIP),
+                    tuple(params or ()),
+                )
+                cols = (
+                    [d[0] for d in cur.description] if cur.description else []
+                )
+                return cols, cur.fetchall()
+            finally:
+                c.close()
+
+        return await asyncio.to_thread(run)
+    return await agent.pool.query(Statement(sql, params=params))
+
+
+_CATALOG_PREFIX_STRIP = [(re.compile(r"(?i)\bpg_catalog\."), "")]
 
 
 def translate_placeholders(sql: str) -> str:
@@ -298,8 +453,8 @@ async def _extended(
         if portal is None:
             raise _PgError(f"unknown portal {name!r}", "34000")
         if _is_query(portal.prepared.translated):
-            cols, rows = await agent.pool.query(
-                Statement(portal.prepared.translated, params=portal.params)
+            cols, rows = await _run_query(
+                agent, portal.prepared.translated, portal.params
             )
             portal.described = (cols, rows)
             writer.write(_row_description(cols))
@@ -320,9 +475,7 @@ async def _extended(
             if portal.described is not None:
                 cols, rows = portal.described
             else:
-                cols, rows = await agent.pool.query(
-                    Statement(sql, params=portal.params)
-                )
+                cols, rows = await _run_query(agent, sql, portal.params)
             for row in rows:
                 writer.write(_data_row(row))
             writer.write(_command_complete(f"SELECT {len(rows)}"))
@@ -368,6 +521,21 @@ def _try_describe(agent: "Agent", stmt: _Prepared) -> list[str] | None:
         (int(m) for m in re.findall(r"\?(\d+)", stmt.translated)), default=0
     )
     try:
+        if _mentions_catalog(stmt.translated):
+            c = catalog_conn(agent)
+            try:
+                cur = c.execute(
+                    "SELECT * FROM ("
+                    + _sub_unquoted(stmt.translated, _CATALOG_PREFIX_STRIP)
+                    + ") LIMIT 0",
+                    tuple([None] * n_params),
+                )
+                return (
+                    [d[0] for d in cur.description]
+                    if cur.description else None
+                )
+            finally:
+                c.close()
         cur = agent.store.read_conn.execute(
             f"SELECT * FROM ({stmt.translated}) LIMIT 0",
             tuple([None] * n_params),
@@ -422,7 +590,7 @@ async def _simple_query(agent: "Agent", writer, sql: str) -> None:
             continue
         try:
             if _is_query(translated):
-                cols, rows = await agent.pool.query(Statement(translated))
+                cols, rows = await _run_query(agent, translated)
                 writer.write(_row_description(cols))
                 for row in rows:
                     writer.write(_data_row(row))

@@ -26,10 +26,10 @@ def _cfg(
     n, writers, regions=None, region_rtt=None, swim_kw=None, **gossip_kw
 ) -> tuple[ClusterConfig, object]:
     regions = regions or [n]
+    gossip_kw.setdefault("max_transmissions", _max_tx(n))
     g = GossipConfig(
         n_nodes=n,
         n_writers=len(writers),
-        max_transmissions=_max_tx(n),
         **gossip_kw,
     )
     s = SwimConfig(
@@ -159,12 +159,16 @@ def merge_10k(n: int = 10_000, rounds: int = 120, samples: int = 256,
         # exceeding the steady-state need (512 saturated and never drained).
         sync_budget=1024,
         sync_chunk=128,
-        # Under a cluster-wide write storm the pending queue churns (fresh
-        # versions evict older ones before their retransmission budgets are
-        # spent), so spread needs width: more far targets + deeper queues.
+        # Under a cluster-wide write storm the pending queue churns, so
+        # spread needs width: more far targets + deeper queues, and an
+        # intake cap sized to the ~100 new versions/round write rate
+        # (docs/SCALING.md "Queue policy under write storms"; measured
+        # p50 5.5->3.5 s, p99 10.5->7.0 s at 10k).
         fanout_near=3,
         fanout_far=3,
         queue=24,
+        max_transmissions=6,
+        rebroadcast_intake=200,
         n_cells=1024,
         cells_per_write=2,
         # Sparse membership: the dense u32[N, N] view plus its scatter
@@ -183,12 +187,15 @@ def merge_10k(n: int = 10_000, rounds: int = 120, samples: int = 256,
 
 
 def wan_100k(n: int = 100_000, n_regions: int = 20, n_writers: int = 512,
-             rounds: int = 240, samples: int = 128, seed: int = 4):
+             rounds: int = 240, samples: int = 128, seed: int = 4,
+             partition: bool = True):
     """Config 5: 100k-node partitioned WAN topology.
 
     20 regions; writers spread across regions; mid-run a region pair is cut
-    off for 60 rounds and must catch up after healing. Node axis is meant to
-    be sharded over a mesh (see corrosion_tpu.parallel)."""
+    off for 60 rounds and must catch up after healing (``partition=False``
+    gives the steady-state propagation variant — the north-star visibility
+    measurement, uncontaminated by partition recovery). Node axis is meant
+    to be sharded over a mesh (see corrosion_tpu.parallel)."""
     rng = np.random.default_rng(seed)
     region_size = n // n_regions
     writers = sorted(rng.choice(n, size=n_writers, replace=False).tolist())
@@ -197,12 +204,25 @@ def wan_100k(n: int = 100_000, n_regions: int = 20, n_writers: int = 512,
         writers=writers,
         regions=[region_size] * n_regions,
         region_rtt="geo",  # graded WAN rings (members.rs:33)
-        sync_interval=12,
+        sync_interval=6,
         sync_budget=512,
         sync_chunk=64,
         fanout_near=2,
         fanout_far=1,
         n_cells=256,
+        # Queue policy measured on the 20k-node CPU sweep (2026-07-30):
+        # fresh per-holder budgets (the reference's requeue semantics,
+        # broadcast/mod.rs:549-563) + first-receipt-only intake + keep-most-
+        # budget priority + intake sized to the cluster write rate. The
+        # version-number keep-priority starved fresh versions under load
+        # (cross-writer version comparison is arbitrary) and tripled p50;
+        # inherited hop-TTL budgets + stale recirculation doubled p99.
+        queue=48,
+        max_transmissions=6,
+        rebroadcast_intake=26,
+        rebroadcast_fresh_budget=True,
+        rebroadcast_stale=False,
+        queue_priority="budget",
         # Dense SWIM is u32[N, N] = 40 GB at 100k nodes; the sparse
         # exception-table kernel is ~0.5 KiB/node (ops/swim_sparse.py).
         swim_kw={"view_capacity": 64},
@@ -212,10 +232,12 @@ def wan_100k(n: int = 100_000, n_regions: int = 20, n_writers: int = 512,
     # (rounds - 80 would go negative and zero the whole schedule).
     drain = min(80, max(rounds // 3, 1))
     writes[rounds - drain :, :] = 0
-    partition = np.zeros((rounds, n_regions, n_regions), bool)
-    cut_a, cut_b = 0, 1
-    partition[60:120, cut_a, :] = True
-    partition[60:120, :, cut_a] = True
-    partition[60:120, cut_a, cut_a] = False
-    sched = Schedule(writes=writes, partition=partition).make_samples(samples)
+    part = None
+    if partition:
+        part = np.zeros((rounds, n_regions, n_regions), bool)
+        cut_a = 0
+        part[60:120, cut_a, :] = True
+        part[60:120, :, cut_a] = True
+        part[60:120, cut_a, cut_a] = False
+    sched = Schedule(writes=writes, partition=part).make_samples(samples)
     return cfg, topo, sched

@@ -54,6 +54,29 @@ class GossipConfig:
     sync_chunk: int = 64  # versions per writer per peer (chunk cap)
     sync_peers: int = 3  # peers pulled from per session (ref: 3-10, agent.rs:84)
     sync_candidates: int = 8  # candidate peers scored by need per session
+    # Rebroadcast-intake policy. ``rebroadcast_fresh_budget`` gives a newly
+    # applied entry the holder's own full ``max_transmissions`` (the
+    # reference's per-holder requeue, broadcast/mod.rs:549-563) instead of
+    # inheriting the sender's remaining budget minus one (a hop-TTL).
+    # ``rebroadcast_stale`` re-admits re-deliveries of versions the node
+    # already held (keeps old versions circulating; incompatible with fresh
+    # budgets — entries would never expire). Defaults follow the reference:
+    # per-holder budgets, first receipts only — measured 2x better p50/p99
+    # under write storms (docs/SCALING.md "Queue policy under write
+    # storms").
+    rebroadcast_fresh_budget: bool = True
+    rebroadcast_stale: bool = False
+    # Applied messages admitted to the queue per node per round (0 = the
+    # fanout*2 default). Under a cluster-wide write storm this cap — not
+    # queue depth — bounds how many of its appliers rebroadcast a version:
+    # an intake share of k_in/new-versions-per-round multiplies the
+    # epidemic growth factor.
+    rebroadcast_intake: int = 0
+    # Queue keep-priority when over capacity: "version" keeps the lowest
+    # version numbers (cross-writer — arbitrary under many writers, and
+    # measured to starve fresh versions under load), "budget" keeps the
+    # most remaining transmissions (youngest entries under fresh budgets).
+    queue_priority: str = "budget"
     # CRDT cell plane: per-node LWW/causal-length registers that every
     # applied version scatter-merges into (0 = plane disabled). The global
     # cell key space has n_cells keys; each write touches cells_per_write.
@@ -65,6 +88,16 @@ class GossipConfig:
             raise ValueError(
                 f"sync_peers ({self.sync_peers}) must be <= "
                 f"sync_candidates ({self.sync_candidates})"
+            )
+        if self.rebroadcast_fresh_budget and self.rebroadcast_stale:
+            raise ValueError(
+                "rebroadcast_fresh_budget requires rebroadcast_stale=False: "
+                "stale re-admissions with refreshed budgets never expire"
+            )
+        if self.queue_priority not in ("version", "budget"):
+            raise ValueError(
+                f"queue_priority must be 'version' or 'budget', got "
+                f"{self.queue_priority!r}"
             )
 
     @property
@@ -372,12 +405,27 @@ def broadcast_round(
             n_merges += m
 
         # ---- 4. rebroadcast intake (epidemic requeue) ----------------------
-        # Already receiver-local: keep up to k_in applied messages per row.
-        k_in = cfg.fanout * 2
+        # Same-round duplicate copies of one (writer, version) never take
+        # two intake slots; ``rebroadcast_stale`` additionally re-admits
+        # re-deliveries of already-held versions (old versions keep
+        # circulating at inherited budgets), while the fresh-budget policy
+        # admits only first receipts but with the holder's full budget (the
+        # reference's per-holder requeue, broadcast/mod.rs:549-563).
+        prev_same = (~seg_start) & (v2 == prev_v)
+        fresh = run & valid2 & ~prev_same
+        if not cfg.rebroadcast_stale:
+            fresh &= v2 > base
+        if cfg.rebroadcast_fresh_budget:
+            intake_ok = fresh
+            in_budget = jnp.full_like(tx2, cfg.max_transmissions)
+        else:
+            intake_ok = fresh & (tx2 > 1)
+            in_budget = tx2 - 1
+        k_in = cfg.rebroadcast_intake or cfg.fanout * 2
         in_mask, (in_w, in_v, in_tx) = routing.rebuild_bounded_queue(
-            run & valid2 & (tx2 > 1),
+            intake_ok,
             -v2.astype(jnp.int32),  # oldest versions first, like the queue
-            (jnp.minimum(w2, w_count - 1), v2, tx2 - 1),
+            (jnp.minimum(w2, w_count - 1), v2, in_budget),
             k_in,
         )
         in_w = jnp.where(in_mask, in_w, -1)
@@ -423,14 +471,16 @@ def broadcast_round(
         ],
         axis=1,
     )
-    # Priority = -version: keep the oldest entries so slot-order delivery
-    # stays version-sorted; dropped newer entries are healed by sync.
+    # Keep-priority over capacity ("version": lowest version numbers;
+    # "budget": most remaining transmissions). Dropped entries are healed
+    # by sync. Delivery re-sorts rows, so slot order is free.
+    if cfg.queue_priority == "budget":
+        prio = cand_tx
+    else:
+        prio = -cand_v.astype(jnp.int32)
     keep, (q_writer, q_ver, q_tx) = routing.rebuild_bounded_queue(
-        cand_ok, -cand_v.astype(jnp.int32), (cand_w, cand_v, cand_tx), q_cap
+        cand_ok, prio, (cand_w, cand_v, cand_tx), q_cap
     )
-    # rebuild_bounded_queue sorts by priority desc == version asc. Re-sort
-    # kept slots ascending by version for the delivery scan (it already is,
-    # since priority order == ascending version).
     q_writer = jnp.where(keep, q_writer, -1)
 
     stats = {

@@ -1,11 +1,21 @@
 """On-chip validation for the 100k-node WAN config (BASELINE config 5).
 
 Initializes the full wan_100k cluster (sparse SWIM kernel) on the real
-device, runs a bounded number of rounds, and prints state size + step time.
-This is the memory-plan check: 100k nodes must fit and run on one chip.
+device, runs the scheduled rounds (mid-run partition of region 0 included),
+and prints state size, step time, and the north-star metric: p99 change
+visibility in simulated seconds (BASELINE.md: < 10 s at 100k nodes).
+
+Visibility is reported twice: over ALL sampled writes, and over the writes
+not affected by the scheduled partition (steady-state) — a write originating
+in a region that is cut off for 30 simulated seconds cannot be visible
+elsewhere before the heal, so the overall p99 measures partition recovery,
+not propagation speed.
 """
 
 from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 import json
 import sys
@@ -16,12 +26,16 @@ import numpy as np
 
 from corrosion_tpu import models
 from corrosion_tpu.ops import swim_sparse
-from corrosion_tpu.sim import simulate
+from corrosion_tpu.sim import simulate, visibility_latencies
 
 
 def main() -> None:
-    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 16
-    cfg, topo, sched = models.wan_100k(rounds=rounds, samples=64)
+    steady = "--steady" in sys.argv  # no partition: pure propagation p99
+    nums = [a for a in sys.argv[1:] if not a.startswith("-")]
+    rounds = int(nums[0]) if nums else 16
+    cfg, topo, sched = models.wan_100k(
+        rounds=rounds, samples=256, partition=not steady
+    )
     t0 = time.perf_counter()
     final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=8)
     jax.block_until_ready(final.data.contig)
@@ -31,26 +45,62 @@ def main() -> None:
         x.size * x.dtype.itemsize
         for x in jax.tree.leaves((final.swim, final.data))
     )
-    print(
-        json.dumps(
-            {
-                "platform": jax.devices()[0].platform,
-                "nodes": cfg.n_nodes,
-                "rounds": rounds,
-                "wall_s": round(wall, 2),
-                "step_ms": round(wall / rounds * 1000.0, 1),
-                "state_mib": round(state_bytes / 2**20, 1),
-                "swim_bytes_per_node": swim_sparse.state_bytes_per_node(
-                    cfg.swim
-                ),
-                "applied": int(
-                    curves["applied_broadcast"].sum()
-                    + curves["applied_sync"].sum()
-                ),
-                "mismatches_last": int(curves["mismatches"][-1]),
-            }
-        )
-    )
+    lat = visibility_latencies(final, sched, cfg)
+
+    # Steady-state split: samples whose write round overlaps the partition
+    # window AND whose writer sits in the cut-off region (or whose
+    # observers include it before the heal) measure partition recovery.
+    # wan_100k cuts region 0 for rounds [60, 120).
+    out = {
+        "platform": jax.devices()[0].platform,
+        "steady": steady,
+        "nodes": cfg.n_nodes,
+        "rounds": rounds,
+        "wall_s": round(wall, 2),
+        "step_ms": round(wall / rounds * 1000.0, 1),
+        "state_mib": round(state_bytes / 2**20, 1),
+        "swim_bytes_per_node": swim_sparse.state_bytes_per_node(cfg.swim),
+        "applied": int(
+            curves["applied_broadcast"].sum() + curves["applied_sync"].sum()
+        ),
+        "mismatches_last": int(curves["mismatches"][-1]),
+        "converged": bool(
+            (np.asarray(final.data.contig)
+             == np.asarray(final.data.head)[None, :]).all()
+        ),
+        "vis_p50_s": round(lat["p50_s"], 2),
+        "vis_p99_s": round(lat["p99_s"], 2),
+        "unseen_pairs": lat["unseen"],
+    }
+    if rounds >= 120 and sched.partition is not None:
+        # Every write committed while region 0 is cut (rounds [60, 120)) has
+        # unreachable observers until the heal — and writes up to ~2 sync
+        # intervals BEFORE the cut may not have drained into region 0 yet.
+        # Those samples measure partition recovery, not propagation.
+        affected = (sched.sample_round >= 36) & (sched.sample_round < 120)
+        steady = ~affected
+
+        def _sub(mask):
+            import dataclasses
+
+            sub = dataclasses.replace(
+                sched,
+                sample_writer=sched.sample_writer[mask],
+                sample_ver=sched.sample_ver[mask],
+                sample_round=sched.sample_round[mask],
+            )
+            vis = np.asarray(final.vis_round)[mask]
+            fake = final._replace(vis_round=vis)
+            return visibility_latencies(fake, sub, cfg)
+
+        lat_steady = _sub(steady)
+        lat_part = _sub(affected)
+        out["vis_steady_p50_s"] = round(lat_steady["p50_s"], 2)
+        out["vis_steady_p99_s"] = round(lat_steady["p99_s"], 2)
+        out["steady_samples"] = int(steady.sum())
+        out["vis_partition_p99_s"] = round(lat_part["p99_s"], 2)
+        out["partition_samples"] = int(affected.sum())
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

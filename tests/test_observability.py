@@ -190,9 +190,14 @@ def test_agents_propagate_trace_and_count_metrics(tmp_path):
 def test_agent_prometheus_endpoint(tmp_path):
     async def main():
         a = await launch_test_agent(
-            str(tmp_path / "a"), prometheus_addr="127.0.0.1:0"
+            str(tmp_path / "a"), prometheus_addr="127.0.0.1:0",
+            compact_interval=0.4,  # metrics_loop samples at half this
         )
         try:
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'm')"]]
+            )
+            await asyncio.sleep(0.5)  # let the metrics_loop sample once
             host, port = a.agent.prometheus_addr
             body = await asyncio.to_thread(
                 lambda: urllib.request.urlopen(
@@ -200,6 +205,10 @@ def test_agent_prometheus_endpoint(tmp_path):
                 ).read().decode()
             )
             assert "corro_gossip_members" in body
+            # collect_metrics parity: per-table row counts + pool queues
+            # (agent.rs:1138-1187).
+            assert 'corro_db_table_rows{table="tests"} 1' in body
+            assert "corro_sqlite_write_queue" in body
         finally:
             await a.stop()
 

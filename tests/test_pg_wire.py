@@ -118,6 +118,85 @@ def test_pg_select_insert_and_parity(tmp_path):
     run(main())
 
 
+def test_pg_catalog_introspection(tmp_path):
+    """Catalog queries ORMs/psql issue at connect: pg_class/pg_attribute/
+    pg_namespace reflect the live schema; session shims answer
+    current_database()/current_schema() (the reference's pg_catalog vtabs,
+    corro-pg/src/vtab/*)."""
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        from corrosion_tpu.agent.pg import serve_pg
+
+        server, (host, port) = await serve_pg(a.agent)
+        try:
+            pg = await MiniPg.connect(host, port)
+            msgs = await pg.query(
+                "SELECT relname FROM pg_catalog.pg_class"
+                " WHERE relkind = 'r' ORDER BY relname"
+            )
+            names = [r[0] for r in _rows(msgs)]
+            assert names == ["tests", "tests2", "testsblob"]
+            # Columns with type + pk flag, the \d backbone.
+            msgs = await pg.query(
+                "SELECT a.attname, t.typname, a.attnotnull"
+                " FROM pg_attribute a"
+                " JOIN pg_class c ON c.oid = a.attrelid"
+                " JOIN pg_type t ON t.oid = a.atttypid"
+                " WHERE c.relname = 'tests' ORDER BY a.attnum"
+            )
+            assert _rows(msgs) == [
+                ["id", "text", "1"], ["text", "text", "0"],
+            ]
+            msgs = await pg.query(
+                "SELECT nspname FROM pg_namespace ORDER BY oid"
+            )
+            assert [r[0] for r in _rows(msgs)] == ["pg_catalog", "public"]
+            msgs = await pg.query(
+                "SELECT current_database(), current_schema(), current_user"
+            )
+            assert _rows(msgs) == [["corrosion", "public", "corrosion"]]
+            # A schema migration shows up in the next catalog snapshot.
+            from corrosion_tpu.agent.testing import TEST_SCHEMA
+
+            await a.client.schema(
+                [TEST_SCHEMA
+                 + "CREATE TABLE newt (id INTEGER NOT NULL PRIMARY KEY);"]
+            )
+            msgs = await pg.query(
+                "SELECT tablename FROM pg_tables WHERE tablename = 'newt'"
+            )
+            assert _rows(msgs) == [["newt"]]
+            # Catalog names INSIDE string literals must not reroute the
+            # query away from user tables...
+            msgs = await pg.query(
+                "SELECT count(*) FROM tests WHERE text = 'pg_class'"
+            )
+            assert _rows(msgs) == [["0"]]
+            # ...session keywords inside literals must pass through
+            # unrewritten...
+            msgs = await pg.query(
+                "INSERT INTO tests (id, text) VALUES (7, 'current_user')"
+            )
+            assert b"E" not in [t for t, _ in msgs]
+            msgs = await pg.query("SELECT text FROM tests WHERE id = 7")
+            assert _rows(msgs) == [["current_user"]]
+            # ...and catalog queries can JOIN user tables (the reference's
+            # vtabs share the connection with user data).
+            msgs = await pg.query(
+                "SELECT c.relname, count(t.id) FROM pg_class c"
+                " LEFT JOIN tests t ON c.relname = 'tests'"
+                " WHERE c.relname = 'tests' GROUP BY c.relname"
+            )
+            assert len(_rows(msgs)) == 1
+            pg.close()
+        finally:
+            server.close()
+            await a.stop()
+
+    run(main())
+
+
 def test_split_statements_quote_aware():
     from corrosion_tpu.agent.pg import _split_statements
 
