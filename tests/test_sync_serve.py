@@ -1,0 +1,156 @@
+"""Direct unit tests of the sync server's need-serving — the analogue of
+test_handle_known_version (peer.rs:1529), which drives handle_known_version
+with a channel-backed sender and no network: Current versions stream their
+changesets, Cleared spans stream as cleared ranges, Partial versions serve
+buffered seq ranges.
+"""
+
+import asyncio
+
+from corrosion_tpu.agent.agent import Agent, AgentConfig
+from corrosion_tpu.agent.testing import TEST_SCHEMA
+from corrosion_tpu.core.bookkeeping import (
+    CLEARED,
+    Current,
+    FullNeed,
+    Partial,
+    PartialNeed,
+)
+from corrosion_tpu.core.intervals import RangeSet
+from corrosion_tpu.core.values import Change, Statement
+
+
+class FakeSession:
+    def __init__(self):
+        self.frames = []
+
+    async def send(self, frame):
+        self.frames.append(frame)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_agent(tmp_path) -> Agent:
+    return Agent(AgentConfig(data_dir=str(tmp_path), schema_sql=TEST_SCHEMA))
+
+
+def test_serve_full_need_streams_current_versions(tmp_path):
+    a = make_agent(tmp_path)
+    try:
+        for i in range(3):
+            a.execute(
+                [Statement("INSERT INTO tests (id, text) VALUES (?, ?)",
+                           params=[i, f"row{i}"])]
+            )
+        booked = a.bookie.for_actor(a.actor_id)
+        assert booked.last() == 3
+
+        async def main():
+            s = FakeSession()
+            await a._serve_need(s, a.actor_id, booked, FullNeed(1, 3))
+            return s.frames
+
+        frames = run(main())
+        assert [f["t"] for f in frames] == ["sync_changes"] * 3
+        assert [f["version"] for f in frames] == [1, 2, 3]
+        # Each frame is a complete changeset: seqs [0, last_seq].
+        for f in frames:
+            assert f["seqs"][0] == 0 and f["seqs"][1] == f["last_seq"]
+            assert len(f["changes"]) >= 1
+        # A need outside the held range serves nothing.
+        async def none():
+            s = FakeSession()
+            await a._serve_need(s, a.actor_id, booked, FullNeed(7, 9))
+            return s.frames
+
+        assert run(none()) == []
+    finally:
+        a.store.close()
+
+
+def test_serve_full_need_sends_cleared_spans(tmp_path):
+    a = make_agent(tmp_path)
+    try:
+        for i in range(4):
+            a.execute(
+                [Statement("INSERT INTO tests (id, text) VALUES (?, ?)",
+                           params=[10 + i, "x"])]
+            )
+        booked = a.bookie.for_actor(a.actor_id)
+        booked.insert_many(1, 2, CLEARED)
+
+        async def main():
+            s = FakeSession()
+            await a._serve_need(s, a.actor_id, booked, FullNeed(1, 4))
+            return s.frames
+
+        frames = run(main())
+        kinds = [f["t"] for f in frames]
+        assert kinds[0] == "sync_cleared"
+        assert frames[0]["versions"] == [(1, 2)]
+        # The still-current tail streams as changesets.
+        assert [f["version"] for f in frames[1:]] == [3, 4]
+        # Cleared range clipped to the need window (partial overlap).
+        async def clipped():
+            s = FakeSession()
+            await a._serve_need(s, a.actor_id, booked, FullNeed(2, 3))
+            return s.frames
+
+        frames = run(clipped())
+        assert frames[0]["t"] == "sync_cleared"
+        assert frames[0]["versions"] == [(2, 2)]
+    finally:
+        a.store.close()
+
+
+def test_serve_partial_need_serves_buffered_seq_ranges(tmp_path):
+    a = make_agent(tmp_path)
+    try:
+        actor = "ab" * 16  # a remote actor
+        site = bytes.fromhex(actor)
+        booked = a.bookie.for_actor(actor)
+        # Buffer seqs 0-1 and 4-5 of a 6-seq version (gap at 2-3), like
+        # process_incomplete_version would (agent.rs:2063-2151).
+        rows = []
+        for seq in (0, 1, 4, 5):
+            rows.append(Change(
+                table="tests", pk=b"\x01", cid="text", val=f"s{seq}",
+                col_version=1, db_version=9, seq=seq, site_id=site, cl=1,
+            ))
+        with a.store._wlock("test_seed"):
+            for ch in rows:
+                a.store.conn.execute(
+                    "INSERT INTO __corro_buffered_changes VALUES"
+                    " (?, 5, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    (site, ch.table, ch.pk, ch.cid, ch.val, ch.col_version,
+                     ch.db_version, ch.seq, ch.site_id, ch.cl),
+                )
+        booked.insert(
+            5, Partial(seqs=RangeSet([(0, 1), (4, 5)]), last_seq=6, ts=0)
+        )
+
+        async def main(need):
+            s = FakeSession()
+            await a._serve_need(s, actor, booked, need)
+            return s.frames
+
+        # Request exactly the buffered ranges.
+        frames = run(main(PartialNeed(5, [(0, 1), (4, 5)])))
+        assert [f["t"] for f in frames] == ["sync_changes"] * 2
+        assert frames[0]["seqs"] == [0, 1] and frames[1]["seqs"] == [4, 5]
+        assert [c[6] for c in frames[0]["changes"]] == [0, 1]  # seq column
+        # A range covering the gap serves only what is buffered.
+        frames = run(main(PartialNeed(5, [(0, 5)])))
+        assert len(frames) == 1
+        assert frames[0]["seqs"] == [0, 5]
+        assert [c[6] for c in frames[0]["changes"]] == [0, 1, 4, 5]
+        # Ranges entirely inside the gap serve nothing.
+        assert run(main(PartialNeed(5, [(2, 3)]))) == []
+        # A PartialNeed for a version we hold as Current is ignored (the
+        # client's state was stale).
+        booked2 = a.bookie.for_actor(a.actor_id)
+        assert run(main(PartialNeed(99, [(0, 1)]))) == []
+    finally:
+        a.store.close()
