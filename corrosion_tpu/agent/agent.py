@@ -13,6 +13,7 @@ process_fully_buffered_changes :1667-1806).
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import random
 import time
@@ -776,7 +777,7 @@ class Agent:
         queue_g = self.metrics.gauge(
             "corro_sqlite_write_queue", "queued writer jobs per priority"
         )
-        interval = max(self.cfg.compact_interval / 2, 0.5)
+        interval = max(self.cfg.compact_interval / 2, 0.25)
         while not self.tripwire.tripped:
             await asyncio.sleep(interval)
             try:
@@ -792,12 +793,14 @@ class Agent:
                     Statement("SELECT count(*) FROM __crdt_changes")
                 )
                 log_g.set(rows[0][0])
-                for label, p in (("high", 0), ("normal", 1), ("low", 2)):
-                    queue_g.set(
-                        self.pool._queues[p].qsize(), priority=label
-                    )
+                for label, depth in self.pool.queue_depths().items():
+                    queue_g.set(depth, priority=label)
             except Exception:
-                pass
+                # Keep sampling; stale gauges with no signal would hide
+                # the failure entirely.
+                logging.getLogger(__name__).debug(
+                    "metrics sample failed", exc_info=True
+                )
 
     # -- SWIM loop -------------------------------------------------------------
 

@@ -120,7 +120,9 @@ _SESSION_SHIMS = [
 
 def _split_quoted(sql: str) -> list[tuple[bool, str]]:
     """Split SQL into (is_quoted, segment) runs; quoted segments include
-    their delimiters and respect doubled-quote escapes."""
+    their delimiters. A doubled quote ('it''s') splits into two adjacent
+    quoted segments — the literal's content never lands in an unquoted
+    run, which is the property the callers rely on."""
     out: list[tuple[bool, str]] = []
     cur: list[str] = []
     quote: str | None = None
@@ -221,15 +223,35 @@ def catalog_conn(agent: "Agent") -> sqlite3.Connection:
             (oid, name, _NS_PUBLIC),
         )
         c.execute("INSERT INTO pg_tables VALUES ('public', ?)", (name,))
+        decl = {
+            row[1]: (row[2] or "") for row in c.execute(
+                f'PRAGMA table_info("{name}")'
+            )
+        }
         for attnum, col in enumerate(
             [*info.pk_cols, *info.data_cols], start=1
         ):
             c.execute(
-                "INSERT INTO pg_attribute VALUES (?, ?, 25, ?, ?, 0)",
-                (oid, col, attnum, int(col in info.pk_cols)),
+                "INSERT INTO pg_attribute VALUES (?, ?, ?, ?, ?, 0)",
+                (oid, col, _affinity_oid(decl.get(col, "")), attnum,
+                 int(col in info.pk_cols)),
             )
         oid += 1
     return c
+
+
+def _affinity_oid(decl_type: str) -> int:
+    """SQLite declared type → pg_type oid, by SQLite's affinity rules."""
+    t = decl_type.upper()
+    if "INT" in t:
+        return 20  # int8
+    if "CHAR" in t or "CLOB" in t or "TEXT" in t:
+        return 25
+    if "BLOB" in t or not t:
+        return 17
+    if "REAL" in t or "FLOA" in t or "DOUB" in t:
+        return 701
+    return 1700  # NUMERIC affinity
 
 
 async def _run_query(
@@ -260,34 +282,14 @@ async def _run_query(
 _CATALOG_PREFIX_STRIP = [(re.compile(r"(?i)\bpg_catalog\."), "")]
 
 
+_PLACEHOLDER_SUB = [(re.compile(r"\$(\d+)"), r"?\1")]
+
+
 def translate_placeholders(sql: str) -> str:
-    """PG ``$N`` → SQLite ``?N``, outside string/identifier literals."""
-    out: list[str] = []
-    quote: str | None = None
-    i = 0
-    while i < len(sql):
-        ch = sql[i]
-        if quote is not None:
-            out.append(ch)
-            if ch == quote:
-                quote = None
-            i += 1
-        elif ch in ("'", '"'):
-            quote = ch
-            out.append(ch)
-            i += 1
-        elif ch == "$":
-            m = re.match(r"\$(\d+)", sql[i:])
-            if m:
-                out.append("?" + m.group(1))
-                i += len(m.group(0))
-            else:
-                out.append(ch)
-                i += 1
-        else:
-            out.append(ch)
-            i += 1
-    return "".join(out)
+    """PG ``$N`` → SQLite ``?N``, outside string/identifier literals
+    (one quote scanner — ``_split_quoted`` — serves shims, catalog
+    routing, and placeholder translation alike)."""
+    return _sub_unquoted(sql, _PLACEHOLDER_SUB)
 
 
 class _Prepared:
@@ -446,7 +448,9 @@ async def _extended(
             for oid in stmt.param_oids:
                 body += struct.pack(">I", oid or TEXT_OID)
             writer.write(_msg(b"t", body))  # ParameterDescription
-            cols = _try_describe(agent, stmt)
+            # Off-loop: the probe may build a catalog snapshot (fresh
+            # connection + temp tables) — not event-loop work.
+            cols = await asyncio.to_thread(_try_describe, agent, stmt)
             writer.write(_row_description(cols) if cols else _msg(b"n", b""))
             return
         portal = portals.get(name)

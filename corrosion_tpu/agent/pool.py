@@ -131,6 +131,14 @@ class SplitPool:
             if not job.future.done():
                 job.future.set_result(result)
 
+    def queue_depths(self) -> dict[str, int]:
+        """Queued writer jobs per priority class (for metrics)."""
+        return {
+            "high": self._queues[HIGH].qsize(),
+            "normal": self._queues[NORMAL].qsize(),
+            "low": self._queues[LOW].qsize(),
+        }
+
     def _pop(self) -> _Job | None:
         for p in (HIGH, NORMAL, LOW):
             try:

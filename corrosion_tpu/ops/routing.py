@@ -38,8 +38,11 @@ def bounded_intake(
     """
     m = recv.shape[0]
     key = jnp.where(valid, recv, n_rows).astype(jnp.int32)
-    order = jnp.argsort(key, stable=True)
-    s_key = key[order]
+    # One fused sort carrying all payloads (vs argsort + one gather per
+    # payload); stable keeps the documented lowest-index-wins guarantee.
+    sorted_ops = jax.lax.sort((key, *payloads), num_keys=1, is_stable=True)
+    s_key = sorted_ops[0]
+    s_payloads = sorted_ops[1:]
     idxs = jnp.arange(m)
     run_first = jnp.where(
         jnp.concatenate([jnp.array([True]), s_key[1:] != s_key[:-1]]), idxs, 0
@@ -59,9 +62,8 @@ def bounded_intake(
         .reshape(n_rows, k)
     )
     outs = []
-    for p in payloads:
-        sp = p[order]
-        zero = jnp.zeros((n_rows * k,), dtype=p.dtype)
+    for sp in s_payloads:
+        zero = jnp.zeros((n_rows * k,), dtype=sp.dtype)
         outs.append(
             zero.at[slot].set(jnp.where(ok, sp, 0), mode="drop").reshape(n_rows, k)
         )
@@ -103,8 +105,12 @@ def rebuild_bounded_queue(
     """
     neg_inf = jnp.int32(-(2**31) + 1)
     prio = jnp.where(cand_valid, cand_prio.astype(jnp.int32), neg_inf)
-    order = jnp.argsort(-prio, axis=1, stable=True)[:, :capacity]
-    take = jnp.take_along_axis
-    mask = take(cand_valid, order, axis=1)
-    outs = tuple(take(p, order, axis=1) for p in payloads)
+    # One fused sort carrying mask + payloads (vs argsort + a gather per
+    # payload). Stable so over-capacity ties drop deterministically.
+    sorted_ops = jax.lax.sort(
+        (-prio, cand_valid, *payloads), dimension=1, num_keys=1,
+        is_stable=True,
+    )
+    mask = sorted_ops[1][:, :capacity]
+    outs = tuple(p[:, :capacity] for p in sorted_ops[2:])
     return mask, outs

@@ -191,24 +191,36 @@ def test_agent_prometheus_endpoint(tmp_path):
     async def main():
         a = await launch_test_agent(
             str(tmp_path / "a"), prometheus_addr="127.0.0.1:0",
-            compact_interval=0.4,  # metrics_loop samples at half this
+            compact_interval=0.4,  # metrics_loop samples every 0.25 s floor
         )
         try:
             await a.client.execute(
                 [["INSERT INTO tests (id, text) VALUES (1, 'm')"]]
             )
-            await asyncio.sleep(0.5)  # let the metrics_loop sample once
             host, port = a.agent.prometheus_addr
-            body = await asyncio.to_thread(
-                lambda: urllib.request.urlopen(
-                    f"http://{host}:{port}/metrics"
-                ).read().decode()
-            )
+
+            async def fetch():
+                return await asyncio.to_thread(
+                    lambda: urllib.request.urlopen(
+                        f"http://{host}:{port}/metrics"
+                    ).read().decode()
+                )
+
+            body = await fetch()
             assert "corro_gossip_members" in body
+
             # collect_metrics parity: per-table row counts + pool queues
-            # (agent.rs:1138-1187).
-            assert 'corro_db_table_rows{table="tests"} 1' in body
-            assert "corro_sqlite_write_queue" in body
+            # (agent.rs:1138-1187) — poll past the sampling cadence.
+            async def sampled():
+                body = await fetch()
+                return (
+                    'corro_db_table_rows{table="tests"} 1' in body
+                    and "corro_sqlite_write_queue" in body
+                )
+
+            from corrosion_tpu.agent.testing import poll_until
+
+            await poll_until(sampled, timeout=10.0)
         finally:
             await a.stop()
 

@@ -146,7 +146,7 @@ def test_pg_catalog_introspection(tmp_path):
                 " WHERE c.relname = 'tests' ORDER BY a.attnum"
             )
             assert _rows(msgs) == [
-                ["id", "text", "1"], ["text", "text", "0"],
+                ["id", "int8", "1"], ["text", "text", "0"],
             ]
             msgs = await pg.query(
                 "SELECT nspname FROM pg_namespace ORDER BY oid"
