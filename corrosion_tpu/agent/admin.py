@@ -84,6 +84,13 @@ async def _handle(agent: "Agent", session: Session, msg: dict) -> None:
     elif cmd == "reload":
         sql = msg.get("schema_sql", "")
         changed = agent.store.apply_schema(sql) if sql else []
+        if "api_concurrency" in msg:
+            agent.cfg.api_concurrency = int(msg["api_concurrency"])
+        if "migration_concurrency" in msg:
+            agent.cfg.migration_concurrency = int(msg["migration_concurrency"])
+        from corrosion_tpu.agent.api import rebuild_api_limits
+
+        rebuild_api_limits(agent)  # config hot-reload reaches admission
         await session.send({"reloaded": changed})
     elif cmd == "restore":
         actor = await agent.restore_online(

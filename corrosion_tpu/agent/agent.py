@@ -64,6 +64,10 @@ class AgentConfig:
     sync_peers: int = 3  # 3-10 by need desc / ring asc (agent.rs:2383-2423)
     ingest_batch: int = 1000  # handle_changes batching (agent.rs:2450-2518)
     ingest_linger: float = 0.05
+    # Admission control: per-route concurrency + load-shed (128 per route,
+    # 4 for migrations; agent.rs:836-902).
+    api_concurrency: int = 128
+    migration_concurrency: int = 4
     admin_uds: str = ""  # unix socket path for admin RPC ("" = disabled)
     # Compaction cadence. The reference runs clear_overwritten_versions
     # every 300 s and batches empties for 120 s (agent.rs:86, :2520);
@@ -161,6 +165,9 @@ class Agent:
         self._addr_of: dict[str, tuple[str, int]] = {}
         self._api_server = None
         self.subs = None  # SubsManager, attached by api/subs wiring
+        # Optional (actor_id, version, hlc_ts) hook on every committed
+        # local write — the trace-recording seam for kernel replay.
+        self.on_local_write = None
         self._rehydrate()
         if cfg.schema_sql:
             self.store.apply_schema(cfg.schema_sql)
@@ -341,6 +348,10 @@ class Agent:
             booked.insert(
                 version, Current(db_version=dbv, last_seq=last_seq, ts=ts)
             )
+            if self.on_local_write is not None:
+                # Trace hook: real write traffic recorded for kernel replay
+                # (sim/trace.py; SURVEY §7 step 7's dispatch-seam bridge).
+                self.on_local_write(self.actor_id, version, ts)
             dirty = (
                 self.subs.match_changes(changes)
                 if self.subs is not None else []

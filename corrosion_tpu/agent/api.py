@@ -31,6 +31,26 @@ class HttpError(Exception):
         self.message = message
 
 
+class RouteLimit:
+    """Admission control per route: the reference wraps every /v1 route in
+    a concurrency limit + load-shed (128 per route, 4 for migrations;
+    agent.rs:836-902). Handlers run on one event loop, so a plain counter
+    suffices; over-limit requests shed immediately with 503."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+        self.active = 0
+
+    def __enter__(self):
+        if self.active >= self.limit:
+            raise HttpError(503, "concurrency limit reached (load shed)")
+        self.active += 1
+        return self
+
+    def __exit__(self, *exc):
+        self.active -= 1
+
+
 async def _read_request(reader: asyncio.StreamReader):
     line = await reader.readline()
     if not line:
@@ -63,7 +83,8 @@ async def _read_request(reader: asyncio.StreamReader):
 def _resp(writer, status: int, body: bytes, content_type="application/json"):
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
               413: "Payload Too Large", 500: "Internal Server Error",
-              501: "Not Implemented"}.get(status, "?")
+              501: "Not Implemented",
+              503: "Service Unavailable"}.get(status, "?")
     writer.write(
         f"HTTP/1.1 {status} {reason}\r\n"
         f"content-type: {content_type}\r\n"
@@ -112,7 +133,7 @@ async def serve_api(agent: "Agent") -> tuple[str, int]:
                 url = urlparse(target)
                 try:
                     keep = await _route(
-                        agent, writer, method, url.path,
+                        agent, reader, writer, method, url.path,
                         parse_qs(url.query), body,
                     )
                 except HttpError as e:
@@ -132,6 +153,7 @@ async def serve_api(agent: "Agent") -> tuple[str, int]:
             except Exception:
                 pass
 
+    rebuild_api_limits(agent)
     server = await asyncio.start_server(
         on_conn, agent.cfg.api_host, agent.cfg.api_port
     )
@@ -140,9 +162,32 @@ async def serve_api(agent: "Agent") -> tuple[str, int]:
     return sock[0], sock[1]
 
 
-async def _route(agent, writer, method, path, query, body) -> bool:
+def rebuild_api_limits(agent) -> None:
+    """(Re)build the per-route admission limits from the current config —
+    called at serve time and by config hot-reload so a changed
+    api_concurrency takes effect without restart. In-flight requests keep
+    their old limiter; new requests see the new one."""
+    n = agent.cfg.api_concurrency
+    agent._api_limits = {
+        "/v1/transactions": RouteLimit(n),
+        "/v1/queries": RouteLimit(n),
+        "/v1/migrations": RouteLimit(agent.cfg.migration_concurrency),
+        "/v1/subscriptions": RouteLimit(n),
+    }
+
+
+async def _route(agent, reader, writer, method, path, query, body) -> bool:
     """Dispatch; returns False when the connection was turned into a stream
     (and must close when the stream ends)."""
+    route_key = "/".join(path.split("/")[:3])  # /v1/<route>
+    limit = agent._api_limits.get(route_key)
+    if limit is None:
+        return await _dispatch(agent, reader, writer, method, path, query, body)
+    with limit:
+        return await _dispatch(agent, reader, writer, method, path, query, body)
+
+
+async def _dispatch(agent, reader, writer, method, path, query, body) -> bool:
     if method == "POST" and path == "/v1/transactions":
         stmts = [Statement.parse(o) for o in _json_body(body)]
         resp = await agent.execute_async(stmts)
@@ -177,7 +222,7 @@ async def _route(agent, writer, method, path, query, body) -> bool:
             raise HttpError(501, "subscriptions not enabled")
         stmt = Statement.parse(_json_body(body))
         handle = agent.subs.subscribe(stmt.sql)
-        await _stream_sub(agent, writer, handle, from_change=None,
+        await _stream_sub(agent, reader, writer, handle, from_change=None,
                           skip_rows=query.get("skip_rows") == ["true"])
         return False
     if method == "GET" and path.startswith("/v1/subscriptions/"):
@@ -189,7 +234,7 @@ async def _route(agent, writer, method, path, query, body) -> bool:
             raise HttpError(404, f"no such subscription {sub_id}")
         frm = query.get("from")
         await _stream_sub(
-            agent, writer, handle,
+            agent, reader, writer, handle,
             from_change=int(frm[0]) if frm else None,
             skip_rows=query.get("skip_rows") == ["true"],
         )
@@ -197,16 +242,27 @@ async def _route(agent, writer, method, path, query, body) -> bool:
     raise HttpError(404, f"no route for {method} {path}")
 
 
-async def _stream_sub(agent, writer, handle, from_change, skip_rows) -> None:
+async def _stream_sub(
+    agent, reader, writer, handle, from_change, skip_rows
+) -> None:
     """NDJSON QueryEvent stream (api/public/pubsub.rs:36-180)."""
     await _start_stream(writer)
     queue = handle.attach()
+    # Disconnect watch: an idle stream never writes, so a vanished client
+    # would otherwise hold the handler (and its admission-control slot)
+    # forever. Clients send nothing after the request, so any read
+    # completion — EOF included — means the peer is gone. Deliberate
+    # trade-off: a client that half-closes its write side (SHUT_WR) while
+    # still reading gets its stream ended — admission-control slots must
+    # not leak, and the SDK never half-closes; reconnect via ?from= covers
+    # the exotic client.
+    eof = asyncio.ensure_future(reader.read(1))
     try:
         for ev in handle.backlog(from_change=from_change, skip_rows=skip_rows):
             await _stream_chunk(
                 writer, json.dumps(_json_safe(ev.to_json_obj())).encode() + b"\n"
             )
-        while not agent.tripwire.tripped:
+        while not agent.tripwire.tripped and not eof.done():
             try:
                 ev = await asyncio.wait_for(queue.get(), timeout=0.5)
             except asyncio.TimeoutError:
@@ -215,6 +271,7 @@ async def _stream_sub(agent, writer, handle, from_change, skip_rows) -> None:
                 writer, json.dumps(_json_safe(ev.to_json_obj())).encode() + b"\n"
             )
     finally:
+        eof.cancel()
         handle.detach(queue)
         try:
             await _end_stream(writer)

@@ -19,6 +19,7 @@ requesting binary gets a clean protocol error.
 from __future__ import annotations
 
 import asyncio
+import logging
 import re
 import sqlite3
 import struct
@@ -540,12 +541,23 @@ def _try_describe(agent: "Agent", stmt: _Prepared) -> list[str] | None:
                 )
             finally:
                 c.close()
-        cur = agent.store.read_conn.execute(
-            f"SELECT * FROM ({stmt.translated}) LIMIT 0",
-            tuple([None] * n_params),
-        )
-        return [d[0] for d in cur.description] if cur.description else None
+        # Fresh connection: this probe runs in a to_thread worker, and the
+        # store's shared read_conn belongs to the event loop.
+        c = sqlite3.connect(agent.store.path)
+        try:
+            cur = c.execute(
+                f"SELECT * FROM ({stmt.translated}) LIMIT 0",
+                tuple([None] * n_params),
+            )
+            return [d[0] for d in cur.description] if cur.description else None
+        finally:
+            c.close()
     except Exception:
+        # NoData is the protocol fallback; keep a debug trail so a broken
+        # probe doesn't silently degrade every prepared query.
+        logging.getLogger(__name__).debug(
+            "describe probe failed", exc_info=True
+        )
         return None
 
 

@@ -16,3 +16,8 @@ from corrosion_tpu.sim.engine import (  # noqa: F401
     simulate,
     visibility_latencies,
 )
+from corrosion_tpu.sim.trace import (  # noqa: F401
+    Trace,
+    replay,
+    schedule_from_trace,
+)

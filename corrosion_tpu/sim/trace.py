@@ -1,0 +1,131 @@
+"""Record real host-agent write traffic and replay it in the kernel.
+
+The bridge across the dispatch seam (SURVEY §7 step 7): the reference's
+agent pushes every local write into `tx_bcast` (BroadcastInput::AddBroadcast,
+corro-types/src/agent.rs:64-69); here each agent's committed writes are
+recorded as (time, actor, version) events via `Agent.on_local_write`, and
+`replay` re-executes the same write workload inside the whole-cluster
+simulator — the scripted `Schedule` becomes a faithful transcript of real
+traffic, so kernel visibility/convergence numbers can be read for workloads
+that actually happened.
+
+Round mapping: one simulator round is `round_ms` of recorded wall time (the
+broadcast flush tick, 500 ms in the reference). Every recorded actor becomes
+one writer stream; extra silent observer nodes can be added to study how the
+same workload would propagate in a larger cluster.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from corrosion_tpu.core.hlc import ts_physical_ms
+
+
+@dataclass
+class Trace:
+    """Ordered (t_ms, actor_id, version) write events."""
+
+    events: list[tuple[int, str, int]] = field(default_factory=list)
+
+    def record(self, agent) -> None:
+        """Attach to a live Agent: every committed local write appends an
+        event (hook installed on Agent.on_local_write)."""
+
+        def hook(actor_id: str, version: int, ts) -> None:
+            self.events.append((ts_physical_ms(ts), actor_id, version))
+
+        agent.on_local_write = hook
+
+    def merge(self, other: "Trace") -> "Trace":
+        out = Trace(events=sorted(self.events + other.events))
+        return out
+
+    @property
+    def actors(self) -> list[str]:
+        return sorted({a for _, a, _ in self.events})
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            for t, a, v in sorted(self.events):
+                f.write(json.dumps([t, a, v]) + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Trace":
+        events = []
+        with open(path) as f:
+            for line in f:
+                t, a, v = json.loads(line)
+                events.append((int(t), a, int(v)))
+        return cls(events=sorted(events))
+
+
+def schedule_from_trace(
+    trace: Trace, round_ms: float = 500.0, drain_rounds: int = 40,
+    samples: int = 256,
+):
+    """Bucket recorded writes into simulator rounds.
+
+    Returns (actor_ids, Schedule): actor i of the sorted actor list becomes
+    writer stream i; writes[r, i] counts the versions actor i committed in
+    round r's wall-time window. Versions must be each actor's contiguous
+    1..n sequence (they are — the agent allocates them that way); the
+    count-per-bucket encoding preserves exactly that order.
+    """
+    from corrosion_tpu.sim.engine import Schedule
+
+    if not trace.events:
+        raise ValueError("empty trace")
+    events = sorted(trace.events)
+    actors = trace.actors
+    a_idx = {a: i for i, a in enumerate(actors)}
+    # Sanity: contiguous per-actor version sequences.
+    seen: dict[str, int] = {}
+    for _, a, v in events:
+        expect = seen.get(a, 0) + 1
+        if v != expect:
+            raise ValueError(
+                f"trace gap: actor {a[:8]} version {v}, expected {expect}"
+            )
+        seen[a] = v
+    t0 = events[0][0]
+    rounds = int((events[-1][0] - t0) // round_ms) + 1
+    writes = np.zeros((rounds + drain_rounds, len(actors)), np.uint32)
+    for t, a, _v in events:
+        r = int((t - t0) // round_ms)
+        writes[r, a_idx[a]] += 1
+    return actors, Schedule(writes=writes).make_samples(samples)
+
+
+def replay(
+    trace: Trace, round_ms: float = 500.0, observers: int = 0,
+    drain_rounds: int = 40, seed: int = 0, **gossip_kw,
+):
+    """Re-run a recorded workload in the kernel cluster.
+
+    The recorded actors become writer nodes 0..W-1; ``observers`` adds
+    silent nodes that only receive. Returns (actors, final, curves, lat).
+    """
+    from corrosion_tpu.models.baselines import _cfg
+    from corrosion_tpu.sim import simulate, visibility_latencies
+
+    actors, sched = schedule_from_trace(
+        trace, round_ms=round_ms, drain_rounds=drain_rounds
+    )
+    w = len(actors)
+    n = w + observers
+    max_writes = int(sched.writes.max())
+    cfg, topo = _cfg(
+        n,
+        writers=list(range(w)),
+        sync_interval=4,
+        n_cells=256,
+        max_writes_per_round=max(4, max_writes),
+        **gossip_kw,
+    )
+    final, curves = simulate(cfg, topo, sched, seed=seed)
+    lat = visibility_latencies(final, sched, cfg)
+    return actors, final, curves, lat

@@ -433,6 +433,51 @@ def test_subscription_semicolon_and_limit_membership(tmp_path):
     run(main())
 
 
+def test_api_concurrency_load_shed(tmp_path):
+    """P8 admission control: over-limit requests shed with 503 instead of
+    queueing (the reference's per-route ConcurrencyLimit + load-shed,
+    agent.rs:836-902; migrations get their own, smaller limit)."""
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"), api_concurrency=2)
+        try:
+            # Two open subscription streams occupy the route's two slots.
+            s1 = await a.client.subscribe("SELECT id FROM tests")
+            s2 = await a.client.subscribe("SELECT text FROM tests")
+            from corrosion_tpu.client import ApiError
+
+            try:
+                await a.client.subscribe("SELECT id, text FROM tests")
+                raise AssertionError("third stream should shed")
+            except ApiError as e:
+                assert e.status == 503
+            # Other routes have their own limits: writes still work.
+            resp = await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'x')"]]
+            )
+            assert resp["results"][0]["rows_affected"] == 1
+            s1.close()
+            s2.close()
+
+            # Slots free asynchronously (the server notices the closed
+            # connection when its stream write fails); poll for reuse.
+            async def slot_free():
+                try:
+                    s3 = await a.client.subscribe(
+                        "SELECT id, text FROM tests"
+                    )
+                except ApiError:
+                    return False
+                s3.close()
+                return True
+
+            await poll_until(slot_free, timeout=10.0)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
 def test_subscription_window_function_full_diff(tmp_path):
     """A window function's value on UNCHANGED rows shifts when other rows
     change, so such queries must keep full-diff semantics — the candidate
