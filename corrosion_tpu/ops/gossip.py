@@ -656,27 +656,33 @@ def _sync_rows(
     )
     pulls = [(sel[:, s], sel_ok[:, s]) for s in range(cfg.sync_peers)]
     pulls.append((origin, origin_ok))
-    contig_r = contig0
-    budget_left = jnp.full((r,), cfg.sync_budget, jnp.int32)
+    # Union pull: the session pulls from the UNION of what its chosen
+    # peers hold — one elementwise max over the peers' watermark rows,
+    # then a single budgeted grant pass, instead of a deficit + cumsum
+    # sweep over [R, W] per peer (the per-peer sweeps were the sync
+    # plane's dominant cost mid-run: 4x the [cohort, writers] traffic).
+    # Versions teleport within a round in this model, so which peer a
+    # granted version "came from" is unobservable; the only semantic
+    # shift is that sync_chunk caps a writer's grant once per session
+    # rather than once per peer.
+    avail = contig0
     for p, ok_s in pulls:
-        p_contig = data.contig[p]  # [R, W]
-        deficit = (p_contig - jnp.minimum(p_contig, contig_r)).astype(
-            jnp.uint32
+        avail = jnp.maximum(
+            avail, jnp.where(ok_s[:, None], data.contig[p], 0)
         )
-        per_w = jnp.minimum(deficit, jnp.uint32(cfg.sync_chunk)).astype(
-            jnp.int32
-        )
-        per_w = jnp.where(ok_s[:, None], per_w, 0)
-        cum = jnp.cumsum(per_w, axis=1)
-        grant = jnp.clip(
-            budget_left[:, None] - (cum - per_w), 0, per_w
-        ).astype(jnp.uint32)
-        contig_r = contig_r + grant
-        budget_left = budget_left - jnp.sum(grant, axis=1, dtype=jnp.int32)
         if not exact:
             seen_r = jnp.maximum(
                 seen_r, jnp.where(ok_s[:, None], data.seen[p], 0)
             )
+    deficit = (avail - jnp.minimum(avail, contig0)).astype(jnp.uint32)
+    per_w = jnp.minimum(deficit, jnp.uint32(cfg.sync_chunk)).astype(
+        jnp.int32
+    )
+    cum = jnp.cumsum(per_w, axis=1)
+    grant = jnp.clip(
+        jnp.int32(cfg.sync_budget) - (cum - per_w), 0, per_w
+    ).astype(jnp.uint32)
+    contig_r = contig0 + grant
     seen_r = jnp.maximum(seen_r, contig_r)
 
     cells = data.cells

@@ -10,6 +10,7 @@ scatter boundaries (all-to-all-shaped traffic riding ICI).
 
 from corrosion_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
+    make_wan_mesh,
     shard_cluster_state,
     shard_topology,
 )

@@ -31,11 +31,42 @@ def make_mesh(n_devices: int | None = None, axis: str = "nodes") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def make_wan_mesh(n_dcn: int, n_ici: int) -> Mesh:
+    """2-D (dcn, ici) mesh for the partitioned-WAN configs.
+
+    Node indices are region-blocked (make_topology lays regions out as
+    contiguous index ranges), and a multi-axis node sharding
+    ``P(("dcn", "ici"))`` splits the node axis with ``dcn`` as the outer
+    (slow) axis — so whole regions land inside one dcn group when
+    n_regions is a multiple of n_dcn. In-region traffic (ring-0 near
+    pulls, most broadcast volume) then stays inside an ICI group's
+    all-to-all, and only cross-region gossip crosses the DCN axis —
+    matching how the reference's WAN deployments keep gossip chatter
+    regional (the ICI/DCN split of SURVEY §5's comm-backend plan).
+    """
+    devs = jax.devices()
+    if n_dcn * n_ici > len(devs):
+        raise ValueError(
+            f"need {n_dcn * n_ici} devices, have {len(devs)}"
+        )
+    arr = np.array(devs[: n_dcn * n_ici]).reshape(n_dcn, n_ici)
+    return Mesh(arr, ("dcn", "ici"))
+
+
+def _node_axis(mesh: Mesh, axis):
+    """Node-dimension spec entry: the mesh's full axis tuple for multi-axis
+    meshes (dcn outer, ici inner), else the single named axis."""
+    if axis is not None:
+        return axis
+    return mesh.axis_names if len(mesh.axis_names) > 1 else mesh.axis_names[0]
+
+
 def _put(x, mesh: Mesh, spec: P):
     return jax.device_put(x, NamedSharding(mesh, spec))
 
 
-def shard_topology(topo: Topology, mesh: Mesh, axis: str = "nodes") -> Topology:
+def shard_topology(topo: Topology, mesh: Mesh, axis=None) -> Topology:
+    axis = _node_axis(mesh, axis)
     n = P(axis)
     r = P()  # replicated
     return Topology(
@@ -54,8 +85,9 @@ def shard_topology(topo: Topology, mesh: Mesh, axis: str = "nodes") -> Topology:
 
 
 def shard_cluster_state(
-    state: ClusterState, mesh: Mesh, axis: str = "nodes"
+    state: ClusterState, mesh: Mesh, axis=None
 ) -> ClusterState:
+    axis = _node_axis(mesh, axis)
     row = P(axis, None)
     vec = P(axis)
     rep = P()

@@ -13,23 +13,26 @@ import pytest
 from corrosion_tpu import models, parallel
 from corrosion_tpu.sim import engine, simulate
 
+N, N_REGIONS = 64, 4
 
-@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
-def test_sharded_run_is_bit_identical():
+
+def _wan_setup():
     cfg, topo, sched = models.wan_100k(
-        n=64, n_regions=4, n_writers=16, rounds=24, samples=16
+        n=N, n_regions=N_REGIONS, n_writers=16, rounds=24, samples=16
     )
     sched.writes[:8, :] = 1
     sched = sched.make_samples(16)
+    return cfg, topo, sched
 
-    final_u, curves_u = simulate(cfg, topo, sched, seed=5)
 
-    mesh = parallel.make_mesh(8)
+def _run_sharded(cfg, topo, sched, mesh):
     topo_s = parallel.shard_topology(topo, mesh)
     state0 = engine.init_cluster(cfg, len(sched.sample_writer))
     state0 = parallel.shard_cluster_state(state0, mesh)
-    final_s, curves_s = simulate(cfg, topo_s, sched, seed=5, state=state0)
+    return simulate(cfg, topo_s, sched, seed=5, state=state0)
 
+
+def _assert_identical(final_u, final_s, curves_u=None, curves_s=None):
     for name in ("head", "contig", "seen", "q_writer", "q_ver", "q_tx"):
         np.testing.assert_array_equal(
             np.asarray(getattr(final_u.data, name)),
@@ -50,14 +53,60 @@ def test_sharded_run_is_bit_identical():
     np.testing.assert_array_equal(
         np.asarray(final_u.vis_round), np.asarray(final_s.vis_round)
     )
-    for k in curves_u:
-        np.testing.assert_array_equal(curves_u[k], curves_s[k], err_msg=k)
+    if curves_u is not None:
+        for k in curves_u:
+            np.testing.assert_array_equal(curves_u[k], curves_s[k], err_msg=k)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_run_is_bit_identical():
+    cfg, topo, sched = _wan_setup()
+    final_u, curves_u = simulate(cfg, topo, sched, seed=5)
+    final_s, curves_s = _run_sharded(cfg, topo, sched, parallel.make_mesh(8))
+    _assert_identical(final_u, final_s, curves_u, curves_s)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_wan_mesh_2d_bit_identical_and_region_blocked():
+    """The (dcn, ici) WAN mesh must be semantics-preserving AND place every
+    region's rows inside a single DCN group — the locality make_wan_mesh
+    exists for (in-region gossip rides ICI; only cross-region crosses DCN).
+    """
+    cfg, topo, sched = _wan_setup()
+    final_u, _ = simulate(cfg, topo, sched, seed=5)
+    mesh = parallel.make_wan_mesh(n_dcn=2, n_ici=4)
+    assert mesh.axis_names == ("dcn", "ici")
+    final_s, _ = _run_sharded(cfg, topo, sched, mesh)
+    _assert_identical(final_u, final_s)
+
+    # Placement: device -> dcn coordinate from the mesh layout; every
+    # shard's node rows must belong to ONE region, and every region's
+    # shards must sit on devices of ONE dcn group.
+    dcn_of_device = {}
+    for d in range(mesh.devices.shape[0]):
+        for j in range(mesh.devices.shape[1]):
+            dcn_of_device[mesh.devices[d, j]] = d
+    region_size = N // N_REGIONS
+    regions_per_dcn = N_REGIONS // mesh.devices.shape[0]
+    dcn_groups_of_region: dict[int, set[int]] = {}
+    for shard in final_s.data.contig.addressable_shards:
+        rows = range(*shard.index[0].indices(N))
+        row_regions = {r // region_size for r in rows}
+        assert len(row_regions) == 1, "a shard must not straddle regions"
+        (region,) = row_regions
+        dcn_groups_of_region.setdefault(region, set()).add(
+            dcn_of_device[shard.device]
+        )
+    for region, groups in dcn_groups_of_region.items():
+        assert groups == {region // regions_per_dcn}, (
+            f"region {region} scattered across dcn groups {groups}"
+        )
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_sharded_state_is_actually_distributed():
     cfg, topo, sched = models.wan_100k(
-        n=64, n_regions=4, n_writers=16, rounds=4, samples=8
+        n=N, n_regions=N_REGIONS, n_writers=16, rounds=4, samples=8
     )
     mesh = parallel.make_mesh(8)
     state0 = engine.init_cluster(cfg, len(sched.sample_writer))
