@@ -47,6 +47,9 @@ def _time_plane(step, carry, iters=10):
 
 
 def main() -> None:
+    from corrosion_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     from corrosion_tpu import models

@@ -74,6 +74,9 @@ class AgentConfig:
     # scaled down to in-process test time.
     compact_interval: float = 5.0
     empties_flush_interval: float = 0.5
+    # WAL truncation cadence (the reference checkpoints + times WAL
+    # truncation in its db_cleanup loop, agent.rs:956-967, 1413-1435).
+    wal_checkpoint_interval: float = 15.0
     tls: "AgentTls | None" = None  # gossip-plane TLS (None = plaintext)
     prometheus_addr: str = ""  # host:port for /metrics ("" = disabled)
     trace_export_path: str = ""  # JSON-lines span export ("" = in-memory)
@@ -232,6 +235,7 @@ class Agent:
         )
         self.tasks.spawn(self._empties_loop(), name="write_empties_loop")
         self.tasks.spawn(self._metrics_loop(), name="metrics_loop")
+        self.tasks.spawn(self._wal_checkpoint_loop(), name="db_cleanup")
         if self.cfg.admin_uds:
             from corrosion_tpu.agent.admin import start_admin
 
@@ -811,6 +815,46 @@ class Agent:
                 # the failure entirely.
                 logging.getLogger(__name__).debug(
                     "metrics sample failed", exc_info=True
+                )
+
+    async def _wal_checkpoint_loop(self) -> None:
+        """Periodic WAL truncation on the writer, timed (the reference's
+        db_cleanup loop: PRAGMA wal_checkpoint(TRUNCATE) every 15 min with
+        a duration histogram, agent.rs:956-967, 1413-1435). Background
+        write tier: user writes always preempt it."""
+        hist = self.metrics.histogram(
+            "corro_db_wal_truncate_seconds", "WAL truncation duration"
+        )
+        bytes_g = self.metrics.gauge(
+            "corro_db_wal_bytes_truncated",
+            "WAL size reclaimed by the last truncation",
+        )
+        wal_path = self.store.path + "-wal"
+        while not self.tripwire.tripped:
+            await asyncio.sleep(self.cfg.wal_checkpoint_interval)
+            try:
+                t0 = time.monotonic()
+
+                def ckpt():
+                    # Size BEFORE truncating: the pragma reports the
+                    # post-truncation log (0 on success), not the amount
+                    # reclaimed.
+                    try:
+                        before = os.path.getsize(wal_path)
+                    except OSError:
+                        before = 0
+                    with self.store._wlock("wal_checkpoint"):
+                        self.store.conn.execute(
+                            "PRAGMA wal_checkpoint(TRUNCATE)"
+                        ).fetchone()
+                    return before
+
+                before = await self.pool.write_low(ckpt)
+                hist.observe(time.monotonic() - t0)
+                bytes_g.set(before)
+            except Exception:
+                logging.getLogger(__name__).debug(
+                    "wal checkpoint failed", exc_info=True
                 )
 
     # -- SWIM loop -------------------------------------------------------------

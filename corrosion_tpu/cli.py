@@ -17,28 +17,45 @@ from corrosion_tpu.agent.config import Config, parse_addr, resolve_bootstrap
 
 
 def _build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(prog="corrosion", description=__doc__)
-    p.add_argument("--config", "-c", default=None, help="TOML config path")
-    p.add_argument("--api-addr", default=None, help="host:port of the HTTP API")
-    p.add_argument("--admin-path", default=None, help="admin unix socket path")
+    # Global flags accepted before OR after the subcommand (the reference's
+    # clap marks them global). SUPPRESS defaults keep a subparser's parse
+    # from overwriting a value given before the subcommand.
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument(
+        "--config", "-c", default=argparse.SUPPRESS, help="TOML config path"
+    )
+    common.add_argument(
+        "--api-addr", default=argparse.SUPPRESS,
+        help="host:port of the HTTP API",
+    )
+    common.add_argument(
+        "--admin-path", default=argparse.SUPPRESS,
+        help="admin unix socket path",
+    )
+    p = argparse.ArgumentParser(
+        prog="corrosion", description=__doc__, parents=[common]
+    )
     sub = p.add_subparsers(dest="command", required=True)
 
-    sub.add_parser("agent", help="run the agent until interrupted")
+    def add(name, **kw):
+        return sub.add_parser(name, parents=[common], **kw)
 
-    q = sub.add_parser("query", help="run a read-only SQL statement")
+    add("agent", help="run the agent until interrupted")
+
+    q = add("query", help="run a read-only SQL statement")
     q.add_argument("sql")
     q.add_argument("--columns", action="store_true")
     q.add_argument("--timer", action="store_true")
 
-    e = sub.add_parser("exec", help="run write statements in a transaction")
+    e = add("exec", help="run write statements in a transaction")
     e.add_argument("sql", nargs="+")
     e.add_argument("--timer", action="store_true")
 
-    b = sub.add_parser("backup", help="snapshot the db (VACUUM INTO + strip)")
+    b = add("backup", help="snapshot the db (VACUUM INTO + strip)")
     b.add_argument("out")
     b.add_argument("--db", required=True)
 
-    r = sub.add_parser("restore", help="swap a backup into place")
+    r = add("restore", help="swap a backup into place")
     r.add_argument("backup")
     r.add_argument("--db", required=True)
     r.add_argument(
@@ -51,26 +68,26 @@ def _build_parser() -> argparse.ArgumentParser:
         "file locks held during the swap)",
     )
 
-    s = sub.add_parser("sync", help="sync protocol utilities")
+    s = add("sync", help="sync protocol utilities")
     s.add_argument("sync_cmd", choices=["generate"])
 
-    lk = sub.add_parser("locks", help="show longest-held lock acquisitions")
+    lk = add("locks", help="show longest-held lock acquisitions")
     lk.add_argument("--top", type=int, default=10)
 
-    cl = sub.add_parser("cluster", help="cluster introspection")
+    cl = add("cluster", help="cluster introspection")
     cl.add_argument("cluster_cmd", choices=["members"])
 
-    rl = sub.add_parser("reload", help="re-apply schema paths from config")
+    add("reload", help="re-apply schema paths from config")
 
-    t = sub.add_parser("template", help="render templates (--watch to follow)")
+    t = add("template", help="render templates (--watch to follow)")
     t.add_argument("files", nargs="+", help="TEMPLATE[:OUTPUT] specs")
     t.add_argument("--watch", action="store_true")
 
-    cs = sub.add_parser("consul", help="consul bridge")
+    cs = add("consul", help="consul bridge")
     cs.add_argument("consul_cmd", choices=["sync"])
 
     # command/tls.rs:1-94: `corrosion tls {ca,server,client} generate`
-    tl = sub.add_parser("tls", help="certificate generation")
+    tl = add("tls", help="certificate generation")
     tl.add_argument("tls_kind", choices=["ca", "server", "client"])
     tl.add_argument("tls_cmd", choices=["generate"])
     tl.add_argument("host", nargs="?", default=None,
@@ -83,10 +100,11 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
-    cfg = Config.load(args.config) if args.config else Config.load()
-    if args.api_addr:
+    config_path = getattr(args, "config", None)
+    cfg = Config.load(config_path) if config_path else Config.load()
+    if getattr(args, "api_addr", None):
         cfg.api.addr = args.api_addr
-    if args.admin_path:
+    if getattr(args, "admin_path", None):
         cfg.admin.uds_path = args.admin_path
     try:
         return asyncio.run(_dispatch(args, cfg)) or 0

@@ -52,6 +52,9 @@ def run(name, builder, **kw):
 
 
 def main() -> None:
+    from corrosion_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
     print(
         f"# platform={jax.devices()[0].platform}", file=sys.stderr, flush=True
     )

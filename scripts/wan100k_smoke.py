@@ -30,6 +30,9 @@ from corrosion_tpu.sim import simulate, visibility_latencies
 
 
 def main() -> None:
+    from corrosion_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
     steady = "--steady" in sys.argv  # no partition: pure propagation p99
     nums = [a for a in sys.argv[1:] if not a.startswith("-")]
     rounds = int(nums[0]) if nums else 16

@@ -187,6 +187,48 @@ def test_agents_propagate_trace_and_count_metrics(tmp_path):
     run(main())
 
 
+def test_wal_checkpoint_loop_truncates_and_times(tmp_path):
+    """db_cleanup parity: the WAL checkpoint loop truncates the WAL on the
+    background write tier and records its duration (agent.rs:1413-1435)."""
+
+    async def main():
+        a = await launch_test_agent(
+            str(tmp_path / "a"), wal_checkpoint_interval=0.2
+        )
+        try:
+            for i in range(20):
+                await a.client.execute(
+                    [["INSERT INTO tests (id, text) VALUES (?, 'w')", [i]]]
+                )
+
+            async def checkpointed():
+                hist = a.agent.metrics.histogram(
+                    "corro_db_wal_truncate_seconds", ""
+                )
+                return hist.count() >= 1
+
+            from corrosion_tpu.agent.testing import poll_until
+
+            await poll_until(checkpointed, timeout=10.0)
+            # The WAL file is empty (truncated) right after a checkpoint
+            # with no concurrent writers.
+            import os
+
+            wal = a.agent.store.path + "-wal"
+            await poll_until(
+                lambda: _a(os.path.getsize(wal) == 0 if os.path.exists(wal)
+                           else True),
+                timeout=10.0,
+            )
+        finally:
+            await a.stop()
+
+    async def _a(v):
+        return v
+
+    run(main())
+
+
 def test_agent_prometheus_endpoint(tmp_path):
     async def main():
         a = await launch_test_agent(
