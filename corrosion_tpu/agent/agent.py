@@ -844,14 +844,20 @@ class Agent:
                     except OSError:
                         before = 0
                     with self.store._wlock("wal_checkpoint"):
-                        self.store.conn.execute(
+                        row = self.store.conn.execute(
                             "PRAGMA wal_checkpoint(TRUNCATE)"
                         ).fetchone()
-                    return before
+                    return before, row
 
-                before = await self.pool.write_low(ckpt)
-                hist.observe(time.monotonic() - t0)
-                bytes_g.set(before)
+                before, row = await self.pool.write_low(ckpt)
+                busy = bool(row and row[0])
+                if not busy:
+                    # Only a real truncation counts — with busy=1 the
+                    # pragma returns without reclaiming anything and the
+                    # metrics would show healthy truncations while the
+                    # WAL grows.
+                    hist.observe(time.monotonic() - t0)
+                    bytes_g.set(before)
             except Exception:
                 logging.getLogger(__name__).debug(
                     "wal checkpoint failed", exc_info=True

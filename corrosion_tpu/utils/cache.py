@@ -12,14 +12,30 @@ from __future__ import annotations
 import os
 
 
-def enable_persistent_cache(path: str | None = None) -> str:
+def enable_persistent_cache(path: str | None = None) -> str | None:
     import jax
 
-    path = path or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-        ".jax_cache",
-    )
-    os.makedirs(path, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    return path
+    candidates = [
+        path,
+        os.environ.get("JAX_COMPILATION_CACHE_DIR"),
+        # Source checkout: keep the cache next to the code (gitignored).
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+            ".jax_cache",
+        ),
+        # Installed package (read-only site-packages): user cache dir.
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "corrosion_tpu", "jax"
+        ),
+    ]
+    for cand in candidates:
+        if not cand:
+            continue
+        try:
+            os.makedirs(cand, exist_ok=True)
+        except OSError:
+            continue
+        jax.config.update("jax_compilation_cache_dir", cand)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        return cand
+    return None  # no writable location: run uncached

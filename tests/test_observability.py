@@ -215,16 +215,16 @@ def test_wal_checkpoint_loop_truncates_and_times(tmp_path):
             import os
 
             wal = a.agent.store.path + "-wal"
-            await poll_until(
-                lambda: _a(os.path.getsize(wal) == 0 if os.path.exists(wal)
-                           else True),
-                timeout=10.0,
-            )
+
+            async def wal_empty():
+                try:
+                    return os.path.getsize(wal) == 0
+                except OSError:
+                    return True  # no WAL file at all
+
+            await poll_until(wal_empty, timeout=10.0)
         finally:
             await a.stop()
-
-    async def _a(v):
-        return v
 
     run(main())
 
