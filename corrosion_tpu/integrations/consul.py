@@ -19,10 +19,12 @@ from corrosion_tpu.client import CorrosionApiClient
 
 SETUP_SQL = """
 CREATE TABLE IF NOT EXISTS __corro_consul_services (
-  id TEXT PRIMARY KEY, hash BLOB NOT NULL
+  node TEXT NOT NULL, id TEXT NOT NULL, hash TEXT NOT NULL,
+  PRIMARY KEY (node, id)
 ) WITHOUT ROWID;
 CREATE TABLE IF NOT EXISTS __corro_consul_checks (
-  id TEXT PRIMARY KEY, hash BLOB NOT NULL
+  node TEXT NOT NULL, id TEXT NOT NULL, hash TEXT NOT NULL,
+  PRIMARY KEY (node, id)
 ) WITHOUT ROWID;
 """
 
@@ -178,30 +180,91 @@ def _dechunk(body: bytes) -> bytes:
     return out
 
 
+async def _setup(
+    client: CorrosionApiClient, node: str
+) -> tuple[dict, dict]:
+    """Create the node-local hash tables and load persisted hashes
+    (sync.rs setup, :119-160). ``__corro_*`` tables are not CRRs, so these
+    writes stay node-local — exactly the reference's split between the
+    replicated consul_* tables and the local bookkeeping. Hashes key by
+    (node, id): a hostname change must re-upsert everything under the new
+    node name, not silently skip it."""
+    stmts = [s for s in SETUP_SQL.split(";") if s.strip()]
+    await client.execute([[s] for s in stmts])
+    known_services: dict[str, bytes] = {}
+    known_checks: dict[str, bytes] = {}
+    from corrosion_tpu.core.values import Statement
+
+    _, rows = await client.query(Statement(
+        "SELECT id, hash FROM __corro_consul_services WHERE node = ?",
+        params=[node],
+    ))
+    for sid, h in rows:
+        known_services[sid] = bytes.fromhex(h)
+    _, rows = await client.query(Statement(
+        "SELECT id, hash FROM __corro_consul_checks WHERE node = ?",
+        params=[node],
+    ))
+    for cid, h in rows:
+        known_checks[cid] = bytes.fromhex(h)
+    return known_services, known_checks
+
+
+def _hash_persist_statements(
+    node: str, old: dict[str, bytes], new: dict[str, bytes], table: str
+) -> list[list]:
+    stmts: list[list] = []
+    for key, h in new.items():
+        if old.get(key) != h:
+            # Hex: blobs don't ride the JSON statement API.
+            stmts.append(
+                [f"INSERT OR REPLACE INTO {table} (node, id, hash)"
+                 " VALUES (?, ?, ?)",
+                 [node, key, h.hex()]]
+            )
+    for key in old:
+        if key not in new:
+            stmts.append(
+                [f"DELETE FROM {table} WHERE node = ? AND id = ?",
+                 [node, key]]
+            )
+    return stmts
+
+
 async def run_consul_sync(cfg: Config, iterations: int | None = None) -> None:
-    """Poll-and-upsert loop (sync.rs run, :20-117)."""
+    """Poll-and-upsert loop (sync.rs run, :20-117). Diff hashes persist in
+    ``__corro_consul_*`` so a bridge restart does not re-upsert the world
+    (and churn every subscription on consul_services)."""
     import socket
 
     node = socket.gethostname()
     consul = ConsulHttp(cfg.consul.address)
     host, port = parse_addr(cfg.api.addr)
     client = CorrosionApiClient(host, port)
-    known_services: dict[str, bytes] = {}
-    known_checks: dict[str, bytes] = {}
+    known = None  # lazily set up: the API may not be listening yet
     i = 0
     while iterations is None or i < iterations:
         i += 1
         try:
+            if known is None:
+                known = await _setup(client, node)
+            known_services, known_checks = known
             services = await consul.agent_services()
             checks = await consul.agent_checks()
             stmts, new_services, new_checks = diff_statements(
                 node, services, checks, known_services, known_checks
             )
+            stmts += _hash_persist_statements(
+                node, known_services, new_services, "__corro_consul_services"
+            )
+            stmts += _hash_persist_statements(
+                node, known_checks, new_checks, "__corro_consul_checks"
+            )
             if stmts:
                 await client.execute(stmts)
             # Adopt the hash state only after the corrosion write succeeded;
             # a failed tick must re-diff (and re-send) next tick.
-            known_services, known_checks = new_services, new_checks
+            known = (new_services, new_checks)
         except Exception:
             pass  # consul/corrosion unreachable or rejected: retry next tick
         await asyncio.sleep(cfg.consul.interval_ms / 1000.0)

@@ -227,6 +227,96 @@ def test_consul_diffing_basic_operations():
     ]
 
 
+def test_consul_bridge_hashes_persist_across_restart(tmp_path):
+    """A restarted bridge must NOT re-upsert unchanged services: the diff
+    hashes persist in the node-local __corro_consul_* tables (the
+    reference's setup + hash tables, consul/sync.rs:119-160). Re-upserts
+    would bump updated_at and churn every subscription on consul_*."""
+    import asyncio
+    import json as _json
+
+    from corrosion_tpu.agent.config import Config
+    from corrosion_tpu.agent.testing import launch_test_agent
+    from corrosion_tpu.integrations.consul import run_consul_sync
+
+    SCHEMA = """
+    CREATE TABLE consul_services (
+      node TEXT NOT NULL, id TEXT NOT NULL, name TEXT NOT NULL DEFAULT '',
+      tags TEXT NOT NULL DEFAULT '[]', meta TEXT NOT NULL DEFAULT '{}',
+      port INTEGER NOT NULL DEFAULT 0, address TEXT NOT NULL DEFAULT '',
+      updated_at INTEGER NOT NULL DEFAULT 0,
+      PRIMARY KEY (node, id)
+    );
+    CREATE TABLE consul_checks (
+      node TEXT NOT NULL, id TEXT NOT NULL,
+      service_id TEXT NOT NULL DEFAULT '',
+      service_name TEXT NOT NULL DEFAULT '', name TEXT NOT NULL DEFAULT '',
+      status TEXT NOT NULL DEFAULT '', output TEXT NOT NULL DEFAULT '',
+      updated_at INTEGER NOT NULL DEFAULT 0,
+      PRIMARY KEY (node, id)
+    );
+    """
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"), schema=SCHEMA)
+
+        # Fake Consul agent: fixed services/checks.
+        async def on_conn(reader, writer):
+            req = await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b""):
+                pass
+            if b"/v1/agent/services" in req:
+                body = _json.dumps(
+                    {"web-1": {"ID": "web-1", "Service": "web",
+                               "Tags": ["a"], "Port": 80,
+                               "Address": "10.0.0.1"}}
+                ).encode()
+            else:
+                body = _json.dumps(
+                    {"web-1-http": {"CheckID": "web-1-http",
+                                    "ServiceID": "web-1",
+                                    "Status": "passing", "Output": "ok"}}
+                ).encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\ncontent-length: %d\r\n\r\n%s"
+                % (len(body), body)
+            )
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        consul_port = server.sockets[0].getsockname()[1]
+        try:
+            cfg = Config()
+            cfg.api.addr = "%s:%d" % a.agent.api_addr
+            cfg.consul.address = f"127.0.0.1:{consul_port}"
+            cfg.consul.interval_ms = 10
+
+            await run_consul_sync(cfg, iterations=2)
+            _, rows = await a.client.query(
+                "SELECT id, updated_at FROM consul_services"
+            )
+            assert [r[0] for r in rows] == ["web-1"]
+            first_seen = rows[0][1]
+            head0 = a.agent.bookie.for_actor(a.agent.actor_id).last()
+
+            # "Restart": a fresh bridge run with empty in-memory state must
+            # find the persisted hashes and write NOTHING.
+            await asyncio.sleep(1.1)  # updated_at has 1 s granularity
+            await run_consul_sync(cfg, iterations=2)
+            _, rows = await a.client.query(
+                "SELECT updated_at FROM consul_services"
+            )
+            assert rows[0][0] == first_seen, "no re-upsert after restart"
+            head1 = a.agent.bookie.for_actor(a.agent.actor_id).last()
+            assert head1 == head0, "no replicated writes at all"
+        finally:
+            server.close()
+            await a.stop()
+
+    run(main())
+
+
 def test_resolve_bootstrap_dns_syntax(monkeypatch):
     import socket
 
