@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import sqlite3
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -56,6 +57,13 @@ class SplitPool:
         self._conn_gen: dict[sqlite3.Connection, int] = {}
         self._current: _Job | None = None  # job the writer is executing
         self._closed = False
+        # Dedicated single writer thread (not asyncio.to_thread): close()
+        # must be able to WAIT for an in-flight job — cancelling the
+        # awaiting task leaves the thread running, and closing the store's
+        # connection under a mid-transaction job segfaults in sqlite3.
+        self._writer_exec = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="splitpool-writer"
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -80,6 +88,10 @@ class SplitPool:
         while (job := self._pop()) is not None:
             if not job.future.done():
                 job.future.set_exception(RuntimeError("pool closed"))
+        # Drain the writer THREAD: an in-flight job keeps executing after
+        # its awaiting task is cancelled, and the store connection must
+        # not be closed under it.
+        await asyncio.to_thread(self._writer_exec.shutdown, True)
         with self._read_lock:
             for c in self._read_pool:
                 c.close()
@@ -115,7 +127,9 @@ class SplitPool:
                 continue
             self._current = job
             try:
-                result = await asyncio.to_thread(job.fn)
+                result = await asyncio.get_running_loop().run_in_executor(
+                    self._writer_exec, job.fn
+                )
             except asyncio.CancelledError:
                 # close() cancelled us mid-job: fail the caller before the
                 # cancellation unwinds, or it would await forever.

@@ -745,10 +745,19 @@ class Store:
             return False  # unknown table (schema lag): drop, sync re-serves
         rows_t = _q(ch.table + "__crdt_rows")
         clock_t = _q(ch.table + "__crdt_clock")
+        # One point read for both the causal length and the cell's clock
+        # (the apply path runs per change; two separate SELECTs measurably
+        # dominated the receiver side of the host bench). The joined
+        # col_version is only valid in the same-epoch branch — the
+        # adoption branch wipes the clock table first.
         row = c.execute(
-            f"SELECT cl FROM {rows_t} WHERE pk = ?", (ch.pk,)
+            f"SELECT r.cl, cc.col_version FROM {rows_t} r"
+            f" LEFT JOIN {clock_t} cc ON cc.pk = r.pk AND cc.cid = ?"
+            " WHERE r.pk = ?",
+            (ch.cid, ch.pk),
         ).fetchone()
         local_cl = row[0] if row else 0
+        local_cv_joined = row[1] if row else None
 
         if ch.cl < local_cl:
             return False  # stale causal epoch
@@ -790,12 +799,11 @@ class Store:
         if ch.cid not in info.data_cols:
             return False  # column we don't know (additive schema lag)
 
-        prev = c.execute(
-            f"SELECT col_version FROM {clock_t} WHERE pk = ? AND cid = ?",
-            (ch.pk, ch.cid),
-        ).fetchone()
-        if prev is not None:
-            local_cv = prev[0]
+        if ch.cl > local_cl:
+            # Epoch adoption wiped the clock above: no LWW compare.
+            local_cv_joined = None
+        if local_cv_joined is not None:
+            local_cv = local_cv_joined
             if ch.col_version < local_cv:
                 return False
             if ch.col_version == local_cv:

@@ -191,6 +191,19 @@ async def _setup(
     node name, not silently skip it."""
     stmts = [s for s in SETUP_SQL.split(";") if s.strip()]
     await client.execute([[s] for s in stmts])
+    # Hash tables are pure caches: a layout mismatch (e.g. the pre-node
+    # id-keyed schema — CREATE IF NOT EXISTS cannot migrate it) is fixed
+    # by dropping and recreating; worst case one full re-upsert.
+    try:
+        await client.query(
+            "SELECT node FROM __corro_consul_services LIMIT 0"
+        )
+    except Exception:
+        await client.execute(
+            [["DROP TABLE IF EXISTS __corro_consul_services"],
+             ["DROP TABLE IF EXISTS __corro_consul_checks"]]
+            + [[s] for s in stmts]
+        )
     known_services: dict[str, bytes] = {}
     known_checks: dict[str, bytes] = {}
     from corrosion_tpu.core.values import Statement
@@ -266,5 +279,12 @@ async def run_consul_sync(cfg: Config, iterations: int | None = None) -> None:
             # a failed tick must re-diff (and re-send) next tick.
             known = (new_services, new_checks)
         except Exception:
-            pass  # consul/corrosion unreachable or rejected: retry next tick
+            # Unreachable consul/corrosion or a rejected write: retry next
+            # tick — but leave a trail, or a permanently failing setup
+            # looks identical to a healthy idle bridge.
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "consul sync tick failed", exc_info=True
+            )
         await asyncio.sleep(cfg.consul.interval_ms / 1000.0)

@@ -85,6 +85,6 @@ async def main(n_changes: int, batch: int) -> None:
 
 
 if __name__ == "__main__":
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 200
     asyncio.run(main(n, batch))

@@ -115,6 +115,47 @@ def _raise():
     raise RuntimeError("boom")
 
 
+def test_pool_close_waits_for_inflight_writer(tmp_path):
+    """Shutdown race regression: close() must drain the writer THREAD —
+    cancelling the awaiting task leaves the job running, and closing the
+    store connection under a mid-transaction job segfaults in sqlite3
+    (observed as a flaky teardown crash in the host bench)."""
+    import time
+
+    async def main():
+        store = Store(str(tmp_path / "s.db"), os.urandom(16))
+        store.apply_schema(
+            "CREATE TABLE t (id INTEGER NOT NULL PRIMARY KEY, v INTEGER)"
+        )
+        pool = SplitPool(store)
+        pool.start()
+        import threading
+
+        started = threading.Event()
+        state = {"done": False}
+
+        def slow_job():
+            started.set()
+            time.sleep(0.3)
+            # Touch the connection late: if close() freed it, this is the
+            # use-after-free the old code hit.
+            store.conn.execute("SELECT count(*) FROM t").fetchone()
+            state["done"] = True
+
+        fut = asyncio.ensure_future(pool.write(slow_job))
+        # Deterministic: wait until the job is RUNNING on the writer
+        # thread (a fixed sleep can miss on a loaded machine).
+        await asyncio.to_thread(started.wait, 5.0)
+        await pool.close()
+        assert state["done"], "close returned before the in-flight job"
+        # The caller's future was failed, not left hanging.
+        with pytest.raises(RuntimeError):
+            await fut
+        store.close()
+
+    run(main())
+
+
 def test_online_restore_same_inode(tmp_path):
     # Build a source DB, back it up, then restore it into a LIVE store.
     src = Store(str(tmp_path / "src.db"), b"\x04" * 16)
