@@ -109,6 +109,10 @@ class SplitPool:
         loop = asyncio.get_running_loop()
         job = _Job(fn=fn, future=loop.create_future())
         await self._queues[priority].put(job)  # bounded: backpressure
+        if self._closed and not job.future.done():
+            # close() drained the queues while we were blocked in put():
+            # nothing will ever run this job — fail it, don't hang.
+            job.future.set_exception(RuntimeError("pool closed"))
         self._kick.set()
         return await job.future
 

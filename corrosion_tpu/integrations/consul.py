@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import logging
 
 from corrosion_tpu.agent.config import Config, parse_addr
 from corrosion_tpu.client import CorrosionApiClient
@@ -198,6 +199,9 @@ async def _setup(
         await client.query(
             "SELECT node FROM __corro_consul_services LIMIT 0"
         )
+        await client.query(
+            "SELECT node FROM __corro_consul_checks LIMIT 0"
+        )
     except Exception:
         await client.execute(
             [["DROP TABLE IF EXISTS __corro_consul_services"],
@@ -255,6 +259,7 @@ async def run_consul_sync(cfg: Config, iterations: int | None = None) -> None:
     host, port = parse_addr(cfg.api.addr)
     client = CorrosionApiClient(host, port)
     known = None  # lazily set up: the API may not be listening yet
+    warned = False
     i = 0
     while iterations is None or i < iterations:
         i += 1
@@ -280,11 +285,15 @@ async def run_consul_sync(cfg: Config, iterations: int | None = None) -> None:
             known = (new_services, new_checks)
         except Exception:
             # Unreachable consul/corrosion or a rejected write: retry next
-            # tick — but leave a trail, or a permanently failing setup
-            # looks identical to a healthy idle bridge.
-            import logging
-
-            logging.getLogger(__name__).debug(
-                "consul sync tick failed", exc_info=True
-            )
+            # tick — but leave a VISIBLE trail (warning on the first
+            # failure, debug on repeats), or a permanently failing bridge
+            # looks identical to a healthy idle one.
+            log = logging.getLogger(__name__)
+            if not warned:
+                warned = True
+                log.warning("consul sync tick failed", exc_info=True)
+            else:
+                log.debug("consul sync tick failed", exc_info=True)
+        else:
+            warned = False
         await asyncio.sleep(cfg.consul.interval_ms / 1000.0)

@@ -145,7 +145,7 @@ def test_pool_close_waits_for_inflight_writer(tmp_path):
         fut = asyncio.ensure_future(pool.write(slow_job))
         # Deterministic: wait until the job is RUNNING on the writer
         # thread (a fixed sleep can miss on a loaded machine).
-        await asyncio.to_thread(started.wait, 5.0)
+        assert await asyncio.to_thread(started.wait, 5.0), "job never started"
         await pool.close()
         assert state["done"], "close returned before the in-flight job"
         # The caller's future was failed, not left hanging.
