@@ -1,0 +1,177 @@
+"""One-hot row reductions: the data plane's scatter/gather replacement.
+
+Two primitives used throughout the gossip kernels (see ops/gossip.py):
+
+- ``rowmax(idx, val, mask, width)``:  out[r, x] = max over m with
+  idx[r, m] == x of val[r, m]   (a row-local scatter-max)
+- ``rowgather(table, idx)``:          out[r, m] = table[r, idx[r, m]]
+  (a row-local take_along_axis)
+
+Why not scatter/gather? TPU scatters serialize per element (~70M elem/s
+measured on v5e — 207 ms for a [100k, 144] scatter into [100k, 512]) and
+dynamic gathers lower similarly badly (269 ms). Why not a plain jnp
+one-hot broadcast? In context XLA materializes the [R, M, W] compare /
+select intermediates to HBM when they have multiple consumers — measured
+331 GB of HBM traffic per broadcast round at 100k nodes, ~0.5 s of pure
+bandwidth.
+
+The Pallas kernels below block rows into VMEM tiles and loop over the
+small axis, so the [tile, W] accumulator lives in registers/VMEM and HBM
+traffic is exactly inputs + outputs (a few hundred MB per round). The jnp
+fallback (CPU tests, small shapes, non-TPU backends) is the same math.
+
+Reference anchor: these implement the batched merge/delivery promotions of
+corro-agent's broadcast plane (broadcast/mod.rs:356-567) and the CRDT
+scatter-merge (crsql `INSERT INTO crsql_changes` replay, agent.rs:2192-2214)
+at simulator scale.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Rows per grid program (amortizes DMA latency) and per inner sub-tile
+# (bounds the [sub, M, W] register/VMEM temporary to ~2.4 MB at M=144,
+# W=512).
+_BLOCK_ROWS = 256
+_SUB_ROWS = 8
+# Below this many one-hot lanes (rows·M·width) the jnp broadcast form stays
+# in cache/fusion range and beats a kernel launch.
+_PALLAS_MIN_LANES = 1 << 27
+
+
+def _block_rows(m: int, width: int) -> int:
+    return _BLOCK_ROWS
+
+
+def _use_pallas(lanes: int) -> bool:
+    # Off by default: measured on v5e at wan_100k shapes, the fused jnp
+    # broadcast form beat these kernels (567 vs 651 ms broadcast plane) —
+    # XLA's materialized one-hot intermediates still stream at near-HBM
+    # bandwidth while the VMEM-tiled kernels are VPU-throughput-bound.
+    # CORRO_ONEHOT_PALLAS=1 re-enables for experiments.
+    import os
+
+    if os.environ.get("CORRO_ONEHOT_PALLAS", "0") != "1":
+        return False
+    return jax.default_backend() == "tpu" and lanes >= _PALLAS_MIN_LANES
+
+
+def _pad_rows(x: jax.Array, rows_p: int):
+    r = x.shape[0]
+    if rows_p == r:
+        return x
+    pad = [(0, rows_p - r)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad)
+
+
+# -- rowmax -------------------------------------------------------------------
+
+
+def _flip(u32val: jax.Array) -> jax.Array:
+    """u32 → i32 preserving order (Mosaic can't reduce unsigned ints);
+    u32 0 maps to i32 min, so the 'no entry' floor survives the trip."""
+    return (u32val ^ jnp.uint32(1 << 31)).astype(jnp.int32)
+
+
+def _unflip(i32val: jax.Array) -> jax.Array:
+    return i32val.astype(jnp.uint32) ^ jnp.uint32(1 << 31)
+
+
+def _rowmax_kernel(idx_ref, val_ref, out_ref):
+    # Big row blocks amortize per-program DMA latency; the inner loop
+    # walks 8-row sub-tiles (sublane-aligned dynamic slices are legal)
+    # whose [8, M, W] one-hot temporaries live in registers/VMEM. Nothing
+    # reaches HBM but the inputs and the [bn, W] result.
+    bn, m = idx_ref.shape
+    w = out_ref.shape[1]
+    ids = jax.lax.broadcasted_iota(jnp.int32, (_SUB_ROWS, m, w), 2)
+
+    def body(t, _):
+        r0 = t * _SUB_ROWS
+        hit = idx_ref[pl.ds(r0, _SUB_ROWS), :][:, :, None] == ids
+        vi = _flip(val_ref[pl.ds(r0, _SUB_ROWS), :])[:, :, None]
+        out_ref[pl.ds(r0, _SUB_ROWS), :] = _unflip(
+            jnp.max(jnp.where(hit, vi, jnp.int32(-(2**31))), axis=1)
+        )
+        return 0
+
+    jax.lax.fori_loop(0, bn // _SUB_ROWS, body, 0)
+
+
+def rowmax(
+    idx: jax.Array,  # i32[R, M] column index per entry (any value ok if masked)
+    val: jax.Array,  # u32[R, M]
+    mask: jax.Array | None,  # bool[R, M] live entries (None = all)
+    width: int,
+) -> jax.Array:
+    """out[r, x] = max over masked m with idx[r, m] == x of val[r, m], 0
+    when none. Masked/out-of-range entries contribute nothing."""
+    r, m = idx.shape
+    val = val.astype(jnp.uint32)
+    if mask is not None:
+        idx = jnp.where(mask, idx, -1)
+        val = jnp.where(mask, val, 0)
+    if not _use_pallas(r * m * width):
+        ids = jnp.arange(width, dtype=idx.dtype)
+        hit = idx[:, :, None] == ids[None, None, :]
+        return jnp.max(jnp.where(hit, val[:, :, None], 0), axis=1)
+    bn = _block_rows(m, width)
+    rows_p = -(-r // bn) * bn
+    out = pl.pallas_call(
+        _rowmax_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_p, width), jnp.uint32),
+        grid=(rows_p // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, width), lambda i: (i, 0)),
+    )(_pad_rows(idx.astype(jnp.int32), rows_p), _pad_rows(val, rows_p))
+    return out[:r]
+
+
+# -- rowgather ----------------------------------------------------------------
+
+
+def _rowgather_kernel(table_ref, idx_ref, out_ref):
+    bn, w = table_ref.shape
+    m = idx_ref.shape[1]
+    ids = jax.lax.broadcasted_iota(jnp.int32, (_SUB_ROWS, m, w), 2)
+
+    def body(t, _):
+        r0 = t * _SUB_ROWS
+        hit = idx_ref[pl.ds(r0, _SUB_ROWS), :][:, :, None] == ids
+        ti = _flip(table_ref[pl.ds(r0, _SUB_ROWS), :])[:, None, :]
+        out_ref[pl.ds(r0, _SUB_ROWS), :] = _unflip(
+            jnp.max(jnp.where(hit, ti, jnp.int32(-(2**31))), axis=2)
+        )
+        return 0
+
+    jax.lax.fori_loop(0, bn // _SUB_ROWS, body, 0)
+
+
+def rowgather(table: jax.Array, idx: jax.Array) -> jax.Array:
+    """out[r, m] = table[r, idx[r, m]] (idx must be in range; u32 table)."""
+    r, width = table.shape
+    m = idx.shape[1]
+    table = table.astype(jnp.uint32)
+    if not _use_pallas(r * m * width):
+        ids = jnp.arange(width, dtype=idx.dtype)
+        hit = idx[:, :, None] == ids[None, None, :]
+        return jnp.max(jnp.where(hit, table[:, None, :], 0), axis=2)
+    bn = _block_rows(m, width)
+    rows_p = -(-r // bn) * bn
+    out = pl.pallas_call(
+        _rowgather_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_p, m), jnp.uint32),
+        grid=(rows_p // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, width), lambda i: (i, 0)),
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, m), lambda i: (i, 0)),
+    )(_pad_rows(table, rows_p), _pad_rows(idx.astype(jnp.int32), rows_p))
+    return out[:r]

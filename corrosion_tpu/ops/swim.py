@@ -73,6 +73,12 @@ class SwimConfig:
     # sparse kernel: gossiped view-merge messages absorbed per node per
     # round (0 = gossip_fanout * backlog, the expected arrival rate).
     view_intake: int = 0
+    # Down-member GC horizon in rounds (foca remove_down_after, 48 h WAN
+    # preset, broadcast/mod.rs:704-713): each round a DOWN belief is
+    # forgotten with probability 1/down_gc_rounds (geometric lifetime with
+    # that mean — stateless ageing; no per-belief timestamp array). 0 =
+    # never forget. Frees sparse-table capacity in long-churn runs.
+    down_gc_rounds: int = 0
 
 
 def impl(cfg: SwimConfig):
@@ -272,6 +278,14 @@ def swim_round(state: SwimState, rng: jax.Array, round_idx: jax.Array,
     keep, (upd_target, upd_packed, upd_tx2) = routing.rebuild_bounded_queue(
         co, cx, (ct, cp, cx), cfg.backlog)
     upd_target = jnp.where(keep, upd_target, -1)
+
+    # ---- 6. down-member GC (remove_down_after) -----------------------------
+    if cfg.down_gc_rounds > 0:
+        k_gc = jax.random.fold_in(k_goss, 7)
+        drop = (packed_sev(view) == SEV_DOWN) & (
+            jax.random.uniform(k_gc, view.shape) < 1.0 / cfg.down_gc_rounds
+        )
+        view = jnp.where(drop, 0, view)
 
     return SwimState(
         view=view,

@@ -123,8 +123,7 @@ def _merge_one(
     merge strictly raised the row's belief about the target — the dense
     kernel's ``packed > view[row, tgt]`` change test.
     """
-    n, _k = exc_tgt.shape
-    rows = jnp.arange(n)
+    n, k = exc_tgt.shape
     old = _lookup(exc_tgt, exc_pkd, tgt)
     raised = valid & (pkd > old)
 
@@ -134,18 +133,19 @@ def _merge_one(
 
     # Insert path: no existing slot for this target. Choose the slot with the
     # lowest keep-priority (empty slots first), evict only if strictly lower
-    # priority than the incoming entry.
+    # priority than the incoming entry. Dense one-hot select, not a scatter:
+    # each row writes exactly one slot, and [N, K] selects are pure VPU work
+    # while TPU scatters serialize per element.
     ins = raised & ~any_hit & (pkd > 0)
     score = jnp.where(exc_tgt < 0, jnp.int32(-1), _evict_score(exc_pkd))
     slot = jnp.argmin(score, axis=1)
-    slot_score = score[rows, slot]
+    slot_score = jnp.min(score, axis=1)
     ok = ins & (slot_score < _evict_score(pkd))
-    exc_tgt = exc_tgt.at[rows, slot].set(
-        jnp.where(ok, tgt, exc_tgt[rows, slot])
-    )
-    exc_pkd = exc_pkd.at[rows, slot].set(
-        jnp.where(ok, pkd, exc_pkd[rows, slot])
-    )
+    sl = (
+        jax.lax.broadcasted_iota(jnp.int32, (n, k), 1) == slot[:, None]
+    ) & ok[:, None]
+    exc_tgt = jnp.where(sl, tgt[:, None], exc_tgt)
+    exc_pkd = jnp.where(sl, pkd[:, None], exc_pkd)
     # A raise that found no slot (table full of higher-priority entries) is
     # dropped — report it as not-raised so it is not re-gossiped as applied.
     raised = raised & (any_hit | ~ins | ok)
@@ -251,32 +251,42 @@ def swim_round(
     cand_ok.append(fired)
     susp_target = jnp.where(expired, -1, susp_target)
 
-    # ---- 3. gossip dissemination (bounded piggyback) -----------------------
+    # ---- 3. gossip dissemination (bounded piggyback, pull model) -----------
+    # Receiver-centric like the broadcast plane (ops/gossip.py): each node
+    # pulls G random sources' backlogs, so intake is a row-local [N, G·U]
+    # selection instead of a global multi-million-element sort + scatter
+    # (bounded_intake on N·G·U = 4.8M entries was the SWIM plane's dominant
+    # cost at 100k: ~3 serialized scatters of the full message set).
+    # Epidemically equivalent: in-degree becomes exactly G instead of
+    # Binomial(N·G, 1/N).
     sendable = (state.upd_target >= 0) & (state.upd_tx > 0) & alive[:, None]
-    g_tgts = jax.random.randint(k_goss, (n, cfg.gossip_fanout), 0, n)
-    recv = jnp.repeat(g_tgts[:, :, None], cfg.backlog, axis=2)  # [N, G, U]
-    tgt = jnp.broadcast_to(state.upd_target[:, None, :], recv.shape)
-    pkd = jnp.broadcast_to(state.upd_packed[:, None, :], recv.shape)
-    ok = (
-        jnp.broadcast_to(sendable[:, None, :], recv.shape)
-        & (recv != jnp.arange(n)[:, None, None])  # not to self
-        & alive[recv]  # dead receivers drop datagrams
+    src = jax.random.randint(k_goss, (n, cfg.gossip_fanout), 0, n)
+    m_tgt = state.upd_target[src].reshape(n, -1)  # [N, G·U]
+    m_pkd = state.upd_packed[src].reshape(n, -1)
+    m_ok = (
+        sendable[src].reshape(n, -1)
+        & (src != nodes[:, None])[:, :, None].repeat(
+            cfg.backlog, axis=2
+        ).reshape(n, -1)
+        & alive[src][:, :, None].repeat(cfg.backlog, axis=2).reshape(n, -1)
+        & alive[:, None]  # dead receivers drop datagrams
     )
     upd_tx = jnp.where(sendable, state.upd_tx - 1, state.upd_tx)
 
     # Bounded receiver intake (the cap is the sparse kernel's datagram-drop
-    # deviation; see module docstring), then a sequential merge scan that
-    # doubles as the per-message change test.
+    # deviation; see module docstring): severity-first keep priority (the
+    # entries that must survive an overloaded inbox), then a sequential
+    # merge scan that doubles as the per-message change test.
     r_view = cfg.view_intake if cfg.view_intake > 0 else (
         cfg.gossip_fanout * cfg.backlog
     )
-    in_mask, (in_tgt, in_pkd) = routing.bounded_intake(
-        recv.reshape(-1),
-        ok.reshape(-1),
-        (jnp.maximum(tgt, 0).reshape(-1), pkd.reshape(-1)),
-        n,
+    in_mask, (in_tgt, in_pkd) = routing.rebuild_bounded_queue(
+        m_ok & (m_tgt >= 0),
+        _evict_score(m_pkd),
+        (m_tgt, m_pkd),
         r_view,
     )
+    in_tgt = jnp.maximum(in_tgt, 0)
     exc_tgt, exc_pkd, raised = _merge_scan(
         exc_tgt, exc_pkd, in_tgt, in_pkd, in_mask
     )
@@ -321,6 +331,19 @@ def swim_round(
         co, cx, (ct, cp, cx), cfg.backlog
     )
     upd_target = jnp.where(keep, upd_target, -1)
+
+    # ---- 6. down-member GC (remove_down_after, stateless ageing) -----------
+    # A DOWN exception is forgotten with probability 1/down_gc_rounds per
+    # round (geometric lifetime, mean = the horizon): dead nodes stop
+    # occupying severity-first-protected table slots forever, without a
+    # per-slot timestamp array.
+    if cfg.down_gc_rounds > 0:
+        k_gc = jax.random.fold_in(k_goss, 7)
+        drop = (packed_sev(exc_pkd) == SEV_DOWN) & (
+            jax.random.uniform(k_gc, exc_pkd.shape) < 1.0 / cfg.down_gc_rounds
+        )
+        exc_tgt = jnp.where(drop, -1, exc_tgt)
+        exc_pkd = jnp.where(drop, 0, exc_pkd)
 
     return SparseSwimState(
         exc_tgt=exc_tgt,

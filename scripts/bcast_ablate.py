@@ -1,0 +1,62 @@
+"""Ablate broadcast_round features at N to locate residual step cost."""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import dataclasses
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu import models
+from corrosion_tpu.ops import gossip as gossip_ops
+
+
+def timed(label, fn):
+    out = fn()
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t1 = time.perf_counter()
+    for _ in range(3):
+        out = fn()
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t2 = time.perf_counter()
+    print(f"[{label}] step={(t2 - t1) / 3 * 1000:.0f}ms", flush=True)
+
+
+def main() -> None:
+    from corrosion_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    cfg, topo, sched = models.wan_100k(n=n, rounds=4, samples=16)
+    key = jax.random.PRNGKey(0)
+    alive = jnp.ones(cfg.n_nodes, bool)
+    n_regions = int(np.asarray(topo.region).max()) + 1
+    part = jnp.zeros((n_regions, n_regions), bool)
+    writes = jnp.asarray(sched.writes[0], jnp.uint32)
+    print(f"platform={jax.devices()[0].platform} n={n}", flush=True)
+
+    variants = {
+        "full": cfg.gossip,
+        "no_cells": dataclasses.replace(cfg.gossip, n_cells=0),
+        "no_loss_rng": dataclasses.replace(cfg.gossip, loss_prob=0.0),
+        "queue16": dataclasses.replace(cfg.gossip, queue=16),
+        "no_intake": dataclasses.replace(cfg.gossip, rebroadcast_intake=6),
+    }
+    for label, g in variants.items():
+        data = gossip_ops.init_data(g)
+        timed(
+            label,
+            lambda g=g, data=data: gossip_ops.broadcast_round(
+                data, topo, alive, part, writes, key, g
+            ),
+        )
+
+
+if __name__ == "__main__":
+    main()

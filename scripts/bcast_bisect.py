@@ -1,0 +1,176 @@
+"""Micro-bisection of the broadcast-plane sub-ops at N (TPU timing).
+
+Times each structural piece of ops/gossip.broadcast_round in isolation at
+wan_100k-like shapes so optimization effort lands where the time is:
+source-queue gather, base gather, 1-key vs 3-key delivery sort, watermark
+scatters, CRDT merge scatter, intake/queue rebuilds.
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.ops import crdt, routing
+
+
+def timed(label, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t1 = time.perf_counter()
+    for _ in range(3):
+        out = f(*args)
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t2 = time.perf_counter()
+    print(f"[{label}] step={(t2 - t1) / 3 * 1000:.0f}ms", flush=True)
+
+
+def main() -> None:
+    from corrosion_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    w_count, q_cap, f, n_cells, k_in = 512, 48, 3, 256, 26
+    kk = f * q_cap
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    print(f"platform={jax.devices()[0].platform} n={n} kk={kk}", flush=True)
+
+    src = jax.random.randint(k1, (n, f), 0, n)
+    q_writer = jax.random.randint(k2, (n, q_cap), -1, w_count).astype(jnp.int32)
+    q_ver = jax.random.randint(k3, (n, q_cap), 1, 100).astype(jnp.uint32)
+    q_tx = jax.random.randint(k4, (n, q_cap), 0, 6).astype(jnp.int32)
+    contig = jnp.zeros((n, w_count), jnp.uint32)
+    m_w = jax.random.randint(k1, (n, kk), 0, w_count).astype(jnp.int32)
+    m_v = jax.random.randint(k2, (n, kk), 1, 100).astype(jnp.uint32)
+    m_tx = jax.random.randint(k3, (n, kk), 0, 6).astype(jnp.int32)
+    m_ok = jax.random.uniform(k4, (n, kk)) < 0.5
+    pkd = jnp.where(
+        m_ok, m_w.astype(jnp.uint32) * (kk + 2) + (m_v % (kk + 1) + 1),
+        jnp.uint32(w_count * (kk + 2)),
+    )
+    nodes = jnp.arange(n)
+
+    timed("gather_src_queues", lambda s: (q_writer[s], q_ver[s], q_tx[s]), src)
+    timed(
+        "gather_base",
+        lambda c, w: jnp.take_along_axis(c, jnp.maximum(w, 0), axis=1),
+        contig, m_w,
+    )
+    timed(
+        "sort3",
+        lambda a, b, c: jax.lax.sort(
+            (a, b, c), dimension=1, num_keys=3, is_stable=False
+        ),
+        jnp.where(m_ok, m_w, w_count), m_v, -m_tx,
+    )
+    timed("sort1", lambda a: jax.lax.sort(a, dimension=1, is_stable=False), pkd)
+    rw = nodes[:, None] * w_count + m_w
+    timed(
+        "scatter_contig",
+        lambda c, idx, v: c.reshape(-1).at[idx.reshape(-1)].max(v.reshape(-1)).reshape(n, w_count),
+        contig, rw, m_v,
+    )
+
+    def crdt_merge(cells, w, v, mask):
+        k, cl, cv, vr = crdt.derive_change(
+            w.reshape(-1).astype(jnp.uint32), v.reshape(-1), jnp.uint32(0),
+            n_cells,
+        )
+        flat = jnp.where(mask.reshape(-1), nodes.repeat(kk) * n_cells + k, 0)
+        return crdt.apply_changes(
+            cells,
+            crdt.ChangeBatch(key=flat, cl=cl, col_version=cv, value_rank=vr,
+                             mask=mask.reshape(-1)),
+        )
+
+    cells = crdt.make_cells(n * n_cells)
+    timed("crdt_scatter", crdt_merge, cells, m_w, m_v, m_ok)
+    timed(
+        "intake_rebuild",
+        lambda ok, v, w: routing.rebuild_bounded_queue(
+            ok, -v.astype(jnp.int32), (w, v), k_in
+        ),
+        m_ok, m_v, m_w,
+    )
+    cand = q_cap + 4 + k_in
+    cw = jax.random.randint(k1, (n, cand), -1, w_count).astype(jnp.int32)
+    cv_ = jax.random.randint(k2, (n, cand), 1, 100).astype(jnp.uint32)
+    ct = jax.random.randint(k3, (n, cand), 0, 6).astype(jnp.int32)
+    timed(
+        "queue_rebuild",
+        lambda w, v, t: routing.rebuild_bounded_queue(
+            (w >= 0) & (t > 0), t, (w, v, t), q_cap
+        ),
+        cw, cv_, ct,
+    )
+    timed(
+        "seg_prefix",
+        lambda fl, ss: routing.segmented_prefix_and_rows(fl, ss),
+        m_ok, jnp.concatenate(
+            [jnp.ones((n, 1), bool), m_w[:, 1:] != m_w[:, :-1]], axis=1
+        ),
+    )
+
+
+if __name__ == "__main__" and (len(sys.argv) <= 2 or sys.argv[2] != "onehot"):
+    main()
+
+
+def onehot_bench() -> None:
+    """Dense one-hot reductions vs sparse gather/scatter at wan_100k shapes:
+    scatters serialize per element on TPU (~70M elem/s measured); a dense
+    compare+max-reduce over the writer axis is pure VPU work."""
+    from corrosion_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    w_count, q_cap, f, n_cells = 512, 48, 3, 256
+    kk = f * q_cap
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    print(f"platform={jax.devices()[0].platform} n={n} kk={kk}", flush=True)
+    contig = jnp.zeros((n, w_count), jnp.uint32)
+    m_w = jax.random.randint(k1, (n, kk), 0, w_count).astype(jnp.int32)
+    m_v = jax.random.randint(k2, (n, kk), 1, 100).astype(jnp.uint32)
+    m_ok = jax.random.uniform(k4, (n, kk)) < 0.5
+
+    def onehot_scatter_max(c, w, v, ok):
+        # c[n, x] = max(c[n, x], max_k where(w[n,k]==x & ok, v, 0))
+        wids = jnp.arange(w_count, dtype=jnp.int32)
+        hit = (w[:, :, None] == wids[None, None, :]) & ok[:, :, None]
+        return jnp.maximum(c, jnp.max(jnp.where(hit, v[:, :, None], 0), axis=1))
+
+    timed("onehot_scatter_contig", onehot_scatter_max, contig, m_w, m_v, m_ok)
+
+    def onehot_gather(c, w):
+        wids = jnp.arange(w_count, dtype=jnp.int32)
+        hit = w[:, :, None] == wids[None, None, :]
+        return jnp.max(jnp.where(hit, c[:, None, :], 0), axis=2)
+
+    timed("onehot_gather_base", onehot_gather, contig, m_w)
+
+    # CRDT pass over 256 hashed cell keys.
+    cellsN = jnp.zeros((n, n_cells), jnp.uint32)
+    ckey = jax.random.randint(k3, (n, kk), 0, n_cells).astype(jnp.int32)
+    pkd_in = jax.random.randint(k2, (n, kk), 1, 1 << 25).astype(jnp.uint32)
+
+    def onehot_crdt(cells, ck, pk, ok):
+        cids = jnp.arange(n_cells, dtype=jnp.int32)
+        hit = (ck[:, :, None] == cids[None, None, :]) & ok[:, :, None]
+        return jnp.maximum(
+            cells, jnp.max(jnp.where(hit, pk[:, :, None], 0), axis=1)
+        )
+
+    timed("onehot_crdt_pass", onehot_crdt, cellsN, ckey, pkd_in, m_ok)
+
+
+if __name__ == "__main__" and len(sys.argv) > 2 and sys.argv[2] == "onehot":
+    onehot_bench()

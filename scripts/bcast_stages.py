@@ -1,0 +1,141 @@
+"""Cumulative stage timing of the fast-path delivery at N (TPU).
+
+Rebuilds broadcast_round's delta-packed one-hot delivery stage by stage on
+realistic state so per-stage cost = difference of consecutive cumulative
+times (isolated micro-benches mismeasured: in-context fusion differs).
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.ops import crdt, routing
+from corrosion_tpu.ops.gossip import _onehot_rowgather
+
+
+def timed(label, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t1 = time.perf_counter()
+    for _ in range(3):
+        out = f(*args)
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t2 = time.perf_counter()
+    print(f"[{label}] step={(t2 - t1) / 3 * 1000:.0f}ms", flush=True)
+
+
+def main() -> None:
+    from corrosion_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    w_count, q_cap, f, n_cells, k_in = 512, 48, 3, 256, 26
+    kk = f * q_cap
+    k2 = kk + 3
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 8)
+    print(f"platform={jax.devices()[0].platform} n={n} kk={kk}", flush=True)
+
+    contig = jax.random.randint(ks[0], (n, w_count), 0, 50).astype(jnp.uint32)
+    seen0 = contig + jax.random.randint(ks[1], (n, w_count), 0, 5).astype(jnp.uint32)
+    q_writer = jax.random.randint(ks[2], (n, q_cap), -1, w_count).astype(jnp.int32)
+    q_ver = jax.random.randint(ks[3], (n, q_cap), 1, 60).astype(jnp.uint32)
+    src = jax.random.randint(ks[4], (n, f), 0, n)
+    link_ok = jax.random.uniform(ks[5], (n, f)) < 0.9
+    cells = crdt.make_cells(n * n_cells)
+
+    def stage_gather(contig, src, q_writer, q_ver, link_ok):
+        m_w = q_writer[src].reshape(n, kk)
+        m_v = q_ver[src].reshape(n, kk)
+        m_ok = (
+            jnp.repeat(link_ok[:, :, None], q_cap, axis=2).reshape(n, kk)
+            & (m_w >= 0)
+        )
+        return m_w, m_v, m_ok
+
+    def stage_base(contig, src, q_writer, q_ver, link_ok):
+        m_w, m_v, m_ok = stage_gather(contig, src, q_writer, q_ver, link_ok)
+        base_m = _onehot_rowgather(contig, jnp.maximum(m_w, 0))
+        return base_m, m_w, m_v, m_ok
+
+    def stage_sort(contig, src, q_writer, q_ver, link_ok):
+        base_m, m_w, m_v, m_ok = stage_base(contig, src, q_writer, q_ver, link_ok)
+        useful = m_ok & (m_v > base_m)
+        d_raw = jnp.where(useful, m_v - base_m, 0)
+        dc = jnp.minimum(d_raw, jnp.uint32(kk + 1))
+        sent_key = jnp.uint32(w_count * k2)
+        pkd = jnp.where(useful, m_w.astype(jnp.uint32) * k2 + dc, sent_key)
+        skey, v2 = jax.lax.sort((pkd, m_v), dimension=1, num_keys=1, is_stable=False)
+        return skey, v2
+
+    def stage_run(contig, src, q_writer, q_ver, link_ok):
+        skey, v2 = stage_sort(contig, src, q_writer, q_ver, link_ok)
+        sent_key = jnp.uint32(w_count * k2)
+        valid2 = skey < sent_key
+        w2 = jnp.minimum((skey // k2).astype(jnp.int32), w_count - 1)
+        d2 = (skey % k2).astype(jnp.uint32)
+        seg_start = jnp.concatenate(
+            [jnp.ones((n, 1), bool), w2[:, 1:] != w2[:, :-1]], axis=1
+        )
+        prev_d = jnp.concatenate([jnp.zeros((n, 1), d2.dtype), d2[:, :-1]], axis=1)
+        ok_link = jnp.where(seg_start, d2 == 1, d2 <= prev_d + 1) & (d2 <= kk)
+        run = routing.segmented_prefix_and_rows(ok_link & valid2, seg_start)
+        return run, valid2, w2, d2, v2, seg_start, prev_d
+
+    def stage_reduce(contig, seen, src, q_writer, q_ver, link_ok):
+        run, valid2, w2, d2, v2, seg_start, prev_d = stage_run(
+            contig, src, q_writer, q_ver, link_ok
+        )
+        applied = run & valid2
+        ids = jnp.arange(w_count, dtype=w2.dtype)
+        hit = w2[:, :, None] == ids[None, None, :]
+        contig2 = contig + jnp.max(
+            jnp.where(hit & applied[:, :, None], d2[:, :, None], 0), axis=1
+        )
+        seen2 = jnp.maximum(
+            seen,
+            jnp.max(jnp.where(hit & valid2[:, :, None], v2[:, :, None], 0), axis=1),
+        )
+        return contig2, seen2, applied, w2, v2, seg_start, d2, prev_d
+
+    def stage_crdt(cells, contig, seen, src, q_writer, q_ver, link_ok):
+        contig2, seen2, applied, w2, v2, seg_start, d2, prev_d = stage_reduce(
+            contig, seen, src, q_writer, q_ver, link_ok
+        )
+        fresh = applied & ~((~seg_start) & (d2 == prev_d))
+        from corrosion_tpu.ops.gossip import GossipConfig, _merge_versions_dense
+
+        cfg = GossipConfig(n_nodes=n, n_writers=w_count, n_cells=n_cells)
+        cells2, _ = _merge_versions_dense(
+            cells, None, w2, v2, fresh, None, n, cfg
+        )
+        return contig2, seen2, cells2, fresh, w2, v2
+
+    def stage_intake(cells, contig, seen, src, q_writer, q_ver, link_ok):
+        contig2, seen2, cells2, fresh, w2, v2 = stage_crdt(
+            cells, contig, seen, src, q_writer, q_ver, link_ok
+        )
+        in_mask, (in_w, in_v) = routing.rebuild_bounded_queue(
+            fresh, -v2.astype(jnp.int32), (w2, v2), k_in
+        )
+        return contig2, seen2, cells2, in_mask, in_w, in_v
+
+    timed("A_gather", stage_gather, contig, src, q_writer, q_ver, link_ok)
+    timed("B_base", stage_base, contig, src, q_writer, q_ver, link_ok)
+    timed("C_sort", stage_sort, contig, src, q_writer, q_ver, link_ok)
+    timed("D_run", stage_run, contig, src, q_writer, q_ver, link_ok)
+    timed("E_reduce", stage_reduce, contig, seen0, src, q_writer, q_ver, link_ok)
+    timed("F_crdt", stage_crdt, cells, contig, seen0, src, q_writer, q_ver, link_ok)
+    timed("G_intake", stage_intake, cells, contig, seen0, src, q_writer, q_ver, link_ok)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,11 +34,38 @@ def main() -> None:
 
     enable_persistent_cache()
     steady = "--steady" in sys.argv  # no partition: pure propagation p99
+    steptime = "--steptime" in sys.argv  # warm-chunk step timing only
     nums = [a for a in sys.argv[1:] if not a.startswith("-")]
     rounds = int(nums[0]) if nums else 16
     cfg, topo, sched = models.wan_100k(
         rounds=rounds, samples=256, partition=not steady
     )
+    if steptime:
+        # Warm-up one 8-round chunk (compile), then time the SAME compiled
+        # scan over the next chunks: per-round time without compile skew.
+        import dataclasses
+
+        warm = dataclasses.replace(
+            sched, writes=sched.writes[:8],
+            partition=None if sched.partition is None else sched.partition[:8],
+        )
+        state, _ = simulate(cfg, topo, warm, seed=0, max_chunk=8)
+        jax.block_until_ready(state.data.contig)
+        rest = dataclasses.replace(
+            sched, writes=sched.writes[8:],
+            partition=None if sched.partition is None else sched.partition[8:],
+        )
+        t0 = time.perf_counter()
+        state, _ = simulate(cfg, topo, rest, seed=0, state=state, max_chunk=8)
+        jax.block_until_ready(state.data.contig)
+        wall = time.perf_counter() - t0
+        print(json.dumps({
+            "platform": jax.devices()[0].platform,
+            "mode": "steptime",
+            "rounds_timed": rounds - 8,
+            "step_ms": round(wall / max(rounds - 8, 1) * 1000.0, 1),
+        }))
+        return
     t0 = time.perf_counter()
     final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=8)
     jax.block_until_ready(final.data.contig)
