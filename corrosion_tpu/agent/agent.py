@@ -31,7 +31,7 @@ from corrosion_tpu.core.bookkeeping import (
     PartialNeed,
     generate_sync,
 )
-from corrosion_tpu.core.changes import chunk_changes
+from corrosion_tpu.core.changes import AdaptiveChunker, chunk_changes
 from corrosion_tpu.core.hlc import HLC, ts_physical_ms
 from corrosion_tpu.core.intervals import RangeSet
 from corrosion_tpu.core.values import Change, ExecResponse, ExecResult, Statement
@@ -62,6 +62,16 @@ class AgentConfig:
     fanout: int = 3  # num_indirect_probes analogue
     max_transmissions: int = 4
     sync_peers: int = 3  # 3-10 by need desc / ring asc (agent.rs:2383-2423)
+    # Concurrent sync-session scheduling (parallel_sync, peer.rs:1108-1223):
+    # need blocks requested per wave per session, and the server's per-wave
+    # version budget (fairness across concurrent sessions).
+    sync_wave_needs: int = 10
+    sync_serve_budget: int = 512
+    # Adaptive chunk sizing + stall abort (peer.rs:352-355, 638-653).
+    sync_chunk_max_bytes: int = 8 * 1024
+    sync_chunk_min_bytes: int = 1024
+    sync_adapt_threshold: float = 0.5
+    sync_stall_timeout: float = 5.0
     ingest_batch: int = 1000  # handle_changes batching (agent.rs:2450-2518)
     ingest_linger: float = 0.05
     # Admission control: per-route concurrency + load-shed (128 per route,
@@ -74,6 +84,11 @@ class AgentConfig:
     # scaled down to in-process test time.
     compact_interval: float = 5.0
     empties_flush_interval: float = 0.5
+    # Row-count sampling cadence (collect_metrics runs every 10 s in the
+    # reference, agent.rs:1138-1187). Full COUNT(*) scans ride the read
+    # pool, but at millions of log rows even pooled scans are not free —
+    # the cadence is its own knob, not derived from compact_interval.
+    metrics_interval: float = 10.0
     # WAL truncation cadence (the reference checkpoints + times WAL
     # truncation in its db_cleanup loop, agent.rs:956-967, 1413-1435).
     wal_checkpoint_interval: float = 15.0
@@ -213,10 +228,14 @@ class Agent:
         self.gossip_addr = await self.transport.serve(
             self.cfg.gossip_host, self.cfg.gossip_port, self._on_gossip
         )
+        # SWIM rides the unreliable datagram plane (foca over QUIC
+        # datagrams, broadcast/mod.rs:710 + transport.rs:66-90): UDP sends
+        # never connect, so a black-holing peer cannot stall the probe
+        # cadence. Oversized packets / TLS mode fall back to streams.
         self.swim = Swim(
             self.members,
             self.gossip_addr,
-            self.transport.send_frame,
+            self.transport.send_packet,
             probe_interval=self.cfg.probe_interval,
             max_transmissions=self.cfg.max_transmissions,
         )
@@ -283,6 +302,8 @@ class Agent:
             except Exception:
                 pass
         self.transport.close()
+        if self.subs is not None:
+            self.subs.close()
         if self._api_server is not None:
             self._api_server.close()
         if self._admin_server is not None:
@@ -476,6 +497,18 @@ class Agent:
         sent_ctr = self.metrics.counter(
             "corro_broadcast_sent", "broadcast frames transmitted"
         )
+        # Transmits are SPAWNED, not awaited inline (transmit_broadcast
+        # tasks, broadcast/mod.rs:741-756): one black-holing peer must not
+        # stall the whole dissemination tick for its connect timeout. The
+        # semaphore bounds in-flight sends; the transport's per-peer
+        # circuit breaker makes repeat failures fail fast.
+        sem = asyncio.Semaphore(32)
+
+        async def transmit(addr: tuple, frame: dict) -> None:
+            async with sem:
+                if await self.transport.send_frame(addr, frame):
+                    sent_ctr.inc()
+
         while not self.tripwire.tripped:
             await asyncio.sleep(self.cfg.broadcast_interval)
             pending_gauge.set(len(self._pending))
@@ -497,10 +530,9 @@ class Agent:
                 for m in others[: self.cfg.fanout]:
                     targets[m.actor_id] = m
                 for m in targets.values():
-                    await self.transport.send_frame(
-                        m.addr, pb.frame
+                    self.tasks.spawn(
+                        transmit(m.addr, pb.frame), name="transmit_broadcast"
                     )
-                    sent_ctr.inc()
                 pb.tx_left -= 1
                 if pb.tx_left > 0:
                     self._pending.append(pb)
@@ -704,12 +736,23 @@ class Agent:
     async def _compact_loop(self) -> None:
         """Periodically find fully-overwritten versions and clear them
         (clear_overwritten_versions, agent.rs:995-1126)."""
+        log = logging.getLogger(__name__)
+        failing = False
         while not self.tripwire.tripped:
             await asyncio.sleep(self.cfg.compact_interval)
             try:
                 await self._compact_once()
+                failing = False
             except Exception:
-                pass
+                # Warn on the first failure of a streak (the reference warns
+                # on compaction errors); repeats at debug so a permanently
+                # failing flush is visible without log spam.
+                log.log(
+                    logging.DEBUG if failing else logging.WARNING,
+                    "clear_overwritten_versions failed",
+                    exc_info=True,
+                )
+                failing = True
 
     async def _compact_once(self) -> None:
         for actor, booked in list(self.bookie.items()):
@@ -743,13 +786,24 @@ class Agent:
     async def _empties_loop(self) -> None:
         """Batch queued cleared ranges into collapsed bookkeeping rows
         (write_empties_loop, agent.rs:2522-2571)."""
+        log = logging.getLogger(__name__)
+        failing = False
         while not self.tripwire.tripped:
             await asyncio.sleep(self.cfg.empties_flush_interval)
             if self._empties:
                 try:
                     await self._flush_empties()
+                    failing = False
                 except Exception:
-                    pass
+                    # First failure of a streak at warning: _flush_empties
+                    # re-merges the batch, so a permanent failure would
+                    # otherwise retry silently forever while _empties grows.
+                    log.log(
+                        logging.DEBUG if failing else logging.WARNING,
+                        "write_empties flush failed; batch re-queued",
+                        exc_info=True,
+                    )
+                    failing = True
 
     async def _flush_empties(self) -> None:
         empties, self._empties = self._empties, {}
@@ -792,9 +846,11 @@ class Agent:
         queue_g = self.metrics.gauge(
             "corro_sqlite_write_queue", "queued writer jobs per priority"
         )
-        interval = max(self.cfg.compact_interval / 2, 0.25)
+        interval = self.cfg.metrics_interval
         while not self.tripwire.tripped:
             await asyncio.sleep(interval)
+            if self.pool is None:
+                continue  # pool-less agent: nothing to sample
             try:
                 # Full-table counts ride the read POOL (off the event
                 # loop): at millions of log rows an on-loop scan would
@@ -886,62 +942,123 @@ class Agent:
                 pass
 
     async def _sync_once(self) -> None:
+        """Concurrent multi-peer sync (parallel_sync, peer.rs:925-1286):
+        sessions to the chosen peers run CONCURRENTLY, a shared claim set
+        dedups in-flight need blocks across them (scheduler peer.rs:1108-
+        1223), and each session pulls in waves of ``sync_wave_needs``
+        blocks so one slow peer never delays the others."""
         peers = self.members.by_ring()  # ring asc (agent.rs:2383-2423)
         if not peers:
             return
         peers = peers[: self.cfg.sync_peers]
+        in_flight: set = set()
+        await asyncio.gather(
+            *(self._sync_with_peer(m, in_flight) for m in peers)
+        )
+
+    # Need blocks align to an absolute 10-version grid so concurrent
+    # sessions claim identical keys for identical work even when the
+    # bookie moved between their waves (chunked ranges, peer.rs:833-841).
+    _NEED_BLOCK = 10
+
+    def _claim_needs(
+        self, needs: dict, in_flight: set, cap: int
+    ) -> tuple[dict, list]:
+        """Split needs into grid-aligned blocks, claim up to ``cap`` blocks
+        not already in flight elsewhere. Returns (wire-ready needs by
+        actor, claimed keys)."""
+        out: dict[str, list] = {}
+        keys: list = []
+        b = self._NEED_BLOCK
+        for actor, lst in needs.items():
+            for need in lst:
+                if isinstance(need, FullNeed):
+                    start = need.start
+                    while start <= need.end:
+                        block_end = min(((start - 1) // b + 1) * b, need.end)
+                        key = (actor, "full", (start - 1) // b)
+                        if key not in in_flight:
+                            in_flight.add(key)
+                            keys.append(key)
+                            out.setdefault(actor, []).append(
+                                FullNeed(start, block_end)
+                            )
+                            if len(keys) >= cap:
+                                return out, keys
+                        start = block_end + 1
+                else:
+                    key = (actor, "part", need.version)
+                    if key not in in_flight:
+                        in_flight.add(key)
+                        keys.append(key)
+                        out.setdefault(actor, []).append(need)
+                        if len(keys) >= cap:
+                            return out, keys
+        return out, keys
+
+    async def _sync_with_peer(self, m, in_flight: set) -> None:
         needs_gauge = self.metrics.gauge(
             "corro_sync_needs", "version gaps at last sync generation"
         )
         sess_hist = self.metrics.histogram(
             "corro_sync_client_seconds", "client-side sync session duration"
         )
-        for m in peers:
-            # Regenerate per peer: changesets ingested from the previous
-            # peer shrink what we ask the next one for (the reference's
-            # scheduler dedups in-flight needs across peers,
-            # peer.rs:1108-1223).
-            my_state = generate_sync(self.bookie, self.actor_id)
-            needs_gauge.set(my_state.need_len())
-            # Cross-node trace propagation: the session span's traceparent
-            # travels in the wire protocol (SyncTraceContextV1, sync.rs:32-67
-            # injected peer.rs:941-944).
-            span = self.tracer.span("sync_client", peer=m.actor_id[:8])
-            span.__enter__()
-            t_start = time.monotonic()
-            session = await self.transport.open_session(
-                m.addr,
-                {"t": "sync_start", "actor": self.actor_id,
-                 "clock": self.hlc.new_timestamp(),
-                 "trace": span.traceparent},
-            )
-            if session is None:
-                span.__exit__(None, None, None)
-                continue
-            try:
-                reply = await session.recv(timeout=5.0)
-                if not reply or reply.get("t") != "sync_state":
-                    continue
-                self.hlc.update_with_timestamp(reply.get("clock", 0))
-                server_state = _state_from_wire(reply["state"])
+        # Cross-node trace propagation: the session span's traceparent
+        # travels in the wire protocol (SyncTraceContextV1, sync.rs:32-67
+        # injected peer.rs:941-944).
+        span = self.tracer.span("sync_client", peer=m.actor_id[:8])
+        span.__enter__()
+        t_start = time.monotonic()
+        session = await self.transport.open_session(
+            m.addr,
+            {"t": "sync_start", "actor": self.actor_id,
+             "clock": self.hlc.new_timestamp(),
+             "trace": span.traceparent},
+        )
+        if session is None:
+            span.__exit__(None, None, None)
+            return
+        claimed: list = []
+        try:
+            reply = await session.recv(timeout=5.0)
+            if not reply or reply.get("t") != "sync_state":
+                return
+            self.hlc.update_with_timestamp(reply.get("clock", 0))
+            server_state = _state_from_wire(reply["state"])
+            while not self.tripwire.tripped:
+                # Regenerate per wave: blocks ingested from concurrent
+                # sessions (and this one's earlier waves) shrink the next
+                # request; claims cover what's served but not yet ingested.
+                my_state = generate_sync(self.bookie, self.actor_id)
+                needs_gauge.set(my_state.need_len())
                 needs = my_state.compute_available_needs(server_state)
-                if not needs:
-                    continue
-                await session.send(
-                    {"t": "sync_request", "needs": _needs_to_wire(needs)}
+                wave, keys = self._claim_needs(
+                    needs, in_flight, self.cfg.sync_wave_needs
                 )
+                claimed.extend(keys)
+                if not wave:
+                    break
+                await session.send(
+                    {"t": "sync_request", "needs": _needs_to_wire(wave)}
+                )
+                done = False
                 while True:
                     frame = await session.recv(timeout=10.0)
                     if frame is None or frame.get("t") == "sync_done":
+                        done = True
                         break
-                    if frame.get("t") == "sync_changes":
+                    t = frame.get("t")
+                    if t == "sync_wave_done":
+                        break
+                    if t == "sync_changes":
                         inner = dict(frame)
                         inner["t"] = "bcast"
                         try:
                             self._ingest.put_nowait((inner, "sync"))
                         except asyncio.QueueFull:
+                            done = True
                             break
-                    elif frame.get("t") == "sync_cleared":
+                    elif t == "sync_cleared":
                         booked = self.bookie.for_actor(frame["actor"])
                         for s, e in frame["versions"]:
                             booked.insert_many(s, e, CLEARED)
@@ -949,17 +1066,32 @@ class Agent:
                             # survives restart (store path of
                             # process_multiple_changes' empty handling).
                             self._queue_empty(frame["actor"], s, e)
-            finally:
-                session.close()
-                sess_hist.observe(time.monotonic() - t_start)
-                span.__exit__(None, None, None)
-            # Let the ingest batcher absorb this peer's changesets before
-            # computing the next peer's (smaller) request.
-            await asyncio.sleep(self.cfg.ingest_linger * 2)
+                if done:
+                    break
+                # Let the ingest batcher absorb this wave before computing
+                # the next (smaller) one.
+                await asyncio.sleep(self.cfg.ingest_linger * 2)
+            try:
+                await session.send({"t": "sync_finish"})
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            # Release claims so a failed session's blocks become requestable
+            # by the next round (in-flight dedup is session-lifetime only).
+            for k in claimed:
+                in_flight.discard(k)
+            session.close()
+            sess_hist.observe(time.monotonic() - t_start)
+            span.__exit__(None, None, None)
 
     async def _serve_sync(self, session: Session, start: dict) -> None:
-        """Server side (peer.rs:1289-1527). Continues the client's trace via
-        the frame's traceparent (extracted like peer.rs:1296-1298)."""
+        """Server side (peer.rs:1289-1527): serves request waves until the
+        client finishes, under a per-wave version budget (fairness: a peer
+        requesting a huge range cannot monopolize the server; the reference
+        caps concurrent jobs and chunks adaptively, peer.rs:675-686) with
+        adaptive chunk sizing and a 5 s blocking-send abort
+        (peer.rs:352-355, 638-653). Continues the client's trace via the
+        frame's traceparent (extracted like peer.rs:1296-1298)."""
         with self.tracer.span(
             "sync_server", traceparent=start.get("trace"),
             peer=str(start.get("actor", ""))[:8],
@@ -970,17 +1102,58 @@ class Agent:
                 {"t": "sync_state", "state": _state_to_wire(state),
                  "clock": self.hlc.new_timestamp()}
             )
-            req = await session.recv(timeout=5.0)
-            if req and req.get("t") == "sync_request":
-                for actor, needs in _needs_from_wire(req["needs"]).items():
-                    booked = self.bookie.get(actor)
-                    if booked is None:
-                        continue
-                    for need in needs:
-                        await self._serve_need(session, actor, booked, need)
-            await session.send({"t": "sync_done"})
+            chunker = AdaptiveChunker(
+                max_bytes=self.cfg.sync_chunk_max_bytes,
+                min_bytes=self.cfg.sync_chunk_min_bytes,
+                threshold_s=self.cfg.sync_adapt_threshold,
+            )
+            try:
+                while not self.tripwire.tripped:
+                    req = await session.recv(timeout=5.0)
+                    if not req or req.get("t") != "sync_request":
+                        break  # sync_finish, timeout, or disconnect
+                    served = 0
+                    budget = self.cfg.sync_serve_budget
+                    for actor, needs in _needs_from_wire(
+                        req["needs"]
+                    ).items():
+                        booked = self.bookie.get(actor)
+                        if booked is None:
+                            continue
+                        for need in needs:
+                            if served >= budget:
+                                break
+                            served += await self._serve_need(
+                                session, actor, booked, need,
+                                chunker=chunker,
+                                budget=budget - served,
+                            )
+                    await session.send(
+                        {"t": "sync_wave_done", "served": served}
+                    )
+                await session.send({"t": "sync_done"})
+            except asyncio.TimeoutError:
+                # Blocking-send stall: abort the session (the client
+                # re-requests unserved blocks next round).
+                session.close()
 
-    async def _serve_need(self, session, actor, booked, need) -> None:
+    async def _timed_send(self, session, frame, chunker) -> None:
+        """Send with the stall abort + chunk-size feedback loop."""
+        t0 = time.monotonic()
+        await asyncio.wait_for(
+            session.send(frame), self.cfg.sync_stall_timeout
+        )
+        if chunker is not None:
+            chunker.record(time.monotonic() - t0)
+
+    async def _serve_need(
+        self, session, actor, booked, need, chunker=None, budget=None
+    ) -> int:
+        """Serve one need; returns the number of versions streamed (cleared
+        spans are range metadata, not streamed rows, and don't count).
+        ``budget`` truncates a large FullNeed — the client's claim
+        machinery re-requests the rest next round."""
+        served = 0
         if isinstance(need, FullNeed):
             # Cleared spans come straight from the interval set — a large
             # compacted range must not be walked version-by-version (it
@@ -991,26 +1164,40 @@ class Agent:
                 if s <= need.end and e >= need.start
             ]
             if cleared:
-                await session.send(
-                    {"t": "sync_cleared", "actor": actor, "versions": cleared}
+                await self._timed_send(
+                    session,
+                    {"t": "sync_cleared", "actor": actor, "versions": cleared},
+                    chunker,
                 )
             for v, known in sorted(booked.current.items()):
                 if v < need.start or v > need.end:
                     continue
+                if budget is not None and served >= budget:
+                    break
                 changes = self.store.changes_for(
                     bytes.fromhex(actor), known.db_version
                 )
-                for chunk, (s, e) in chunk_changes(changes, known.last_seq):
-                    await session.send(
+                max_bytes = chunker.max_bytes if chunker else None
+                for chunk, (s, e) in chunk_changes(
+                    changes, known.last_seq,
+                    **({"max_bytes": max_bytes} if max_bytes else {}),
+                ):
+                    await self._timed_send(
+                        session,
                         self._sync_changes_frame(
                             actor, v, chunk, (s, e), known.last_seq, known.ts,
-                        )
+                        ),
+                        chunker,
                     )
+                served += 1
         elif isinstance(need, PartialNeed):
             known = booked.get(need.version)
             if not isinstance(known, Partial):
-                return
-            rows = self.store.conn.execute(
+                return 0
+            # Read connection (not the writer): the pool's writer thread may
+            # hold an open BEGIN IMMEDIATE on store.conn, and this read runs
+            # on the event loop — same discipline as changes_for.
+            rows = self.store.read_conn.execute(
                 "SELECT tbl, pk, cid, val, col_version, db_version, seq,"
                 " site_id, cl FROM __corro_buffered_changes"
                 " WHERE actor_id = ? AND version = ? ORDER BY seq",
@@ -1023,12 +1210,16 @@ class Agent:
                     continue
                 lo = min(c.seq for c in have)
                 hi = max(c.seq for c in have)
-                await session.send(
+                await self._timed_send(
+                    session,
                     self._sync_changes_frame(
                         actor, need.version, have, (lo, hi),
                         known.last_seq, known.ts,
-                    )
+                    ),
+                    chunker,
                 )
+            served = 1
+        return served
 
     def _sync_changes_frame(self, actor, version, changes, seqs, last_seq, ts):
         f = self._changeset_frame(actor, version, changes, seqs, last_seq, ts)

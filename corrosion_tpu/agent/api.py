@@ -182,12 +182,35 @@ async def _route(agent, reader, writer, method, path, query, body) -> bool:
     route_key = "/".join(path.split("/")[:3])  # /v1/<route>
     limit = agent._api_limits.get(route_key)
     if limit is None:
-        return await _dispatch(agent, reader, writer, method, path, query, body)
-    with limit:
-        return await _dispatch(agent, reader, writer, method, path, query, body)
+        return await _dispatch(
+            agent, reader, writer, method, path, query, body, lambda: None
+        )
+    # The limit bounds request SETUP, not stream lifetime: the reference's
+    # ConcurrencyLimitLayer releases its permit when the handler returns
+    # the response, before the body streams — a long-lived subscription
+    # must not pin an admission slot (the 129th subscriber would shed).
+    # Streaming branches call ``release`` once setup is done; the finally
+    # covers every other path (idempotent via the once-guard).
+    limit.__enter__()
+    released = False
+
+    def release() -> None:
+        nonlocal released
+        if not released:
+            released = True
+            limit.__exit__(None, None, None)
+
+    try:
+        return await _dispatch(
+            agent, reader, writer, method, path, query, body, release
+        )
+    finally:
+        release()
 
 
-async def _dispatch(agent, reader, writer, method, path, query, body) -> bool:
+async def _dispatch(
+    agent, reader, writer, method, path, query, body, release
+) -> bool:
     if method == "POST" and path == "/v1/transactions":
         stmts = [Statement.parse(o) for o in _json_body(body)]
         resp = await agent.execute_async(stmts)
@@ -222,6 +245,7 @@ async def _dispatch(agent, reader, writer, method, path, query, body) -> bool:
             raise HttpError(501, "subscriptions not enabled")
         stmt = Statement.parse(_json_body(body))
         handle = agent.subs.subscribe(stmt.sql)
+        release()  # setup done; the stream must not hold an admission slot
         await _stream_sub(agent, reader, writer, handle, from_change=None,
                           skip_rows=query.get("skip_rows") == ["true"])
         return False
@@ -233,6 +257,7 @@ async def _dispatch(agent, reader, writer, method, path, query, body) -> bool:
         if handle is None:
             raise HttpError(404, f"no such subscription {sub_id}")
         frm = query.get("from")
+        release()  # setup done; the stream must not hold an admission slot
         await _stream_sub(
             agent, reader, writer, handle,
             from_change=int(frm[0]) if frm else None,

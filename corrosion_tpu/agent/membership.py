@@ -15,6 +15,7 @@ runtime_loop + corro-types/src/members.rs), for real (non-simulated) agents:
 from __future__ import annotations
 
 import asyncio
+import math
 import random
 import time
 from dataclasses import dataclass, field
@@ -40,6 +41,7 @@ class MemberState:
     rtts: list[float] = field(default_factory=list)  # ms, circular (cap 20)
     ring: int | None = None
     suspect_at: float = 0.0
+    down_at: float = 0.0  # monotonic time the member was declared down
 
     def add_rtt(self, ms: float) -> None:
         self.rtts.append(ms)
@@ -93,7 +95,23 @@ class Members:
         m.addr = addr
         if state == SUSPECT:
             m.suspect_at = time.monotonic()
+        elif state == DOWN:
+            m.down_at = time.monotonic()
         return True
+
+    def gc_down(self, horizon_s: float) -> list[str]:
+        """Forget members down longer than ``horizon_s`` (foca's
+        remove_down_after, 48 h in the WAN preset, broadcast/mod.rs:704-713)
+        so a long-lived cluster's member table doesn't accumulate corpses.
+        Returns the removed actor ids."""
+        now = time.monotonic()
+        gone = [
+            aid for aid, m in self.states.items()
+            if m.state == DOWN and m.down_at and now - m.down_at > horizon_s
+        ]
+        for aid in gone:
+            del self.states[aid]
+        return gone
 
 
 @dataclass
@@ -136,10 +154,34 @@ class Swim:
         self.suspect_timeout = suspect_timeout
         self.indirect_probes = indirect_probes
         self.max_transmissions = max_transmissions
+        # Cluster-size-adaptive dissemination (the reference resizes foca's
+        # config on every cluster-size notification, agent.rs:1345-1358 →
+        # make_foca_config, broadcast/mod.rs:704-713): retransmission budget
+        # scales ~log2 of the cluster so rumors still infect everyone, and
+        # down members are forgotten after ``down_gc_s`` (remove_down_after,
+        # 48 h in the WAN preset).
+        self._base_max_transmissions = max_transmissions
+        self._base_indirect = indirect_probes
+        self._last_size = 0
+        self.down_gc_s = 48 * 3600.0
         self.incarnation = 0
         self.rumors: list[Rumor] = []
         self._acks: dict[int, asyncio.Event] = {}
         self._seq = 0
+
+    def _adapt_config(self) -> None:
+        """Recompute dissemination parameters from the current cluster size
+        (called every probe round; cheap, idempotent)."""
+        size = len(self.members.alive()) + 1
+        if size == self._last_size:
+            return
+        self._last_size = size
+        self.max_transmissions = max(
+            self._base_max_transmissions, math.ceil(1.5 * math.log2(size + 1))
+        )
+        self.indirect_probes = max(
+            self._base_indirect, min(5, math.ceil(math.log2(size + 1) / 2))
+        )
 
     # -- dissemination -------------------------------------------------------
 
@@ -178,12 +220,15 @@ class Swim:
     # -- probe loop ----------------------------------------------------------
 
     async def probe_round(self) -> None:
+        self._adapt_config()
+        self.members.gc_down(self.down_gc_s)
         alive = [m for m in self.members.alive() if m.state == ALIVE]
         # Expire suspects first (suspect -> down).
         now = time.monotonic()
         for m in list(self.members.states.values()):
             if m.state == SUSPECT and now - m.suspect_at > self.suspect_timeout:
                 m.state = DOWN
+                m.down_at = now
                 self.queue_rumor(m.actor_id, m.addr, DOWN, m.incarnation)
         if not alive:
             return

@@ -599,8 +599,14 @@ class Store:
             sql += " AND seq BETWEEN ? AND ?"
             args += [seqs[0], seqs[1]]
         sql += " ORDER BY seq"
+        # Read connection, not the writer: sync serving runs on the event
+        # loop while the pool's writer thread may hold an open BEGIN
+        # IMMEDIATE on ``conn`` — joining that in-flight transaction could
+        # serve uncommitted state. WAL gives this snapshot committed
+        # versions only, which is exactly what booked.current describes.
         return [
-            Change.from_tuple(r) for r in self.conn.execute(sql, args).fetchall()
+            Change.from_tuple(r)
+            for r in self.read_conn.execute(sql, args).fetchall()
         ]
 
     # -- compaction (clear_overwritten_versions, agent.rs:995-1299) ----------

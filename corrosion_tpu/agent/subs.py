@@ -16,14 +16,19 @@ suited to the host store:
   pubsub.rs:566-661); other shapes (joins/aggregates) fall back to
   whole-row identity, which downgrades updates to delete+insert pairs but
   keeps the stream correct;
-- the result snapshot and the change history (`query` and `changes` tables
-  of the reference's per-sub SQLite db, pubsub.rs:806-841) live in memory,
-  with the same change-id semantics.
+- the result snapshot and the change history live in each sub's own
+  SQLite file (`query`/`changes`/`meta` tables — the reference's per-sub
+  sub-db, pubsub.rs:806-841), so ``?from=`` catch-up replays across agent
+  restarts and a laggard survives far deeper history than an in-memory
+  ring; handles built without a directory (unit tests) fall back to an
+  in-memory deque with the same change-id semantics.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
+import os
 import sqlite3
 import time
 import uuid
@@ -42,7 +47,34 @@ from corrosion_tpu.core.values import (
     unpack_columns,
 )
 
+# In-memory fallback ring depth (no-db handles); durable handles retain
+# MAX_DURABLE_HISTORY change rows in their sub-db before pruning.
 MAX_CHANGE_HISTORY = 8192
+MAX_DURABLE_HISTORY = 1 << 16
+
+
+def _jsonable(v):
+    if isinstance(v, bytes):
+        return {"$b": v.hex()}
+    return v
+
+
+def _unjson(v):
+    if isinstance(v, dict) and set(v.keys()) == {"$b"}:
+        return bytes.fromhex(v["$b"])
+    return v
+
+
+def _key_to_json(key: tuple) -> str:
+    return json.dumps([_jsonable(v) for v in key], separators=(",", ":"))
+
+
+def _cells_to_json(cells) -> str:
+    return json.dumps([_jsonable(v) for v in cells], separators=(",", ":"))
+
+
+def _cells_from_json(s: str) -> tuple:
+    return tuple(_unjson(v) for v in json.loads(s))
 
 
 def normalize_sql(sql: str) -> str:
@@ -93,7 +125,7 @@ class MatcherHandle:
 
     def __init__(
         self, store: Store, sql: str, sub_id: str | None = None,
-        start_change_id: int = 0,
+        start_change_id: int = 0, db_dir: str | None = None,
     ) -> None:
         self.id = sub_id or uuid.uuid4().hex
         self.sql = sql
@@ -115,7 +147,138 @@ class MatcherHandle:
         self.change_id = start_change_id
         self.history: deque[QueryEventChange] = deque(maxlen=MAX_CHANGE_HISTORY)
         self._listeners: list[asyncio.Queue] = []
-        self._run_initial()
+        self._touched: list[tuple] = []
+        self._db: sqlite3.Connection | None = None
+        restored = False
+        if db_dir is not None:
+            os.makedirs(db_dir, exist_ok=True)
+            self._db = sqlite3.connect(
+                os.path.join(db_dir, f"{self.id}.sqlite"),
+                check_same_thread=False,
+            )
+            self._db.isolation_level = None
+            self._db.execute("PRAGMA journal_mode=WAL")
+            # The sub-db is DERIVED state (rebuildable from the main db by
+            # the restore-time reconcile diff), so commits skip fsync:
+            # _persist_events runs on the event loop per ingest batch, and
+            # a synchronous commit there would stall SWIM probes and sync
+            # timeouts for the disk's flush latency.
+            self._db.execute("PRAGMA synchronous=OFF")
+            restored = self._restore_from_db()
+        if restored:
+            # Snapshot + watermark came from the sub-db; emit (and persist)
+            # whatever drifted while we were down — a resuming subscriber
+            # gets those as ordinary catch-up events instead of a snapshot
+            # restart (Matcher::restore, pubsub.rs:735-771). process(None)'s
+            # full evaluation also populates self.columns — one scan.
+            self.process(None)
+        else:
+            self._run_initial()
+            self._persist_snapshot()
+
+    # -- durable sub-db (pubsub.rs:806-841) ----------------------------------
+
+    def _restore_from_db(self) -> bool:
+        """Load snapshot + history watermark from the sub-db; returns False
+        when the db is fresh or belongs to a different query text."""
+        db = self._db
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v TEXT)"
+        )
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS query ("
+            " key TEXT PRIMARY KEY, rowid_ INTEGER NOT NULL,"
+            " cells TEXT NOT NULL) WITHOUT ROWID"
+        )
+        db.execute(
+            "CREATE TABLE IF NOT EXISTS changes ("
+            " change_id INTEGER PRIMARY KEY, kind TEXT NOT NULL,"
+            " rowid_ INTEGER NOT NULL, cells TEXT NOT NULL)"
+        )
+        row = db.execute("SELECT v FROM meta WHERE k = 'sql'").fetchone()
+        if row is None or normalize_sql(row[0]) != normalize_sql(self.sql):
+            db.execute("DELETE FROM meta")
+            db.execute("DELETE FROM query")
+            db.execute("DELETE FROM changes")
+            db.execute(
+                "INSERT INTO meta VALUES ('sql', ?)", (self.sql,)
+            )
+            return False
+        wm = db.execute("SELECT v FROM meta WHERE k = 'change_id'").fetchone()
+        if wm is not None:
+            self.change_id = max(self.change_id, int(wm[0]))
+        for key_s, rowid, cells_s in db.execute(
+            "SELECT key, rowid_, cells FROM query"
+        ).fetchall():
+            key = tuple(_unjson(v) for v in json.loads(key_s))
+            self.rows[key] = _cells_from_json(cells_s)
+            self.rowids[key] = rowid
+            self._next_rowid = max(self._next_rowid, rowid + 1)
+        return True
+
+    def _persist_snapshot(self) -> None:
+        if self._db is None:
+            return
+        db = self._db
+        db.execute("BEGIN")
+        db.execute("DELETE FROM query")
+        db.executemany(
+            "INSERT INTO query VALUES (?, ?, ?)",
+            [
+                (_key_to_json(k), self.rowids[k], _cells_to_json(c))
+                for k, c in self.rows.items()
+            ],
+        )
+        db.execute(
+            "INSERT OR REPLACE INTO meta VALUES ('change_id', ?)",
+            (str(self.change_id),),
+        )
+        db.execute("COMMIT")
+
+    def _persist_events(
+        self, events: list[QueryEventChange], touched: list[tuple]
+    ) -> None:
+        """Append events to the durable change log + upsert the touched
+        snapshot rows, in one transaction; prune history past the cap."""
+        if self._db is None or not events:
+            return
+        db = self._db
+        db.execute("BEGIN")
+        db.executemany(
+            "INSERT OR REPLACE INTO changes VALUES (?, ?, ?, ?)",
+            [
+                (ev.change_id, ev.kind, ev.rowid, _cells_to_json(ev.cells))
+                for ev in events
+            ],
+        )
+        for key in touched:
+            if key in self.rows:
+                db.execute(
+                    "INSERT OR REPLACE INTO query VALUES (?, ?, ?)",
+                    (_key_to_json(key), self.rowids[key],
+                     _cells_to_json(self.rows[key])),
+                )
+            else:
+                db.execute(
+                    "DELETE FROM query WHERE key = ?", (_key_to_json(key),)
+                )
+        db.execute(
+            "INSERT OR REPLACE INTO meta VALUES ('change_id', ?)",
+            (str(self.change_id),),
+        )
+        db.execute(
+            "DELETE FROM changes WHERE change_id <= ?",
+            (self.change_id - MAX_DURABLE_HISTORY,),
+        )
+        db.execute("COMMIT")
+
+    def close(self) -> None:
+        if self._db is not None:
+            try:
+                self._db.close()
+            except Exception:
+                pass
+            self._db = None
 
     # -- query shape ---------------------------------------------------------
 
@@ -203,14 +366,21 @@ class MatcherHandle:
         not O(result set). Other shapes (joins, aggregates, no batch) fall
         back to full snapshot diffing.
         """
+        self._touched: list[tuple] = []
         candidates = self._candidate_keys(changes)
         if candidates is None:
-            _, new_rows = self._evaluate()
+            cols, new_rows = self._evaluate()
+            self.columns = cols
             events = self._diff_full(new_rows)
         else:
             events = self._diff_candidates(candidates)
+        # The deque stays populated either way: a bounded in-memory cache
+        # for live introspection; durable handles additionally append to
+        # the sub-db log that backs ?from= replay.
+        self.history.extend(events)
+        if self._db is not None:
+            self._persist_events(events, self._touched)
         for ev in events:
-            self.history.append(ev)
             for q in self._listeners:
                 try:
                     q.put_nowait(ev)
@@ -294,6 +464,8 @@ class MatcherHandle:
 
     def _emit(self, kind, key, cells) -> QueryEventChange:
         self.change_id += 1
+        if self._db is not None:
+            self._touched.append(key)
         return QueryEventChange(
             kind=kind,
             rowid=self.rowids.get(key, 0),
@@ -317,16 +489,44 @@ class MatcherHandle:
         rows + eoq) or catch-up from a change id (catch_up_sub,
         api/public/pubsub.rs:36-94)."""
         events: list = [{"sub_id": self.id}]
+        replay: list[QueryEventChange] | None = None
         if from_change is not None:
-            oldest = self.history[0].change_id if self.history else None
-            if oldest is not None and from_change + 1 < oldest:
-                # History truncated: restart with a snapshot.
-                from_change = None
-            elif oldest is None and from_change < self.change_id:
-                # No history but the watermark moved past the resume point
-                # (e.g. restored after a restart): snapshot restart.
-                from_change = None
-        if from_change is None:
+            if self._db is not None:
+                # Durable log: replay is valid iff nothing after
+                # ``from_change`` has been pruned (the log retains
+                # MAX_DURABLE_HISTORY rows and survives restarts).
+                (oldest,) = self._db.execute(
+                    "SELECT min(change_id) FROM changes"
+                ).fetchone()
+                if (
+                    from_change >= self.change_id
+                    or (oldest is not None and from_change + 1 >= oldest)
+                ):
+                    replay = [
+                        QueryEventChange(
+                            kind=kind, rowid=rowid,
+                            cells=list(_cells_from_json(cells_s)),
+                            change_id=cid,
+                        )
+                        for cid, kind, rowid, cells_s in self._db.execute(
+                            "SELECT change_id, kind, rowid_, cells"
+                            " FROM changes WHERE change_id > ?"
+                            " ORDER BY change_id",
+                            (from_change,),
+                        ).fetchall()
+                    ]
+            else:
+                oldest = self.history[0].change_id if self.history else None
+                if oldest is not None and from_change + 1 >= oldest:
+                    replay = [
+                        ev for ev in self.history
+                        if ev.change_id > from_change
+                    ]
+                elif oldest is None and from_change >= self.change_id:
+                    replay = []
+        if replay is None:
+            # History truncated past the resume point (or no resume asked):
+            # snapshot restart.
             events.append(QueryEventColumns(list(self.columns)))
             if not skip_rows:
                 for key, cells in self.rows.items():
@@ -340,9 +540,7 @@ class MatcherHandle:
             # Exclusive: replay events AFTER the given change id
             # (doc/api/subscriptions.md resume semantics).
             events.append(QueryEventColumns(list(self.columns)))
-            for ev in self.history:
-                if ev.change_id > from_change:
-                    events.append(ev)
+            events.extend(replay)
         return [_WireEvent(e) if isinstance(e, dict) else e for e in events]
 
 
@@ -361,12 +559,18 @@ class SubsManager:
 
     Subscriptions persist to ``__corro_subs`` (id, sql, change_id watermark)
     and are recreated at boot (agent.rs:373-419 + Matcher::restore,
-    pubsub.rs:735-771). Event history is in-memory only; a subscriber
-    resuming past the restored watermark gets a snapshot restart.
+    pubsub.rs:735-771). Each sub's snapshot + change history lives in its
+    own SQLite file under ``<data_dir>/subs/`` (the reference's per-sub
+    sub-db), so ``?from=`` replays across restarts.
     """
 
-    def __init__(self, store: Store) -> None:
+    def __init__(self, store: Store, db_dir: str | None = None) -> None:
         self.store = store
+        if db_dir is None:
+            db_dir = os.path.join(
+                os.path.dirname(os.path.abspath(store.path)), "subs"
+            )
+        self._db_dir = db_dir
         self._by_sql: dict[str, MatcherHandle] = {}
         self._by_id: dict[str, MatcherHandle] = {}
         self._ensure_table()
@@ -382,7 +586,7 @@ class SubsManager:
         key = normalize_sql(sql)
         handle = self._by_sql.get(key)
         if handle is None:
-            handle = MatcherHandle(self.store, sql)
+            handle = MatcherHandle(self.store, sql, db_dir=self._db_dir)
             self._register(key, handle)
             with self.store._wlock("subs_persist"):
                 self.store.conn.execute(
@@ -408,7 +612,8 @@ class SubsManager:
                 continue
             try:
                 handle = MatcherHandle(
-                    self.store, sql, sub_id=sub_id, start_change_id=change_id
+                    self.store, sql, sub_id=sub_id, start_change_id=change_id,
+                    db_dir=self._db_dir,
                 )
             except Exception as e:
                 msg = str(e).lower()
@@ -455,7 +660,9 @@ class SubsManager:
     def reinit_after_restore(self) -> None:
         """After an online restore the table reflects the BACKUP's origin
         (or is absent — backups strip it as node-local): recreate it and
-        re-persist this node's live subscriptions + watermarks."""
+        re-persist this node's live subscriptions + watermarks, then emit
+        the diff between each sub's pre-restore snapshot and the restored
+        data as ordinary change events (subscribers keep their streams)."""
         self._ensure_table()
         with self.store._wlock("subs_reinit"):
             self.store.conn.execute("DELETE FROM __corro_subs")
@@ -466,3 +673,9 @@ class SubsManager:
                     for h in self._by_id.values()
                 ],
             )
+        for h in self._by_id.values():
+            h.process(None)
+
+    def close(self) -> None:
+        for h in self._by_id.values():
+            h.close()

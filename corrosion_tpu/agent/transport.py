@@ -1,17 +1,30 @@
-"""Gossip transport: length-delimited frames over TCP (asyncio).
+"""Gossip transport: UDP datagrams + length-delimited frames over TCP.
 
 The reference multiplexes three planes over QUIC (SURVEY.md §5: datagrams =
 SWIM, uni streams = broadcast, bi streams = sync) with a cached
 connection-per-addr pool (corro-agent/src/transport.rs:26-63). Python's
-stdlib has no QUIC, so the host agent uses TCP with the same plane split:
+stdlib has no QUIC, so the host agent keeps the same plane split:
 
-- one-shot frames for SWIM packets and broadcast changesets (send_frame,
-  pooled connections, reconnect-once semantics like transport.rs:75-89);
-- a request/stream exchange for sync sessions (open_session), the bi-stream
-  analogue of peer.rs:925-1527.
+- an **unreliable datagram plane** for SWIM packets (send_datagram — one
+  UDP socket bound beside the TCP gossip port, ≤1178 B per packet like
+  foca's max_packet_size, broadcast/mod.rs:710). UDP sends never connect
+  and never block, so a black-holing peer cannot stall the probe loop;
+  oversized or UDP-less sends fall back to the stream plane transparently.
+- one-shot stream frames for broadcast changesets (send_frame, pooled
+  connections, reconnect-once semantics like transport.rs:75-89);
+- a request/stream exchange for sync sessions (open_session), the
+  bi-stream analogue of peer.rs:925-1527.
 
-Frames are 4-byte big-endian length + a kind byte + body. Kind 1 is the
-compact binary codec (the speedy-encoding role of
+Per-addr **circuit breaker**: a peer whose sends keep failing (or whose
+connect black-holes past the timeout) trips open after
+``BREAKER_THRESHOLD`` consecutive failures and fails fast for an
+exponentially growing cooldown — the transport-level complement of the
+reference's reconnect-once + backoff (transport.rs:75-89). Without it a
+SYN-dropping peer costs every caller the full connect timeout.
+
+Frames are 4-byte big-endian length + a kind byte + body (datagrams carry
+kind + body without the length prefix — the packet delimits itself). Kind 1
+is the compact binary codec (the speedy-encoding role of
 corro-types/src/broadcast.rs), encoded by the native runtime
 (corrosion_tpu/_native) when built; kind 0 is JSON with bytes values as
 {"$b": hex}, the encode fallback without a C toolchain. Decoding accepts
@@ -24,14 +37,22 @@ from __future__ import annotations
 import asyncio
 import json
 import struct
+import time
 from typing import Any, Callable, Awaitable
 
 from corrosion_tpu import native as _native
 
 MAX_FRAME = 32 * 1024 * 1024
+MAX_DATAGRAM = 1178  # foca max_packet_size (broadcast/mod.rs:710)
 
 FRAME_JSON = 0
 FRAME_BIN = 1
+
+# Circuit breaker: consecutive failures before tripping, and the cooldown
+# schedule (doubles per further failure, capped).
+BREAKER_THRESHOLD = 3
+BREAKER_BASE_S = 1.0
+BREAKER_MAX_S = 30.0
 
 
 def encode_value(o: Any) -> Any:
@@ -160,50 +181,184 @@ async def read_frame(reader: asyncio.StreamReader) -> dict | None:
     return decode_frame_body(body)
 
 
+class Breaker:
+    """Per-peer circuit breaker state (see module docstring)."""
+
+    __slots__ = ("fails", "open_until")
+
+    def __init__(self) -> None:
+        self.fails = 0
+        self.open_until = 0.0
+
+    def available(self) -> bool:
+        return time.monotonic() >= self.open_until
+
+    def ok(self) -> None:
+        self.fails = 0
+        self.open_until = 0.0
+
+    def fail(self) -> None:
+        self.fails += 1
+        if self.fails >= BREAKER_THRESHOLD:
+            over = self.fails - BREAKER_THRESHOLD
+            cooldown = min(BREAKER_BASE_S * (2.0 ** over), BREAKER_MAX_S)
+            self.open_until = time.monotonic() + cooldown
+
+
+class _DatagramPlane(asyncio.DatagramProtocol):
+    """Inbound side of the UDP gossip socket; frames dispatch to the same
+    handler as stream frames, with a reply-less session."""
+
+    # In-flight dispatch cap: past this, inbound packets drop (the
+    # unreliable plane's legitimate response to a flood).
+    MAX_PENDING = 1024
+
+    def __init__(self, handler) -> None:
+        self._handler = handler
+        self.transport: asyncio.DatagramTransport | None = None
+        # Strong refs: the event loop only weak-refs tasks, and a GC'd
+        # dispatch task would silently swallow a ping/ack.
+        self._pending: set[asyncio.Task] = set()
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        if len(self._pending) >= self.MAX_PENDING:
+            return  # flood: drop like any saturated datagram socket
+        try:
+            msg = decode_frame_body(data)
+        except (ValueError, UnicodeDecodeError):
+            return  # malformed packet: drop (unreliable plane)
+        task = asyncio.ensure_future(self._dispatch(msg))
+        self._pending.add(task)
+        task.add_done_callback(self._pending.discard)
+
+    async def _dispatch(self, msg: dict) -> None:
+        try:
+            await self._handler(DatagramSession(), msg)
+        except Exception:
+            pass  # handler errors must not kill the UDP protocol
+
+
+class DatagramSession:
+    """Session stand-in for datagram-delivered frames: replies flow via
+    explicit peer addresses (SWIM carries from_addr), never the session."""
+
+    async def send(self, msg: dict) -> None:
+        raise ConnectionError("datagram session cannot stream replies")
+
+    async def recv(self, timeout: float = 0.0) -> None:
+        return None
+
+    def close(self) -> None:
+        pass
+
+
 class Transport:
-    """Pooled one-shot sender + session opener + inbound server.
+    """Pooled one-shot sender + datagram plane + session opener + server.
 
     Optional TLS (agent/tls.py): pass an ``ssl.SSLContext`` for the server
     (inbound gossip) and/or client (outbound) side — the rustls configs of
-    peer.rs:132-313. mTLS comes from the contexts themselves.
+    peer.rs:132-313. mTLS comes from the contexts themselves. With TLS the
+    datagram plane is disabled (plaintext UDP would downgrade the gossip
+    plane; QUIC datagrams in the reference are encrypted) and SWIM rides
+    the TLS stream path.
     """
 
-    def __init__(self, ssl_server=None, ssl_client=None) -> None:
+    def __init__(
+        self,
+        ssl_server=None,
+        ssl_client=None,
+        connect_timeout: float = 3.0,
+        send_timeout: float = 5.0,
+    ) -> None:
         self._pool: dict[tuple[str, int], tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
         self._locks: dict[tuple[str, int], asyncio.Lock] = {}
+        self._breakers: dict[tuple[str, int], Breaker] = {}
         self._server: asyncio.AbstractServer | None = None
+        self._udp: asyncio.DatagramTransport | None = None
         self._ssl_server = ssl_server
         self._ssl_client = ssl_client
+        self.connect_timeout = connect_timeout
+        # Blocking-send abort (the reference aborts a sync send blocked
+        # > 5 s, peer.rs:352-355; same guard here for any frame send).
+        self.send_timeout = send_timeout
+
+    # -- circuit breaker -----------------------------------------------------
+
+    def breaker(self, addr: tuple[str, int]) -> Breaker:
+        br = self._breakers.get(addr)
+        if br is None:
+            br = self._breakers[addr] = Breaker()
+        return br
 
     # -- outbound ------------------------------------------------------------
 
+    def send_datagram(self, addr: tuple[str, int], msg: dict) -> bool:
+        """Unreliable, non-blocking single-packet send (the SWIM plane,
+        Transport::send_datagram, transport.rs:66-90). Returns False and
+        falls back to nothing when the packet exceeds MAX_DATAGRAM or the
+        UDP socket is absent — callers needing delivery-or-fallback use
+        ``send_packet``."""
+        if self._udp is None:
+            return False
+        body = encode_frame(msg)[4:]  # kind + payload; packet self-delimits
+        if len(body) > MAX_DATAGRAM:
+            return False
+        try:
+            self._udp.sendto(body, addr)
+            return True
+        except OSError:
+            return False
+
+    async def send_packet(self, addr: tuple[str, int], msg: dict) -> bool:
+        """SWIM packet send: datagram when possible, stream fallback for
+        oversized packets (bootstrap `known` dumps) or UDP-less/TLS mode."""
+        if self.send_datagram(addr, msg):
+            return True
+        return await self.send_frame(addr, msg)
+
     async def send_frame(self, addr: tuple[str, int], msg: dict) -> bool:
-        """Fire-and-forget frame (datagram/uni-stream analogue). One retry
-        with a fresh connection on failure (transport.rs:75-89)."""
+        """Fire-and-forget frame (uni-stream analogue). One retry with a
+        fresh connection on failure (transport.rs:75-89); fails fast while
+        the peer's circuit breaker is open."""
+        br = self.breaker(addr)
+        if not br.available():
+            return False
         lock = self._locks.setdefault(addr, asyncio.Lock())
         async with lock:
+            if not br.available():
+                return False  # tripped while we waited on the lock
             for attempt in (0, 1):
                 try:
                     _, writer = await self._conn(addr, fresh=attempt > 0)
                     writer.write(encode_frame(msg))
-                    await writer.drain()
+                    await asyncio.wait_for(writer.drain(), self.send_timeout)
+                    br.ok()
                     return True
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     self._drop(addr)
+        br.fail()
         return False
 
     async def open_session(
         self, addr: tuple[str, int], first: dict, timeout: float = 10.0
     ) -> "Session | None":
         """Dedicated connection for a sync exchange (bi-stream analogue)."""
+        br = self.breaker(addr)
+        if not br.available():
+            return None
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(*addr, ssl=self._ssl_client), timeout
             )
             writer.write(encode_frame(first))
             await writer.drain()
+            br.ok()
             return Session(reader, writer)
         except (ConnectionError, OSError, asyncio.TimeoutError):
+            br.fail()
             return None
 
     async def _conn(self, addr, fresh=False):
@@ -211,7 +366,8 @@ class Transport:
             self._drop(addr)
         if addr not in self._pool:
             reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(*addr, ssl=self._ssl_client), 5.0
+                asyncio.open_connection(*addr, ssl=self._ssl_client),
+                self.connect_timeout,
             )
             self._pool[addr] = (reader, writer)
         return self._pool[addr]
@@ -233,7 +389,9 @@ class Transport:
         handler: Callable[["Session", dict], Awaitable[None]],
     ) -> tuple[str, int]:
         """Accept connections; dispatch each inbound frame to ``handler``.
-        The handler may keep the session for a streaming exchange."""
+        The handler may keep the session for a streaming exchange. Also
+        binds the UDP datagram plane on the same port (plaintext mode
+        only); if the UDP bind fails, gossip degrades to stream-only."""
 
         async def on_conn(reader, writer):
             session = Session(reader, writer)
@@ -254,11 +412,22 @@ class Transport:
             on_conn, host, port, ssl=self._ssl_server
         )
         sock = self._server.sockets[0].getsockname()
+        if self._ssl_server is None:
+            try:
+                loop = asyncio.get_running_loop()
+                self._udp, _ = await loop.create_datagram_endpoint(
+                    lambda: _DatagramPlane(handler),
+                    local_addr=(sock[0], sock[1]),
+                )
+            except OSError:
+                self._udp = None
         return sock[0], sock[1]
 
     def close(self) -> None:
         for addr in list(self._pool):
             self._drop(addr)
+        if self._udp is not None:
+            self._udp.close()
         if self._server is not None:
             self._server.close()
 

@@ -45,3 +45,25 @@ def chunk_changes(
 
 def max_seq(rows: list[Change], default: int = 0) -> int:
     return max((r.seq for r in rows), default=default)
+
+
+class AdaptiveChunker:
+    """Adaptive sync chunk sizing (peer.rs:352-355, 638-653): the server
+    halves its chunk byte target whenever a send takes longer than the
+    threshold (500 ms in the reference), floored at 1 KiB — a slow or
+    congested peer gets smaller messages instead of head-of-line blocking.
+    """
+
+    def __init__(
+        self,
+        max_bytes: int = MAX_CHANGES_BYTE_SIZE,
+        min_bytes: int = 1024,
+        threshold_s: float = 0.5,
+    ) -> None:
+        self.max_bytes = max_bytes
+        self.min_bytes = min_bytes
+        self.threshold_s = threshold_s
+
+    def record(self, send_seconds: float) -> None:
+        if send_seconds > self.threshold_s:
+            self.max_bytes = max(self.min_bytes, self.max_bytes // 2)

@@ -297,12 +297,17 @@ def test_subscription_restored_after_restart(tmp_path):
             assert restored is not None, "sub must survive restart"
             assert restored.sql == "SELECT id, text FROM tests"
             assert restored.change_id >= 1  # watermark restored
-            assert restored.rows  # initial snapshot re-ran on restored data
-            # Resume from 0 (before the watermark, history gone): snapshot.
+            assert restored.rows  # snapshot restored from the sub-db
+            # Durable history: resume from 0 REPLAYS the pre-restart
+            # events from the sub-db instead of a snapshot restart
+            # (pubsub.rs:806-841 sub-db semantics).
             events = restored.backlog(from_change=0)
             kinds = [e.to_json_obj() for e in events]
             assert any("columns" in k for k in kinds)
-            assert any("eoq" in k for k in kinds)
+            assert any(
+                "change" in k and k["change"][0] == "insert" for k in kinds
+            ), "pre-restart events must replay from the durable log"
+            assert not any("eoq" in k for k in kinds)  # not a snapshot
             # New changes keep numbering past the restored watermark.
             before = restored.change_id
             await b.client.execute(
@@ -441,37 +446,44 @@ def test_api_concurrency_load_shed(tmp_path):
     async def main():
         a = await launch_test_agent(str(tmp_path / "a"), api_concurrency=2)
         try:
-            # Two open subscription streams occupy the route's two slots.
+            # Long-lived subscription STREAMS do not hold admission slots:
+            # the reference's ConcurrencyLimitLayer releases its permit when
+            # the handler returns, before the body streams — the N+1th
+            # subscriber must work, not shed (tower semantics,
+            # agent.rs:836-902).
             s1 = await a.client.subscribe("SELECT id FROM tests")
             s2 = await a.client.subscribe("SELECT text FROM tests")
+            s3 = await a.client.subscribe("SELECT id, text FROM tests")
             from corrosion_tpu.client import ApiError
 
+            # The limit bounds request SETUP: with both slots held by
+            # in-flight setups, the next request sheds 503.
+            limit = a.agent._api_limits["/v1/subscriptions"]
+            limit.__enter__()
+            limit.__enter__()
             try:
-                await a.client.subscribe("SELECT id, text FROM tests")
-                raise AssertionError("third stream should shed")
+                await a.client.subscribe("SELECT text FROM tests2")
+                raise AssertionError("over-limit setup should shed")
             except ApiError as e:
                 assert e.status == 503
-            # Other routes have their own limits: writes still work.
-            resp = await a.client.execute(
-                [["INSERT INTO tests (id, text) VALUES (1, 'x')"]]
-            )
-            assert resp["results"][0]["rows_affected"] == 1
+            finally:
+                limit.__exit__()
+                limit.__exit__()
+            # Other routes have their own limits: writes still work even
+            # while the subscriptions route is saturated.
+            limit.__enter__()
+            limit.__enter__()
+            try:
+                resp = await a.client.execute(
+                    [["INSERT INTO tests (id, text) VALUES (1, 'x')"]]
+                )
+                assert resp["results"][0]["rows_affected"] == 1
+            finally:
+                limit.__exit__()
+                limit.__exit__()
             s1.close()
             s2.close()
-
-            # Slots free asynchronously (the server notices the closed
-            # connection when its stream write fails); poll for reuse.
-            async def slot_free():
-                try:
-                    s3 = await a.client.subscribe(
-                        "SELECT id, text FROM tests"
-                    )
-                except ApiError:
-                    return False
-                s3.close()
-                return True
-
-            await poll_until(slot_free, timeout=10.0)
+            s3.close()
         finally:
             await a.stop()
 
@@ -552,5 +564,67 @@ def test_bootstrap_announcer_retries_until_join(tmp_path):
         finally:
             socket.getaddrinfo = orig
             await a.stop()
+
+    run(main())
+
+
+def test_subscription_replays_events_missed_while_down(tmp_path):
+    """The verdict's durable-history acceptance test: a subscriber that
+    disconnects, misses writes across an agent RESTART, and reconnects
+    with ?from= receives the missed events — not a snapshot restart
+    (pubsub.rs:735-771 restore + 806-841 durable sub-db)."""
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        try:
+            handle = a.agent.subs.subscribe("SELECT id, text FROM tests")
+            handle_id = handle.id
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'one')"]]
+            )
+
+            async def seen():
+                h = a.agent.subs.get(handle_id)
+                return h is not None and h.change_id >= 1
+
+            await poll_until(seen, timeout=10)
+            resume_from = a.agent.subs.get(handle_id).change_id
+        finally:
+            await a.stop()
+
+        # Mutate the data while "down" via a second agent instance on the
+        # same dir (simulates changes the subscriber missed: an insert, an
+        # update, and a delete).
+        b = await launch_test_agent(str(tmp_path / "a"))
+        try:
+            # Separate transactions: same-batch insert+delete of one row
+            # coalesces to no event (batch-level diffing, like the
+            # reference's per-batch handle_candidates).
+            await b.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (2, 'two')"]]
+            )
+            await b.client.execute(
+                [["UPDATE tests SET text = 'ONE' WHERE id = 1"]]
+            )
+            await b.client.execute([["DELETE FROM tests WHERE id = 2"]])
+
+            async def advanced():
+                h = b.agent.subs.get(handle_id)
+                return h is not None and h.change_id > resume_from
+
+            await poll_until(advanced, timeout=10)
+            restored = b.agent.subs.get(handle_id)
+            events = restored.backlog(from_change=resume_from)
+            objs = [e.to_json_obj() for e in events]
+            changes = [o["change"] for o in objs if "change" in o]
+            kinds = [c[0] for c in changes]
+            # The missed insert/update/delete all replay, in order, with
+            # monotonically increasing change ids after the resume point.
+            assert "insert" in kinds and "update" in kinds and "delete" in kinds
+            ids = [c[3] for c in changes]
+            assert ids == sorted(ids) and ids[0] > resume_from
+            assert not any("eoq" in o for o in objs), "must not snapshot-restart"
+        finally:
+            await b.stop()
 
     run(main())

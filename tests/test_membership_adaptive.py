@@ -1,0 +1,102 @@
+"""Cluster-size-adaptive SWIM config + down-member GC.
+
+The reference resizes foca's config on cluster-size notifications
+(agent.rs:1345-1358 → make_foca_config, broadcast/mod.rs:704-713) and
+forgets down members after remove_down_after (48 h WAN preset). Host and
+kernel sides both implement the semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.agent.membership import ALIVE, DOWN, Members, Swim
+from corrosion_tpu.ops import swim as swim_ops
+from corrosion_tpu.ops import swim_sparse
+from corrosion_tpu.ops.swim import SEV_DOWN, SwimConfig, pack, packed_sev
+
+
+async def _noop_send(addr, msg):
+    return True
+
+
+def _swim(members) -> Swim:
+    return Swim(members, ("127.0.0.1", 0), _noop_send, max_transmissions=4)
+
+
+def test_config_adapts_3_to_32():
+    members = Members("me")
+    sw = _swim(members)
+    for i in range(2):  # 3-node cluster (self + 2)
+        members.apply_update(f"{i:032x}", ("10.0.0.1", i + 1), ALIVE, 0)
+    asyncio.run(sw.probe_round())
+    tx_small = sw.max_transmissions
+    ind_small = sw.indirect_probes
+    for i in range(2, 31):  # grow to 32
+        members.apply_update(f"{i:032x}", ("10.0.0.1", i + 1), ALIVE, 0)
+    asyncio.run(sw.probe_round())
+    assert sw.max_transmissions > tx_small  # ~1.5·log2(n) growth
+    assert sw.indirect_probes >= ind_small
+    assert sw.max_transmissions >= 7  # ceil(1.5·log2(33))
+
+
+def test_host_down_member_gc():
+    members = Members("me")
+    sw = _swim(members)
+    sw.down_gc_s = 0.05
+    members.apply_update("aa" * 16, ("10.0.0.1", 1), ALIVE, 0)
+    members.apply_update("bb" * 16, ("10.0.0.2", 1), ALIVE, 0)
+    members.apply_update("bb" * 16, ("10.0.0.2", 1), DOWN, 0)
+    assert "bb" * 16 in members.states
+    time.sleep(0.1)
+    asyncio.run(sw.probe_round())
+    assert "bb" * 16 not in members.states  # horizon passed: forgotten
+    assert "aa" * 16 in members.states  # alive member untouched
+
+
+def test_dense_kernel_down_gc():
+    cfg = SwimConfig(n_nodes=8, down_gc_rounds=1)  # forget every round
+    state = swim_ops.init_state(cfg)
+    # Everyone believes node 3 is down at incarnation 2.
+    view = state.view.at[:, 3].set(pack(jnp.uint32(2), SEV_DOWN))
+    state = state._replace(view=view, alive=state.alive.at[3].set(False))
+    state = swim_ops.swim_round(
+        state, jax.random.PRNGKey(0), jnp.int32(0), cfg
+    )
+    assert not bool(
+        jnp.any(packed_sev(state.view[:, 3]) == SEV_DOWN)
+    ), "down beliefs must be forgotten at the GC horizon"
+
+
+def test_sparse_kernel_down_gc_frees_slots():
+    cfg = SwimConfig(n_nodes=8, view_capacity=4, down_gc_rounds=1)
+    state = swim_sparse.init_state(cfg)
+    exc_tgt = state.exc_tgt.at[:, 0].set(3)
+    exc_pkd = state.exc_pkd.at[:, 0].set(pack(jnp.uint32(2), SEV_DOWN))
+    state = state._replace(
+        exc_tgt=exc_tgt, exc_pkd=exc_pkd, alive=state.alive.at[3].set(False)
+    )
+    state = swim_sparse.swim_round(
+        state, jax.random.PRNGKey(0), jnp.int32(0), cfg
+    )
+    down_slots = (packed_sev(state.exc_pkd) == SEV_DOWN) & (state.exc_tgt == 3)
+    assert not bool(jnp.any(down_slots)), "GC must free the down slots"
+
+
+def test_sparse_gc_disabled_keeps_down():
+    cfg = SwimConfig(n_nodes=8, view_capacity=4, down_gc_rounds=0)
+    state = swim_sparse.init_state(cfg)
+    exc_tgt = state.exc_tgt.at[:, 0].set(3)
+    exc_pkd = state.exc_pkd.at[:, 0].set(pack(jnp.uint32(2), SEV_DOWN))
+    state = state._replace(
+        exc_tgt=exc_tgt, exc_pkd=exc_pkd, alive=state.alive.at[3].set(False)
+    )
+    state = swim_sparse.swim_round(
+        state, jax.random.PRNGKey(0), jnp.int32(0), cfg
+    )
+    kept = (packed_sev(state.exc_pkd) == SEV_DOWN) & (state.exc_tgt == 3)
+    assert bool(jnp.any(kept))

@@ -233,7 +233,7 @@ def test_agent_prometheus_endpoint(tmp_path):
     async def main():
         a = await launch_test_agent(
             str(tmp_path / "a"), prometheus_addr="127.0.0.1:0",
-            compact_interval=0.4,  # metrics_loop samples every 0.25 s floor
+            metrics_interval=0.25,  # test-speed sampling cadence
         )
         try:
             await a.client.execute(

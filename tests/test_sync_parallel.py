@@ -1,0 +1,221 @@
+"""Concurrent multi-peer sync: parallel sessions, in-flight dedup,
+adaptive chunk sizing, stall abort, server budget.
+
+Mirrors the reference's parallel_sync machinery (peer.rs:925-1286:
+FuturesUnordered sessions, 10-needs/turn scheduling with in-flight dedup
+peer.rs:1108-1223, 8 KiB→1 KiB chunk halving past 500 ms sends
+peer.rs:352-355,638-653, bounded server jobs peer.rs:675-686).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from corrosion_tpu.agent.agent import Agent, AgentConfig
+from corrosion_tpu.agent.testing import TEST_SCHEMA, launch_test_agent, poll_until
+from corrosion_tpu.core.bookkeeping import FullNeed, PartialNeed
+from corrosion_tpu.core.changes import AdaptiveChunker
+from corrosion_tpu.core.values import Statement
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_agent(tmp_path) -> Agent:
+    return Agent(AgentConfig(data_dir=str(tmp_path), schema_sql=TEST_SCHEMA))
+
+
+class FakeSession:
+    """Scripted server-side session: feeds frames to recv, records sends."""
+
+    def __init__(self, script, send_delay: float = 0.0):
+        self.script = list(script)
+        self.frames = []
+        self.send_delay = send_delay
+        self.closed = False
+
+    async def send(self, frame):
+        if self.send_delay:
+            await asyncio.sleep(self.send_delay)
+        self.frames.append(frame)
+
+    async def recv(self, timeout: float = 0.0):
+        if self.script:
+            return self.script.pop(0)
+        return None
+
+    def close(self):
+        self.closed = True
+
+
+def test_adaptive_chunker_halves_and_floors():
+    c = AdaptiveChunker(max_bytes=8192, min_bytes=1024, threshold_s=0.5)
+    c.record(0.1)
+    assert c.max_bytes == 8192  # fast send: unchanged
+    c.record(0.6)
+    assert c.max_bytes == 4096  # slow send: halved
+    for _ in range(10):
+        c.record(1.0)
+    assert c.max_bytes == 1024  # floored at the reference's 1 KiB minimum
+
+
+def test_claim_needs_dedups_across_sessions(tmp_path):
+    a = make_agent(tmp_path)
+    try:
+        in_flight: set = set()
+        needs = {"aa" * 16: [FullNeed(1, 25)], "bb" * 16: [PartialNeed(3, [(0, 5)])]}
+        wave1, keys1 = a._claim_needs(needs, in_flight, cap=2)
+        # Grid-aligned blocks: [1,10], [11,20] claimed first.
+        assert [
+            (n.start, n.end) for n in wave1["aa" * 16]
+        ] == [(1, 10), (11, 20)]
+        # A concurrent session computing the SAME needs gets only what's
+        # left — no overlap with session 1's claims.
+        wave2, keys2 = a._claim_needs(needs, in_flight, cap=10)
+        got2 = [(n.start, n.end) for n in wave2.get("aa" * 16, [])]
+        assert got2 == [(21, 25)]
+        assert any(isinstance(n, PartialNeed) for n in wave2["bb" * 16])
+        assert not (set(keys1) & set(keys2))
+        # Releasing session 1's claims makes its blocks requestable again.
+        for k in keys1:
+            in_flight.discard(k)
+        wave3, _ = a._claim_needs(needs, in_flight, cap=10)
+        assert [(n.start, n.end) for n in wave3["aa" * 16]] == [(1, 10), (11, 20)]
+    finally:
+        a.store.close()
+
+
+def test_serve_need_shrinks_chunks_on_slow_sends(tmp_path):
+    a = make_agent(tmp_path)
+    try:
+        # One big multi-row transaction so chunking has something to split.
+        a.execute(
+            [
+                Statement(
+                    "INSERT INTO tests (id, text) VALUES (?, ?)",
+                    params=[i, "x" * 200],
+                )
+                for i in range(60)
+            ]
+        )
+        booked = a.bookie.for_actor(a.actor_id)
+
+        async def main():
+            chunker = AdaptiveChunker(
+                max_bytes=8192, min_bytes=1024, threshold_s=0.01
+            )
+            s = FakeSession([], send_delay=0.02)  # every send is "slow"
+            await a._serve_need(
+                s, a.actor_id, booked, FullNeed(1, 1), chunker=chunker
+            )
+            return chunker, s
+
+        chunker, s = run(main())
+        # The chunk target observably shrank below the 8 KiB start.
+        assert chunker.max_bytes < 8192
+        assert any(f["t"] == "sync_changes" for f in s.frames)
+    finally:
+        a.store.close()
+
+
+def test_serve_sync_budget_bounds_a_wave(tmp_path):
+    a = make_agent(tmp_path)
+    a.cfg.sync_serve_budget = 5
+    try:
+        for i in range(12):
+            a.execute(
+                [Statement("INSERT INTO tests (id, text) VALUES (?, 'x')",
+                           params=[i])]
+            )
+        wire_needs = {a.actor_id: [{"full": [1, 12]}]}
+
+        async def main():
+            s = FakeSession(
+                [{"t": "sync_request", "needs": wire_needs},
+                 {"t": "sync_finish"}]
+            )
+            await a._serve_sync(s, {"t": "sync_start", "actor": "cc" * 16})
+            return s.frames
+
+        frames = run(main())
+        waves = [f for f in frames if f["t"] == "sync_wave_done"]
+        assert waves and waves[0]["served"] == 5  # budget, not 12
+        versions = {f["version"] for f in frames if f["t"] == "sync_changes"}
+        assert len(versions) == 5  # a huge request cannot monopolize a wave
+        assert frames[-1]["t"] == "sync_done"
+    finally:
+        a.store.close()
+
+
+def test_slow_peer_does_not_delay_fast_peer(tmp_path):
+    """The verdict's acceptance test: with sessions concurrent, a slow
+    peer's sync cannot delay the data arriving from a fast peer."""
+
+    async def main():
+        # Dissemination via sync only: broadcasts effectively disabled.
+        kw = dict(broadcast_interval=3600.0, sync_interval=0.3, sync_peers=3)
+        a = await launch_test_agent(str(tmp_path / "a"), **kw)
+        b = await launch_test_agent(
+            str(tmp_path / "b"), bootstrap=[a.gossip_addr], **kw
+        )
+        c = await launch_test_agent(
+            str(tmp_path / "c"), bootstrap=[a.gossip_addr], **kw
+        )
+        try:
+            await poll_until(
+                lambda: asyncio.sleep(
+                    0, result=len(a.agent.members.alive()) >= 2
+                )
+            )
+            # Both peers get data; C's sessions are slowed 1 s per frame.
+            await b.client.execute(
+                [[f"INSERT INTO tests (id, text) VALUES ({i}, 'fast')"]
+                 for i in range(5)]
+            )
+            await c.client.execute(
+                [[f"INSERT INTO tests2 (id, text) VALUES ({i}, 'slow')"]
+                 for i in range(5)]
+            )
+
+            c_addr = c.agent.gossip_addr
+            orig_open = a.agent.transport.open_session
+
+            async def slow_open(addr, first, timeout=10.0):
+                session = await orig_open(addr, first, timeout)
+                if session is not None and addr == c_addr:
+                    orig_recv = session.recv
+
+                    async def slow_recv(timeout=30.0):
+                        await asyncio.sleep(1.0)
+                        return await orig_recv(timeout)
+
+                    session.recv = slow_recv
+                return session
+
+            a.agent.transport.open_session = slow_open
+
+            t0 = time.monotonic()
+
+            async def fast_rows():
+                _, rows = await a.client.query("SELECT count(*) FROM tests")
+                return rows[0][0] == 5
+
+            await poll_until(fast_rows, timeout=10.0)
+            fast_t = time.monotonic() - t0
+            # B's 5 versions must land well before C's 1 s/frame sessions
+            # could have finished even one wave (state+5 waves ≥ 5 s).
+            assert fast_t < 4.0
+
+            async def slow_rows():
+                _, rows = await a.client.query("SELECT count(*) FROM tests2")
+                return rows[0][0] == 5
+
+            await poll_until(slow_rows, timeout=30.0)  # C still completes
+        finally:
+            await c.stop()
+            await b.stop()
+            await a.stop()
+
+    run(main())

@@ -1,0 +1,190 @@
+"""Dissemination-path resilience: black-holing peers, circuit breaker,
+datagram SWIM plane.
+
+The reference never lets one unresponsive peer stall gossip: SWIM packets
+ride unreliable QUIC datagrams (broadcast/mod.rs:710, transport.rs:66-90)
+and broadcast transmits are spawned tasks (broadcast/mod.rs:741-756).
+These tests pin the same properties on the host agent: a peer that
+accepts nothing (SYN black hole, modeled as a connect that never
+completes) must not affect probe cadence or broadcast latency to healthy
+peers, and its repeated failures must trip a fail-fast breaker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from corrosion_tpu.agent.membership import ALIVE
+from corrosion_tpu.agent.testing import launch_test_agent, poll_until
+from corrosion_tpu.agent.transport import (
+    BREAKER_THRESHOLD,
+    MAX_DATAGRAM,
+    Breaker,
+    Transport,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+BLACKHOLE = ("127.0.0.1", 1)
+
+
+def _blackhole_conn(transport: Transport):
+    """Patch ``transport`` so connects to BLACKHOLE behave like a dropped
+    SYN (never refused, never completed): they burn the full connect
+    timeout, then time out — the same behavior the real ``_conn`` has
+    against an unroutable peer."""
+    orig = transport._conn
+
+    async def conn(addr, fresh=False):
+        if addr == BLACKHOLE:
+            await asyncio.sleep(transport.connect_timeout)
+            raise asyncio.TimeoutError
+        return await orig(addr, fresh)
+
+    transport._conn = conn
+
+
+def test_breaker_trips_and_recovers():
+    br = Breaker()
+    assert br.available()
+    for _ in range(BREAKER_THRESHOLD - 1):
+        br.fail()
+    assert br.available()  # below threshold: still closed
+    br.fail()
+    assert not br.available()  # tripped
+    br.ok()
+    assert br.available()  # success resets
+
+
+def test_send_frame_fails_fast_once_tripped(tmp_path):
+    async def main():
+        t = Transport(connect_timeout=0.3)
+        _blackhole_conn(t)
+        for _ in range(BREAKER_THRESHOLD):
+            assert not await t.send_frame(BLACKHOLE, {"t": "x"})
+        # Breaker open: the next send must not wait out the connect timeout.
+        t0 = time.monotonic()
+        assert not await t.send_frame(BLACKHOLE, {"t": "x"})
+        assert time.monotonic() - t0 < 0.05
+        # open_session consults the same breaker.
+        assert await t.open_session(BLACKHOLE, {"t": "sync_start"}) is None
+        t.close()
+
+    run(main())
+
+
+def test_blackhole_peer_does_not_stall_broadcast_or_probes(tmp_path):
+    """The verdict's acceptance test: with a never-ACKing peer in the
+    member list, broadcast latency to healthy peers and the SWIM probe
+    cadence stay unaffected."""
+
+    async def main():
+        a = await launch_test_agent(
+            str(tmp_path / "a"), probe_interval=0.1, broadcast_interval=0.05
+        )
+        b = await launch_test_agent(
+            str(tmp_path / "b"), bootstrap=[a.gossip_addr],
+            probe_interval=0.1, broadcast_interval=0.05,
+        )
+        try:
+            await poll_until(
+                lambda: asyncio.sleep(
+                    0, result=len(a.agent.members.alive()) >= 1
+                    and len(b.agent.members.alive()) >= 1
+                )
+            )
+            # Inject the black hole: connects to it hang, datagrams vanish.
+            _blackhole_conn(a.agent.transport)
+            a.agent.transport._udp = None  # drop its datagram path too
+            a.agent.members.apply_update("ff" * 16, BLACKHOLE, ALIVE, 0)
+
+            # Broadcast latency to the healthy peer must stay sub-second
+            # even though every pending entry also targets the black hole.
+            t0 = time.monotonic()
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (42, 'bh')"]]
+            )
+
+            async def visible():
+                cols, rows = await b.client.query("SELECT id FROM tests")
+                return any(r[0] == 42 for r in rows)
+
+            await poll_until(visible, timeout=5.0)
+            assert time.monotonic() - t0 < 3.0
+
+            # Probe cadence: b must stay ALIVE in a's view across several
+            # probe intervals with the black hole present (no stalled SWIM
+            # loop would let the suspect timer fire spuriously).
+            await asyncio.sleep(1.0)
+            states = {
+                m.actor_id: m.state for m in a.agent.members.alive()
+            }
+            assert states.get(b.agent.actor_id) == ALIVE
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+def test_swim_rides_datagrams(tmp_path):
+    """Membership converges with the stream path disabled entirely —
+    proving SWIM actually uses the UDP datagram plane."""
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"), probe_interval=0.1)
+        b = await launch_test_agent(
+            str(tmp_path / "b"), bootstrap=[a.gossip_addr],
+            probe_interval=0.1,
+        )
+        assert a.agent.transport._udp is not None
+
+        # Datagram-size budget: a ping with piggybacked rumors fits foca's
+        # packet budget.
+        from corrosion_tpu.agent.transport import encode_frame
+
+        ping = {
+            "t": "swim", "k": "ping", "seq": 1,
+            "from": a.agent.actor_id,
+            "from_addr": list(a.gossip_addr),
+            "inc": 0,
+            "updates": [
+                {"id": "ab" * 16, "addr": ["10.0.0.1", 65535],
+                 "state": "alive", "inc": 2**31}
+                for _ in range(8)
+            ],
+        }
+        assert len(encode_frame(ping)[4:]) <= MAX_DATAGRAM
+
+        try:
+            await poll_until(
+                lambda: asyncio.sleep(
+                    0, result=len(b.agent.members.alive()) >= 1
+                )
+            )
+            # Cut the stream plane on both sides; probes must keep flowing
+            # (b stays alive at a, rtts keep accumulating).
+            for t in (a.agent.transport, b.agent.transport):
+                async def no_stream(addr, msg, _t=t):
+                    return False
+
+                t.send_frame = no_stream
+            m = a.agent.members.states.get(b.agent.actor_id)
+            n0 = len(m.rtts) if m else 0
+
+            async def rtts_grew():
+                mm = a.agent.members.states.get(b.agent.actor_id)
+                return mm is not None and len(mm.rtts) > n0
+
+            await poll_until(rtts_grew, timeout=5.0)
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
