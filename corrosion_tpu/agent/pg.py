@@ -33,12 +33,43 @@ if TYPE_CHECKING:
 SSL_REQUEST = 80877103
 PROTOCOL_V3 = 196608
 TEXT_OID = 25
+BOOL_OID = 16
+BYTEA_OID = 17
+INT2_OID, INT4_OID, INT8_OID = 21, 23, 20
+FLOAT4_OID, FLOAT8_OID = 700, 701
 
 # Parameter OIDs we coerce from text (ints/floats/bool); everything else
 # stays a string and relies on SQLite column affinity.
 _INT_OIDS = {20, 21, 23, 26}
 _FLOAT_OIDS = {700, 701, 1700}
-_BOOL_OID = 16
+_BOOL_OID = BOOL_OID
+
+
+# SQLSTATE mapping for SQLite error text (the role of corro-pg's
+# sql_state.rs, 1336 LoC of codes; these are the ones SQLite can actually
+# produce through this server).
+_SQLSTATE_PATTERNS = [
+    (re.compile(r"(?i)no such table"), "42P01"),  # undefined_table
+    (re.compile(r"(?i)no such column"), "42703"),  # undefined_column
+    (re.compile(r"(?i)syntax error"), "42601"),  # syntax_error
+    (re.compile(r"(?i)ambiguous column"), "42702"),  # ambiguous_column
+    (re.compile(r"(?i)UNIQUE constraint failed"), "23505"),  # unique_violation
+    (re.compile(r"(?i)NOT NULL constraint failed"), "23502"),  # not_null
+    (re.compile(r"(?i)CHECK constraint failed"), "23514"),  # check_violation
+    (re.compile(r"(?i)FOREIGN KEY constraint failed"), "23503"),  # fk
+    (re.compile(r"(?i)datatype mismatch"), "22P02"),  # invalid_text_rep
+    (re.compile(r"(?i)attempt to write a readonly"), "25006"),  # read_only
+    (re.compile(r"(?i)database is locked"), "55P03"),  # lock_not_available
+    (re.compile(r"(?i)too many terms|parser stack overflow"), "54001"),
+]
+
+
+def sqlstate_for(message: str) -> str:
+    """Best-fit SQLSTATE for an engine error message (sql_state.rs role)."""
+    for pat, code in _SQLSTATE_PATTERNS:
+        if pat.search(message):
+            return code
+    return "XX000"
 
 
 def _msg(tag: bytes, payload: bytes) -> bytes:
@@ -54,28 +85,82 @@ def _error(message: str, code: str = "XX000") -> bytes:
     return _msg(b"E", fields)
 
 
-def _row_description(cols: list[str]) -> bytes:
+def _infer_oids(rows: list, n_cols: int) -> list[int]:
+    """Column type oids from the first non-NULL value per column (SQLite is
+    dynamically typed; drivers want real oids for type mapping)."""
+    oids = [TEXT_OID] * n_cols
+    for c in range(n_cols):
+        for row in rows:
+            v = row[c]
+            if v is None:
+                continue
+            if isinstance(v, bool):
+                oids[c] = BOOL_OID
+            elif isinstance(v, int):
+                oids[c] = INT8_OID
+            elif isinstance(v, float):
+                oids[c] = FLOAT8_OID
+            elif isinstance(v, bytes):
+                oids[c] = BYTEA_OID
+            break
+    return oids
+
+
+def _row_description(
+    cols: list[str], oids: list[int] | None = None,
+    fmts: list[int] | None = None,
+) -> bytes:
     body = struct.pack(">H", len(cols))
-    for name in cols:
+    for i, name in enumerate(cols):
+        oid = oids[i] if oids else TEXT_OID
+        fmt = fmts[i] if fmts else 0
         body += _cstr(name)
-        body += struct.pack(">IhIhih", 0, 0, TEXT_OID, -1, -1, 0)
+        body += struct.pack(">IhIhih", 0, 0, oid, -1, -1, fmt)
     return _msg(b"T", body)
 
 
-def _data_row(row) -> bytes:
+def _encode_binary(v, oid: int) -> bytes:
+    """Binary result encoding per oid (the formats real drivers request)."""
+    if oid == INT8_OID and isinstance(v, int):
+        return struct.pack(">q", v)
+    if oid == INT4_OID and isinstance(v, int):
+        return struct.pack(">i", v)
+    if oid == INT2_OID and isinstance(v, int):
+        return struct.pack(">h", v)
+    if oid == FLOAT8_OID and isinstance(v, (int, float)):
+        return struct.pack(">d", float(v))
+    if oid == FLOAT4_OID and isinstance(v, (int, float)):
+        return struct.pack(">f", float(v))
+    if oid == BOOL_OID:
+        return b"\x01" if v else b"\x00"
+    if isinstance(v, bytes):
+        return v  # bytea binary = raw bytes
+    # text/varchar binary representation == utf-8 text
+    return str(v).encode()
+
+
+def _text_cell(v) -> bytes:
+    if isinstance(v, bytes):
+        return ("\\x" + v.hex()).encode()
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    return str(v).encode()
+
+
+def _data_row(
+    row, rfmts: list[int] | None = None, oids: list[int] | None = None
+) -> bytes:
     body = struct.pack(">H", len(row))
-    for v in row:
+    for i, v in enumerate(row):
         if v is None:
             body += struct.pack(">i", -1)
+            continue
+        fmt = rfmts[i] if rfmts else 0
+        if fmt == 1:
+            raw = _encode_binary(v, oids[i] if oids else TEXT_OID)
         else:
-            if isinstance(v, bytes):
-                text = "\\x" + v.hex()
-            elif isinstance(v, bool):
-                text = "t" if v else "f"
-            else:
-                text = str(v)
-            raw = text.encode()
-            body += struct.pack(">i", len(raw)) + raw
+            raw = _text_cell(v)
+        body += struct.pack(">i", len(raw)) + raw
     return body and _msg(b"D", body)
 
 
@@ -94,8 +179,10 @@ def _is_query(sql: str) -> bool:
 
 
 def translate_pg_sql(sql: str) -> str:
-    """Small PG->SQLite surface translation (corro-pg's parse_query,
-    lib.rs:306-472, collapses to the dialect overlaps that matter here)."""
+    """PG->SQLite surface translation (corro-pg's parse_query,
+    lib.rs:306-472 via sqlparser; here: the dialect constructs drivers and
+    hand-written PG SQL actually emit — session shims, ``::`` casts,
+    boolean literals, ILIKE, E'...' escape strings)."""
     s = sql.strip().rstrip(";")
     upper = s.upper()
     if upper in ("BEGIN", "COMMIT", "ROLLBACK", "START TRANSACTION"):
@@ -106,7 +193,121 @@ def translate_pg_sql(sql: str) -> str:
     # only OUTSIDE string/identifier literals (an INSERT of the literal
     # 'current_user' must pass through untouched).
     s = _sub_unquoted(s, _SESSION_SHIMS)
+    s = _sub_unquoted(s, _DIALECT_SUBS)
+    s = _translate_casts(s)
+    s = _translate_estrings(s)
     return s
+
+
+# PG type name → SQLite CAST target (affinity groups).
+_PG_TYPE_MAP = {
+    "int2": "INTEGER", "int4": "INTEGER", "int8": "INTEGER",
+    "smallint": "INTEGER", "integer": "INTEGER", "int": "INTEGER",
+    "bigint": "INTEGER", "serial": "INTEGER", "bigserial": "INTEGER",
+    "oid": "INTEGER", "bool": "INTEGER", "boolean": "INTEGER",
+    "float4": "REAL", "float8": "REAL", "real": "REAL",
+    "numeric": "REAL", "decimal": "REAL",
+    "text": "TEXT", "varchar": "TEXT", "char": "TEXT", "bpchar": "TEXT",
+    "name": "TEXT", "uuid": "TEXT", "json": "TEXT", "jsonb": "TEXT",
+    "regclass": "TEXT", "regtype": "TEXT",
+    "bytea": "BLOB",
+}
+
+_DIALECT_SUBS = [
+    # Boolean literals → SQLite integers (corro-pg translates via sqlparser).
+    (re.compile(r"(?i)\btrue\b"), "1"),
+    (re.compile(r"(?i)\bfalse\b"), "0"),
+    # SQLite LIKE is already case-insensitive for ASCII.
+    (re.compile(r"(?i)\bilike\b"), "LIKE"),
+]
+
+# `token::type` where token is a quote-terminated literal, number,
+# placeholder, identifier, or closing paren. Paren-closed expressions keep
+# their value and drop the cast (SQLite's dynamic typing absorbs it);
+# simple tokens become CAST(token AS affinity).
+_CAST_RE = re.compile(
+    r"(\)|\?\d*|[A-Za-z_][\w.]*|\d+(?:\.\d+)?)\s*::\s*"
+    r"([A-Za-z_][\w]*)(?:\s*\(\s*\d+\s*\))?"
+)
+
+
+def _translate_casts(sql: str) -> str:
+    def repl(m: re.Match) -> str:
+        token, typ = m.group(1), m.group(2).lower()
+        target = _PG_TYPE_MAP.get(typ)
+        if token == ")" or target is None:
+            return token  # drop the cast, keep the value
+        return f"CAST({token} AS {target})"
+
+    # Merge adjacent quoted segments first: a doubled-quote literal
+    # ('it''s') scans as two adjacent quoted runs, and a cast applied to
+    # it must wrap the WHOLE literal, not the final fragment.
+    parts: list[tuple[bool, str]] = []
+    for quoted, seg in _split_quoted(sql):
+        if quoted and parts and parts[-1][0]:
+            parts[-1] = (True, parts[-1][1] + seg)
+        else:
+            parts.append((quoted, seg))
+    out = []
+    for quoted, seg in parts:
+        if quoted:
+            # A cast can follow a string literal: 'x'::text — handled by
+            # peeking in the NEXT unquoted segment (the '::type' prefix).
+            out.append(seg)
+        else:
+            # Cast applied to the preceding quoted literal.
+            m = re.match(r"\s*::\s*([A-Za-z_][\w]*)(?:\s*\(\s*\d+\s*\))?", seg)
+            if m and out and out[-1].startswith(("'", '"')):
+                typ = m.group(1).lower()
+                target = _PG_TYPE_MAP.get(typ)
+                lit = out.pop()
+                if target is None:
+                    out.append(lit)
+                else:
+                    out.append(f"CAST({lit} AS {target})")
+                seg = seg[m.end():]
+            out.append(_CAST_RE.sub(repl, seg))
+    return "".join(out)
+
+
+_ESCAPES = {
+    "n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f",
+    "\\": "\\", "'": "'", '"': '"',
+}
+
+
+def _translate_estrings(sql: str) -> str:
+    """PG E'...' escape strings → standard SQL literals (SQLite has no
+    backslash escapes; a passed-through E-string would keep literal
+    backslashes)."""
+    parts = _split_quoted(sql)
+    out: list[str] = []
+    for i, (quoted, seg) in enumerate(parts):
+        if (
+            quoted
+            and seg.startswith("'")
+            and out
+            and out[-1]
+            and out[-1][-1] in "eE"
+            and (len(out[-1]) < 2 or not (
+                out[-1][-2].isalnum() or out[-1][-2] == "_"
+            ))
+        ):
+            body = seg[1:-1] if seg.endswith("'") and len(seg) > 1 else seg[1:]
+            decoded = []
+            j = 0
+            while j < len(body):
+                if body[j] == "\\" and j + 1 < len(body):
+                    decoded.append(_ESCAPES.get(body[j + 1], body[j + 1]))
+                    j += 2
+                else:
+                    decoded.append(body[j])
+                    j += 1
+            out[-1] = out[-1][:-1]  # drop the E prefix
+            out.append("'" + "".join(decoded).replace("'", "''") + "'")
+        else:
+            out.append(seg)
+    return "".join(out)
 
 
 _SESSION_SHIMS = [
@@ -119,30 +320,60 @@ _SESSION_SHIMS = [
 ]
 
 
+# A dollar-quote opener: $$ or $tag$ (tags are identifiers, so a $N
+# parameter placeholder never matches).
+_DOLLAR_TAG = re.compile(r"\$(?:[A-Za-z_][A-Za-z_0-9]*)?\$")
+
+
 def _split_quoted(sql: str) -> list[tuple[bool, str]]:
     """Split SQL into (is_quoted, segment) runs; quoted segments include
     their delimiters. A doubled quote ('it''s') splits into two adjacent
     quoted segments — the literal's content never lands in an unquoted
-    run, which is the property the callers rely on."""
+    run, which is the property the callers rely on. Recognizes PG
+    dollar-quoted blocks ($$...$$ / $tag$...$tag$) and backslash escapes
+    inside E'...' literals, so shim/placeholder rewriting never corrupts
+    their contents."""
     out: list[tuple[bool, str]] = []
-    cur: list[str] = []
-    quote: str | None = None
-    for ch in sql:
-        if quote is not None:
-            cur.append(ch)
-            if ch == quote:
-                out.append((True, "".join(cur)))
-                cur = []
-                quote = None
-        elif ch in ("'", '"'):
-            if cur:
-                out.append((False, "".join(cur)))
-            cur = [ch]
-            quote = ch
-        else:
-            cur.append(ch)
-    if cur:
-        out.append((quote is not None, "".join(cur)))
+    buf: list[str] = []
+    i, n = 0, len(sql)
+
+    def flush() -> None:
+        if buf:
+            out.append((False, "".join(buf)))
+            buf.clear()
+
+    while i < n:
+        ch = sql[i]
+        if ch in ("'", '"'):
+            # E'...' (the E stays in the unquoted run) honors backslash
+            # escapes; plain literals treat backslash as data.
+            esc = (
+                ch == "'"
+                and buf
+                and buf[-1] in "eE"
+                and (len(buf) < 2 or not (buf[-2].isalnum() or buf[-2] == "_"))
+            )
+            flush()
+            j = i + 1
+            while j < n and sql[j] != ch:
+                j += 2 if esc and sql[j] == "\\" else 1
+            end = min(j + 1, n)
+            out.append((True, sql[i:end]))
+            i = end
+            continue
+        if ch == "$":
+            m = _DOLLAR_TAG.match(sql, i)
+            if m:
+                tag = m.group(0)
+                close = sql.find(tag, m.end())
+                end = n if close < 0 else close + len(tag)
+                flush()
+                out.append((True, sql[i:end]))
+                i = end
+                continue
+        buf.append(ch)
+        i += 1
+    flush()
     return out
 
 
@@ -301,10 +532,23 @@ class _Prepared:
 
 
 class _Portal:
-    def __init__(self, prepared: _Prepared, params: list):
+    def __init__(
+        self, prepared: _Prepared, params: list,
+        rfmts: list[int] | None = None,
+    ):
         self.prepared = prepared
         self.params = params
+        self.rfmts = rfmts or []
         self.described: tuple[list[str], list[tuple]] | None = None
+
+    def col_fmts(self, n_cols: int) -> list[int]:
+        """Expand Bind's result-format list per protocol: empty = all text,
+        one entry = applies to every column, else per column."""
+        if not self.rfmts:
+            return [0] * n_cols
+        if len(self.rfmts) == 1:
+            return [self.rfmts[0]] * n_cols
+        return (self.rfmts + [0] * n_cols)[:n_cols]
 
 
 class _PgError(Exception):
@@ -356,7 +600,7 @@ async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
                         writer.write(_error(str(e), e.code))
                         in_error = True
                     except Exception as e:
-                        writer.write(_error(str(e)))
+                        writer.write(_error(str(e), sqlstate_for(str(e))))
                         in_error = True
                 else:
                     writer.write(
@@ -423,19 +667,18 @@ async def _extended(
             raw = payload[off : off + plen]
             off += plen
             fmt = fmts[i] if i < len(fmts) else (fmts[0] if len(fmts) == 1 else 0)
-            if fmt != 0:
-                raise _PgError("binary parameter format not supported", "0A000")
             oid = stmt.param_oids[i] if i < len(stmt.param_oids) else 0
-            params.append(_coerce_param(raw.decode(), oid))
+            if fmt != 0:
+                params.append(_decode_binary_param(raw, oid))
+            else:
+                params.append(_coerce_param(raw.decode(), oid))
         (n_rfmt,) = struct.unpack_from(">H", payload, off)
         off += 2
         rfmts = [
             struct.unpack_from(">H", payload, off + 2 * i)[0]
             for i in range(n_rfmt)
         ]
-        if any(f != 0 for f in rfmts):
-            raise _PgError("binary result format not supported", "0A000")
-        portals[portal_name] = _Portal(stmt, params)
+        portals[portal_name] = _Portal(stmt, params, rfmts)
         writer.write(_msg(b"2", b""))  # BindComplete
         return
 
@@ -462,7 +705,12 @@ async def _extended(
                 agent, portal.prepared.translated, portal.params
             )
             portal.described = (cols, rows)
-            writer.write(_row_description(cols))
+            writer.write(
+                _row_description(
+                    cols, _infer_oids(rows, len(cols)),
+                    portal.col_fmts(len(cols)),
+                )
+            )
         else:
             writer.write(_msg(b"n", b""))  # NoData
         return
@@ -481,8 +729,10 @@ async def _extended(
                 cols, rows = portal.described
             else:
                 cols, rows = await _run_query(agent, sql, portal.params)
+            oids = _infer_oids(rows, len(cols))
+            fmts = portal.col_fmts(len(cols))
             for row in rows:
-                writer.write(_data_row(row))
+                writer.write(_data_row(row, fmts, oids))
             writer.write(_command_complete(f"SELECT {len(rows)}"))
         else:
             resp = await agent.execute_async(
@@ -490,7 +740,7 @@ async def _extended(
             )
             bad = [r for r in resp.results if r.error]
             if bad:
-                raise _PgError(bad[0].error)
+                raise _PgError(bad[0].error, sqlstate_for(bad[0].error))
             n = sum(r.rows_affected or 0 for r in resp.results)
             word = sql.split(None, 1)[0].upper()
             tag_word = f"INSERT 0 {n}" if word == "INSERT" else f"{word} {n}"
@@ -502,6 +752,34 @@ async def _extended(
         (prepared if kind == b"S" else portals).pop(name, None)
         writer.write(_msg(b"3", b""))  # CloseComplete
         return
+
+
+def _decode_binary_param(raw: bytes, oid: int):
+    """Binary Bind parameter decode (the formats drivers actually send:
+    PQexecParams with paramFormats=1, psycopg binary adapters)."""
+    try:
+        if oid == INT2_OID:
+            return struct.unpack(">h", raw)[0]
+        if oid == INT4_OID or oid == 26:  # oid type rides int4's format
+            return struct.unpack(">i", raw)[0]
+        if oid == INT8_OID:
+            return struct.unpack(">q", raw)[0]
+        if oid == FLOAT4_OID:
+            return struct.unpack(">f", raw)[0]
+        if oid == FLOAT8_OID:
+            return struct.unpack(">d", raw)[0]
+        if oid == BOOL_OID:
+            return raw != b"\x00"
+        if oid == BYTEA_OID or oid == 0:
+            return raw
+    except struct.error as e:
+        raise _PgError(
+            f"invalid binary parameter for oid {oid}", "22P03"
+        ) from e
+    try:
+        return raw.decode()  # text-family binary repr == utf-8 text
+    except UnicodeDecodeError:
+        return raw
 
 
 def _coerce_param(text: str, oid: int):
@@ -607,17 +885,25 @@ async def _simple_query(agent: "Agent", writer, sql: str) -> None:
         try:
             if _is_query(translated):
                 cols, rows = await _run_query(agent, translated)
-                writer.write(_row_description(cols))
+                writer.write(
+                    _row_description(cols, _infer_oids(rows, len(cols)))
+                )
                 for row in rows:
                     writer.write(_data_row(row))
                 writer.write(_command_complete(f"SELECT {len(rows)}"))
             else:
                 resp = await agent.execute_async([Statement(translated)])
+                err = next((r.error for r in resp.results if r.error), None)
+                if err:
+                    raise _PgError(err, sqlstate_for(err))
                 n = sum(r.rows_affected for r in resp.results)
                 word = translated.split(None, 1)[0].upper()
                 tag = f"INSERT 0 {n}" if word == "INSERT" else f"{word} {n}"
                 writer.write(_command_complete(tag))
+        except _PgError as e:
+            writer.write(_error(str(e), e.code))
+            break
         except Exception as e:
-            writer.write(_error(str(e)))
+            writer.write(_error(str(e), sqlstate_for(str(e))))
             break
     writer.write(_ready())

@@ -344,3 +344,176 @@ def test_translate_placeholders():
         "SELECT '$1', \"a$2\", ?3"
     )
     assert translate_placeholders("SELECT 1") == "SELECT 1"
+
+
+def test_pg_dialect_translation():
+    """PG-isms → SQLite (corro-pg's sqlparser translation, lib.rs:306-472):
+    ``::`` casts, boolean literals, ILIKE, E'...' escape strings."""
+    from corrosion_tpu.agent.pg import translate_pg_sql
+
+    assert (
+        translate_pg_sql("SELECT id::text FROM t WHERE ok = true")
+        == "SELECT CAST(id AS TEXT) FROM t WHERE ok = 1"
+    )
+    assert (
+        translate_pg_sql("SELECT '5'::int4, 1.5::float8")
+        == "SELECT CAST('5' AS INTEGER), CAST(1.5 AS REAL)"
+    )
+    # Parenthesized expressions drop the cast (dynamic typing absorbs it).
+    assert (
+        translate_pg_sql("SELECT (id + 1)::bigint FROM t")
+        == "SELECT (id + 1) FROM t"
+    )
+    # varchar(32)-style length qualifiers are consumed with the cast.
+    assert (
+        translate_pg_sql("SELECT name::varchar(32) FROM t")
+        == "SELECT CAST(name AS TEXT) FROM t"
+    )
+    assert (
+        translate_pg_sql("SELECT * FROM t WHERE a ILIKE 'x%' AND b = false")
+        == "SELECT * FROM t WHERE a LIKE 'x%' AND b = 0"
+    )
+    # E-strings decode backslash escapes into standard literals.
+    assert (
+        translate_pg_sql(r"INSERT INTO t VALUES (E'a\nb\'c')")
+        == "INSERT INTO t VALUES ('a\nb''c')"
+    )
+    # Literals stay untouched: 'true' inside a string is data.
+    assert (
+        translate_pg_sql("INSERT INTO t VALUES ('true::int4')")
+        == "INSERT INTO t VALUES ('true::int4')"
+    )
+    # Dollar-quoted blocks are opaque.
+    assert (
+        translate_pg_sql("SELECT $$true::x$$")
+        == "SELECT $$true::x$$"
+    )
+
+
+def test_pg_sqlstate_mapping():
+    from corrosion_tpu.agent.pg import sqlstate_for
+
+    assert sqlstate_for("no such table: nope") == "42P01"
+    assert sqlstate_for("no such column: z") == "42703"
+    assert sqlstate_for('near "FRM": syntax error') == "42601"
+    assert sqlstate_for("UNIQUE constraint failed: tests.id") == "23505"
+    assert sqlstate_for("NOT NULL constraint failed: t.x") == "23502"
+    assert sqlstate_for("whatever else") == "XX000"
+
+
+def test_pg_binary_formats(tmp_path):
+    """Binary Bind parameters + binary result formats (the PQexecParams
+    paramFormats=1 / resultFormat=1 flow real drivers use)."""
+    import struct
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        from corrosion_tpu.agent.pg import serve_pg
+
+        server, (host, port) = await serve_pg(a.agent)
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            startup = struct.pack(">I", 196608) + _cstr("user") + _cstr("t") + b"\x00"
+            writer.write(struct.pack(">I", len(startup) + 4) + startup)
+            await writer.drain()
+
+            async def read_msg():
+                header = await reader.readexactly(5)
+                tag = header[0:1]
+                (length,) = struct.unpack(">I", header[1:5])
+                return tag, await reader.readexactly(length - 4)
+
+            while (await read_msg())[0] != b"Z":
+                pass
+
+            # INSERT with BINARY int4 + text params.
+            parse = (_cstr("s")
+                     + _cstr("INSERT INTO tests (id, text) VALUES ($1, $2)")
+                     + struct.pack(">H", 2) + struct.pack(">II", 23, 25))
+            bind = (_cstr("") + _cstr("s")
+                    + struct.pack(">HHH", 2, 1, 0)  # fmts: binary, text
+                    + struct.pack(">H", 2)
+                    + struct.pack(">i", 4) + struct.pack(">i", 99)  # binary int4
+                    + struct.pack(">i", 3) + b"bin"
+                    + struct.pack(">H", 0))
+            writer.write(_pg_msg(b"P", parse) + _pg_msg(b"B", bind)
+                         + _pg_msg(b"E", _cstr("") + struct.pack(">i", 0))
+                         + _pg_msg(b"S", b""))
+            await writer.drain()
+            while True:
+                tag, payload = await read_msg()
+                if tag == b"C":
+                    assert payload.startswith(b"INSERT 0 1")
+                if tag == b"Z":
+                    break
+
+            # SELECT it back asking for BINARY results.
+            parse = (_cstr("q") + _cstr("SELECT id, text FROM tests WHERE id = $1")
+                     + struct.pack(">H", 1) + struct.pack(">I", 23))
+            bind = (_cstr("p") + _cstr("q")
+                    + struct.pack(">HH", 1, 1)  # one param fmt: binary
+                    + struct.pack(">H", 1)
+                    + struct.pack(">i", 4) + struct.pack(">i", 99)
+                    + struct.pack(">H", 1) + struct.pack(">H", 1))  # results binary
+            describe = b"P" + _cstr("p")
+            writer.write(_pg_msg(b"P", parse) + _pg_msg(b"B", bind)
+                         + _pg_msg(b"D", describe)
+                         + _pg_msg(b"E", _cstr("p") + struct.pack(">i", 0))
+                         + _pg_msg(b"S", b""))
+            await writer.drain()
+            saw = {}
+            while True:
+                tag, payload = await read_msg()
+                saw.setdefault(tag, payload)
+                if tag == b"Z":
+                    break
+            # RowDescription: id column typed int8 with binary format code.
+            t = saw[b"T"]
+            (ncols,) = struct.unpack_from(">H", t, 0)
+            assert ncols == 2
+            off = 2
+            metas = []
+            for _ in range(ncols):
+                end = t.index(b"\x00", off)
+                name = t[off:end].decode()
+                tbl, attnum, oid, tlen, tmod, fmt = struct.unpack_from(
+                    ">IhIhih", t, end + 1
+                )
+                metas.append((name, oid, fmt))
+                off = end + 1 + 18
+            assert metas[0] == ("id", 20, 1)  # int8, binary
+            # DataRow: binary int8 99 + binary text.
+            d = saw[b"D"]
+            (n,) = struct.unpack_from(">H", d, 0)
+            (ln,) = struct.unpack_from(">i", d, 2)
+            assert ln == 8
+            (val,) = struct.unpack_from(">q", d, 6)
+            assert val == 99
+            (ln2,) = struct.unpack_from(">i", d, 14)
+            assert d[18:18 + ln2] == b"bin"
+
+            # SQLSTATE travels on errors: undefined table → 42P01.
+            parse = (_cstr("bad") + _cstr("SELECT * FROM nope_table")
+                     + struct.pack(">H", 0))
+            bind = (_cstr("pb") + _cstr("bad") + struct.pack(">H", 0)
+                    + struct.pack(">H", 0) + struct.pack(">H", 0))
+            writer.write(_pg_msg(b"P", parse) + _pg_msg(b"B", bind)
+                         + _pg_msg(b"E", _cstr("pb") + struct.pack(">i", 0))
+                         + _pg_msg(b"S", b""))
+            await writer.drain()
+            err_payload = None
+            while True:
+                tag, payload = await read_msg()
+                if tag == b"E":
+                    err_payload = payload
+                if tag == b"Z":
+                    break
+            assert err_payload is not None and b"C42P01\x00" in err_payload
+
+            writer.write(_pg_msg(b"X", b""))
+            writer.close()
+        finally:
+            server.close()
+            await a.stop()
+
+    run(main())
