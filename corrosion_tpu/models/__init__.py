@@ -6,12 +6,15 @@ the driver-set scenarios (BASELINE.md "Targets"):
 1. `three_node`   — 3-node local cluster, 1k INSERTs.
 2. `churn_32`     — 32-node SWIM membership churn storm.
 3. `anti_entropy_1k` — 1k-node sync: version-vector diff + changeset replay.
+   (`anti_entropy_chunks` — 3b: the same scale with multi-chunk transactions
+   on the seq-chunk plane, ops/chunks.py.)
 4. `merge_10k`    — 10k-node concurrent-writer CRDT merge.
 5. `wan_100k`     — 100k-node partitioned WAN topology (region-aware fanout).
 """
 
 from corrosion_tpu.models.baselines import (  # noqa: F401
     anti_entropy_1k,
+    anti_entropy_chunks,
     churn_32,
     merge_10k,
     three_node,

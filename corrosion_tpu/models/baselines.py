@@ -246,3 +246,35 @@ def wan_100k(n: int = 100_000, n_regions: int = 20, n_writers: int = 512,
         part[60:120, cut_a, cut_a] = False
     sched = Schedule(writes=writes, partition=part).make_samples(samples)
     return cfg, topo, sched
+
+
+def anti_entropy_chunks(
+    n: int = 1000, streams: int = 16, last_seq: int = 8191,
+    rounds: int = 240,
+):
+    """Config 3b: the seq-chunk plane at BASELINE-3 scale. ``streams`` hot
+    writers each commit one LARGE multi-chunk transaction (last_seq+1 seqs
+    ≈ a large_tx_sync 10k-row INSERT, agent.rs:3340) that disseminates as
+    ≤8 KiB seq-range chunks (change.rs:8-116) with partial-need sync
+    (SyncNeedV1::Partial, sync.rs:248-266) reassembling the gaps — the
+    engine-scale exercise of ops/chunks.py.
+
+    Returns (ChunkConfig, origin[S], last_seq[S], rounds) for
+    sim.chunk_engine.simulate_chunks."""
+    from corrosion_tpu.ops.chunks import ChunkConfig
+
+    rng = np.random.default_rng(11)
+    cfg = ChunkConfig(
+        n_nodes=n,
+        n_streams=streams,
+        cap=16,
+        chunk_len=256,
+        fanout=3,
+        k_in=6,
+        sync_interval=5,
+        gap_requests=4,
+        sync_seq_budget=4096,
+    )
+    origin = np.sort(rng.choice(n, size=streams, replace=False)).astype(np.int32)
+    ls = np.full((streams,), last_seq, np.int32)
+    return cfg, origin, ls, rounds

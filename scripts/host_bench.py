@@ -1,13 +1,17 @@
-"""Host-agent benchmark: the reference's 2-node quick-start scenario.
+"""Host-agent benchmark: quick-start scenario + N-agent fan-out stress.
 
 The only throughput number the reference publishes is a quick-start log
 excerpt: 2 changes synced in 0.0128 s ≈ 156 changes/s across a 2-node
-cluster (doc/quick-start.md:119, BASELINE.md). This script reproduces that
-scenario with REAL agents — two in-process nodes over real TCP loopback,
-writes on A via the HTTP API, convergence polled on B — and reports
-end-to-end replicated changes/s.
+cluster (doc/quick-start.md:119, BASELINE.md). The default mode reproduces
+that scenario with REAL agents — two in-process nodes over real TCP
+loopback, writes on A via the HTTP API, convergence polled on B.
 
-Usage: python scripts/host_bench.py [n_changes] [batch]
+``--agents N`` runs the stress_test shape instead (agent.rs:3009-3224):
+N agents, writes fired at random agents in batches under a sustained
+concurrent read load, convergence asserted everywhere; reports end-to-end
+replicated change-APPLICATIONS per second (writes × (N-1) receivers).
+
+Usage: python scripts/host_bench.py [n_changes] [batch] [--agents N]
 Prints one JSON line.
 """
 
@@ -15,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import random
 import sys
 import tempfile
 import time
@@ -84,7 +89,95 @@ async def main(n_changes: int, batch: int) -> None:
             await a.stop()
 
 
+async def main_fanout(n_changes: int, batch: int, n_agents: int) -> None:
+    """N-agent mixed-load stress (the stress_test harness shape,
+    agent.rs:3009-3224): batched writes to random agents + a sustained
+    concurrent read load, then cluster-wide convergence."""
+    rng = random.Random(7)
+    with tempfile.TemporaryDirectory() as root:
+        agents = [await launch_test_agent(f"{root}/a0")]
+        for i in range(1, n_agents):
+            agents.append(
+                await launch_test_agent(
+                    f"{root}/a{i}", bootstrap=[agents[0].gossip_addr]
+                )
+            )
+        try:
+            async def joined():
+                return all(
+                    len(t.agent.members.alive()) >= n_agents - 1
+                    for t in agents
+                )
+
+            await poll_until(joined, timeout=30)
+
+            reads = 0
+            stop_reads = asyncio.Event()
+
+            async def read_load():
+                nonlocal reads
+                while not stop_reads.is_set():
+                    t = rng.choice(agents)
+                    await t.client.query("SELECT count(*) FROM tests")
+                    reads += 1
+
+            readers = [asyncio.ensure_future(read_load()) for _ in range(4)]
+
+            t0 = time.monotonic()
+            for base in range(0, n_changes, batch):
+                stmts = [
+                    ["INSERT INTO tests (id, text) VALUES (?, ?)",
+                     [base + j, f"v{base + j}"]]
+                    for j in range(min(batch, n_changes - base))
+                ]
+                await rng.choice(agents).client.execute(stmts)
+            write_done = time.monotonic()
+
+            async def converged():
+                for t in agents:
+                    _, rows = t.agent.store.query(
+                        Statement("SELECT count(*) FROM tests")
+                    )
+                    if rows[0][0] != n_changes:
+                        return False
+                return True
+
+            await poll_until(converged, timeout=300, interval=0.05)
+            total = time.monotonic() - t0
+            stop_reads.set()
+            for r in readers:
+                r.cancel()
+            applications = n_changes * (n_agents - 1)
+            print(
+                json.dumps(
+                    {
+                        "metric": "host_fanout_replicated_applications_per_s",
+                        "value": round(applications / total, 1),
+                        "unit": "applications/s",
+                        "agents": n_agents,
+                        "n_changes": n_changes,
+                        "writes_per_s": round(n_changes / total, 1),
+                        "reads_completed": reads,
+                        "write_s": round(write_done - t0, 3),
+                        "end_to_end_s": round(total, 3),
+                    }
+                )
+            )
+        finally:
+            for t in agents:
+                await t.stop()
+
+
 if __name__ == "__main__":
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 200
-    asyncio.run(main(n, batch))
+    argv = sys.argv[1:]
+    n_agents = 0
+    if "--agents" in argv:
+        i = argv.index("--agents")
+        n_agents = int(argv[i + 1])
+        del argv[i:i + 2]
+    n = int(argv[0]) if argv else 10000
+    batch = int(argv[1]) if len(argv) > 1 else 200
+    if n_agents > 2:
+        asyncio.run(main_fanout(n, batch, n_agents))
+    else:
+        asyncio.run(main(n, batch))
