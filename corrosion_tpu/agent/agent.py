@@ -95,6 +95,9 @@ class AgentConfig:
     tls: "AgentTls | None" = None  # gossip-plane TLS (None = plaintext)
     prometheus_addr: str = ""  # host:port for /metrics ("" = disabled)
     trace_export_path: str = ""  # JSON-lines span export ("" = in-memory)
+    # OTLP/HTTP collector base URL (spans POST to <url>/v1/traces as
+    # OTLP/JSON, batched — main.rs:64-117's exporter). "" = disabled.
+    otlp_endpoint: str = ""
 
 
 @dataclass
@@ -152,6 +155,7 @@ class Agent:
         self.tracer = Tracer(
             service=f"corrosion-{self.actor_id[:8]}",
             export_path=cfg.trace_export_path or None,
+            otlp_endpoint=cfg.otlp_endpoint or None,
         )
         self._prom_server = None
         self.pool = None  # SplitPool, started with the event loop
@@ -254,6 +258,9 @@ class Agent:
         )
         self.tasks.spawn(self._empties_loop(), name="write_empties_loop")
         self.tasks.spawn(self._metrics_loop(), name="metrics_loop")
+        self.tasks.spawn(
+            self._runtime_metrics_loop(), name="runtime_metrics"
+        )
         self.tasks.spawn(self._wal_checkpoint_loop(), name="db_cleanup")
         if self.cfg.admin_uds:
             from corrosion_tpu.agent.admin import start_admin
@@ -872,6 +879,34 @@ class Agent:
                 logging.getLogger(__name__).debug(
                     "metrics sample failed", exc_info=True
                 )
+
+    async def _runtime_metrics_loop(self) -> None:
+        """Event-loop/runtime profiling — the tokio-metrics reporter's role
+        (command/agent.rs:87-213: scheduled/idle/poll durations, task
+        counts). asyncio's equivalents: loop LAG (how late a 1 s sleep
+        fires — the 'scheduled duration' signal that catches a blocked
+        loop), live task count, and the counted-handle registry depth."""
+        lag_hist = self.metrics.histogram(
+            "corro_runtime_loop_lag_seconds",
+            "event-loop wakeup lag of a 1s timer (blocked-loop detector)",
+        )
+        tasks_g = self.metrics.gauge(
+            "corro_runtime_tasks", "live asyncio tasks in this process"
+        )
+        counted_g = self.metrics.gauge(
+            "corro_runtime_counted_handles",
+            "tasks tracked by the counted-spawn registry",
+        )
+        interval = 1.0
+        while not self.tripwire.tripped:
+            t0 = time.monotonic()
+            await asyncio.sleep(interval)
+            lag_hist.observe(max(time.monotonic() - t0 - interval, 0.0))
+            try:
+                tasks_g.set(len(asyncio.all_tasks()))
+            except RuntimeError:
+                pass
+            counted_g.set(self.tasks.pending)
 
     async def _wal_checkpoint_loop(self) -> None:
         """Periodic WAL truncation on the writer, timed (the reference's

@@ -56,6 +56,9 @@ class AdminConfig:
 @dataclass
 class TelemetryConfig:
     prometheus_addr: str | None = None
+    # OTLP/HTTP collector base URL (config.rs telemetry.open-telemetry;
+    # spans batch-POST to <url>/v1/traces).
+    otlp_endpoint: str | None = None
 
 
 @dataclass

@@ -234,6 +234,7 @@ async def _run_agent(cfg: Config) -> int:
         admin_uds=cfg.admin.uds_path,
         tls=tls_cfg,
         prometheus_addr=cfg.telemetry.prometheus_addr or "",
+        otlp_endpoint=cfg.telemetry.otlp_endpoint or "",
     )
     agent = Agent(acfg)
     agent.subs = SubsManager(agent.store)

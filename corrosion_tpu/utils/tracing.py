@@ -5,10 +5,10 @@ The reference instruments everything with `tracing` spans and exports OTLP
 the wire protocol (SyncTraceContextV1, corro-types/src/sync.rs:32-67,
 injected peer.rs:941-944, extracted peer.rs:1296-1298). This module is the
 in-process analogue: explicit span context managers backed by contextvars,
-a bounded in-memory ring of finished spans (plus an optional JSON-lines
-file export — there is no egress for a collector), and W3C
-traceparent strings for carrying trace context across agents in sync
-frames.
+a bounded in-memory ring of finished spans, optional JSON-lines file
+export, an optional batched OTLP/JSON exporter POSTing to a collector's
+``/v1/traces`` (the `main.rs` OTLP pipeline's role), and W3C traceparent
+strings for carrying trace context across agents in sync frames.
 """
 
 from __future__ import annotations
@@ -71,18 +71,122 @@ class Span:
         }
 
 
+def spans_to_otlp(service: str, spans: list[dict]) -> dict:
+    """Batch finished spans into an OTLP/JSON ExportTraceServiceRequest
+    (the shape `main.rs:64-117`'s OTLP pipeline emits: resourceSpans →
+    scopeSpans → spans with hex ids, unix-nano times, and key-value
+    attributes) so any OTLP/HTTP collector ingests the file or POST body
+    as-is."""
+    return {
+        "resourceSpans": [{
+            "resource": {
+                "attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": service},
+                }],
+            },
+            "scopeSpans": [{
+                "scope": {"name": "corrosion-tpu"},
+                "spans": [
+                    {
+                        "traceId": s["trace_id"],
+                        "spanId": s["span_id"],
+                        **(
+                            {"parentSpanId": s["parent_id"]}
+                            if s.get("parent_id") else {}
+                        ),
+                        "name": s["name"],
+                        "kind": 1,  # SPAN_KIND_INTERNAL
+                        "startTimeUnixNano": str(s["start_ns"]),
+                        "endTimeUnixNano": str(
+                            s["start_ns"] + s["duration_us"] * 1000
+                        ),
+                        "attributes": [
+                            {"key": k, "value": {"stringValue": str(v)}}
+                            for k, v in s.get("attrs", {}).items()
+                        ],
+                    }
+                    for s in spans
+                ],
+            }],
+        }],
+    }
+
+
 class Tracer:
-    """Per-agent tracer: bounded finished-span ring + optional file export."""
+    """Per-agent tracer: bounded finished-span ring + optional export.
+
+    ``export_path`` appends one JSON object per span; with
+    ``otlp_endpoint`` set, a single long-lived worker thread batches
+    finished spans (256 spans or 5 s idle, whichever first — the
+    reference's batch exporter, main.rs:103-109) and POSTs OTLP/JSON to
+    ``<endpoint>/v1/traces``; close() drains the queue so shutdown never
+    drops buffered spans."""
+
+    OTLP_BATCH = 256
+    OTLP_FLUSH_S = 5.0
 
     def __init__(
         self, service: str = "corrosion-tpu", capacity: int = 4096,
-        export_path: str | None = None,
+        export_path: str | None = None, otlp_endpoint: str | None = None,
     ) -> None:
+        import queue
+
         self.service = service
         self.finished: deque[Span] = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._export_path = export_path
         self._export_f = None
+        self._otlp_endpoint = otlp_endpoint
+        self.otlp_export_errors = 0
+        self._otlp_q: "queue.Queue | None" = None
+        self._otlp_thread: threading.Thread | None = None
+        if otlp_endpoint is not None:
+            self._otlp_q = queue.Queue(maxsize=10240)
+            self._otlp_thread = threading.Thread(
+                target=self._otlp_worker, daemon=True
+            )
+            self._otlp_thread.start()
+
+    def _otlp_worker(self) -> None:
+        import queue
+
+        batch: list[dict] = []
+        while True:
+            try:
+                # Read per-iteration: tests shrink the flush window live.
+                item = self._otlp_q.get(timeout=self.OTLP_FLUSH_S or 0.05)
+            except queue.Empty:
+                if batch:
+                    self._otlp_post(batch)
+                    batch = []
+                continue
+            if item is None:  # close sentinel: drain and exit
+                if batch:
+                    self._otlp_post(batch)
+                return
+            batch.append(item)
+            if len(batch) >= self.OTLP_BATCH:
+                self._otlp_post(batch)
+                batch = []
+
+    def _otlp_post(self, batch: list[dict]) -> None:
+        import urllib.request
+
+        body = json.dumps(
+            spans_to_otlp(self.service, batch), default=str
+        ).encode()
+        req = urllib.request.Request(
+            self._otlp_endpoint.rstrip("/") + "/v1/traces",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5).read()
+        except Exception:
+            # Collectors come and go; drop the batch. Only the worker
+            # thread touches this counter.
+            self.otlp_export_errors += 1
 
     def span(self, name: str, traceparent: str | None = None, **attrs) -> Span:
         """Open a span. Parentage: explicit ``traceparent`` (remote
@@ -112,15 +216,21 @@ class Tracer:
         return span.traceparent if span is not None else None
 
     def _record(self, span: Span) -> None:
+        obj = span.to_json_obj() if (
+            self._export_path is not None or self._otlp_q is not None
+        ) else None
         with self._lock:
             self.finished.append(span)
             if self._export_path is not None:
                 if self._export_f is None:
                     self._export_f = open(self._export_path, "a")
-                self._export_f.write(
-                    json.dumps(span.to_json_obj(), default=str) + "\n"
-                )
+                self._export_f.write(json.dumps(obj, default=str) + "\n")
                 self._export_f.flush()
+        if self._otlp_q is not None:
+            try:
+                self._otlp_q.put_nowait(obj)
+            except Exception:
+                self.otlp_export_errors += 1  # queue full: shed
 
     def recent(self, limit: int = 100, name: str | None = None) -> list[dict]:
         with self._lock:
@@ -133,6 +243,11 @@ class Tracer:
         if self._export_f is not None:
             self._export_f.close()
             self._export_f = None
+        if self._otlp_q is not None:
+            self._otlp_q.put(None)  # drain sentinel
+            self._otlp_thread.join(timeout=5.0)
+            self._otlp_q = None
+            self._otlp_thread = None
 
 
 def parse_traceparent(value: str) -> tuple[str, str] | None:
