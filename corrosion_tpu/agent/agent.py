@@ -152,6 +152,8 @@ class Agent:
         self.tripwire = Tripwire()
         self.lock_registry = LockRegistry()
         self.metrics = MetricsRegistry()
+        # Aggregate transport metrics (Transport::emit_metrics parity).
+        self.transport.bind_metrics(self.metrics)
         self.tracer = Tracer(
             service=f"corrosion-{self.actor_id[:8]}",
             export_path=cfg.trace_export_path or None,

@@ -77,6 +77,21 @@ def _cells_from_json(s: str) -> tuple:
     return tuple(_unjson(v) for v in json.loads(s))
 
 
+def _membership_is_local(select_list: str, tail: str) -> bool:
+    """Candidate-only re-evaluation is sound only when a row's result
+    VALUES and membership depend on that row alone: LIMIT windows, GROUP
+    BY, and subqueries make membership global (a change to one PK can
+    evict another row), and window functions / scalar subqueries in the
+    select list make unchanged rows' values change — only a full diff
+    notices either. Shared by the single-table and join injectors so the
+    soundness rule cannot diverge."""
+    import re
+
+    return not re.search(
+        r"(?i)\b(limit|group)\b|\(\s*select\b", tail
+    ) and not re.search(r"(?i)\bover\s*\(|\(\s*select\b", select_list)
+
+
 def normalize_sql(sql: str) -> str:
     """Whitespace/case-insensitive reuse key (pubsub.rs normalize_sql:2089)."""
     return " ".join(sql.strip().rstrip(";").split()).lower()
@@ -135,6 +150,12 @@ class MatcherHandle:
             raise ValueError("query reads no user tables")
         self._pk_prefix = 0
         self._pk_table: str | None = None
+        # Join mode: [(table, alias, key_offset, n_pk_cols)] per joined
+        # table; None = single-table or fallback identity. The per-segment
+        # index (segment value -> full keys) keeps join deletes
+        # O(candidates), not O(result set).
+        self._pk_segments: list[tuple[str, str, int, int]] | None = None
+        self._seg_index: list[dict[tuple, set[tuple]]] | None = None
         self._local_membership = False
         self._exec_sql = sql
         self._maybe_inject_pks()
@@ -214,6 +235,7 @@ class MatcherHandle:
             self.rows[key] = _cells_from_json(cells_s)
             self.rowids[key] = rowid
             self._next_rowid = max(self._next_rowid, rowid + 1)
+        self._index_rebuild()
         return True
 
     def _persist_snapshot(self) -> None:
@@ -284,7 +306,11 @@ class MatcherHandle:
 
     def _maybe_inject_pks(self) -> None:
         """For `SELECT ... FROM <one crr table> ...`, prepend the table's PK
-        columns as identity columns (hidden from emitted cells)."""
+        columns as identity columns (hidden from emitted cells). For plain
+        inner-join chains, prepend EVERY table's PK columns (the
+        reference's Matcher aliases all tables' PKs, pubsub.rs:566-661) so
+        a one-to-many join keeps per-result-row identity and candidate
+        diffing works from any table's changed PKs."""
         import re
 
         m = re.match(
@@ -293,6 +319,7 @@ class MatcherHandle:
             self.sql,
         )
         if not m:
+            self._maybe_inject_join_pks()
             return
         table = m.group(2)
         info = self.store.tables().get(table)
@@ -314,17 +341,80 @@ class MatcherHandle:
         )
         self._pk_prefix = len(info.pk_cols)
         self._pk_table = table
-        # Candidate-only re-evaluation is sound only when a row's result
-        # VALUES and membership depend on that row alone: LIMIT windows,
-        # GROUP BY, and subqueries make membership global (a change to one
-        # PK can evict another row), and window functions / scalar
-        # subqueries in the select list make unchanged rows' values change
-        # — only a full diff notices either.
-        self._local_membership = not re.search(
-            r"(?i)\b(limit|group)\b|\(\s*select\b", tail
-        ) and not re.search(
-            r"(?i)\bover\s*\(|\(\s*select\b", select_list
+        self._local_membership = _membership_is_local(select_list, tail)
+
+    def _maybe_inject_join_pks(self) -> None:
+        """Inner-join chains: `SELECT ... FROM t1 [a] JOIN t2 [b] ON ...`.
+        Row identity = concatenation of every table's PKs (unique per
+        result row even for one-to-many joins); a change batch touching
+        any joined table re-evaluates only result rows whose that-table PK
+        segment matches a changed PK."""
+        import re
+
+        m = re.match(
+            r"(?is)^\s*select\s+(.+?)\s+from\s+(.+?)"
+            r"(\s+(?:where|order|group|limit)\b.*)?\s*;?\s*$",
+            self.sql,
         )
+        if not m:
+            return
+        select_list, from_clause = m.group(1), m.group(2)
+        tail = (m.group(3) or "").rstrip().rstrip(";")
+        # Only plain INNER JOIN chains: outer/cross/natural/USING change
+        # membership semantics; subqueries and comma-joins fall back.
+        if re.search(
+            r"(?i)\b(left|right|full|cross|outer|natural|using)\b",
+            from_clause,
+        ) or "(" in from_clause or "," in from_clause:
+            return
+        if re.search(
+            r"(?i)\b(count|sum|avg|min|max|group_concat)\s*\(", select_list
+        ) or re.match(r"(?i)\s*distinct\b", select_list):
+            return
+        parts = re.split(r"(?i)\s+(?:inner\s+)?join\s+", from_clause)
+        if len(parts) < 2:
+            return
+
+        def ref(s: str):
+            mm = re.match(
+                r"(?is)^\s*([A-Za-z_]\w*)(?:\s+(?:as\s+)?([A-Za-z_]\w*))?\s*$",
+                s,
+            )
+            return (mm.group(1), mm.group(2) or mm.group(1)) if mm else None
+
+        first = ref(parts[0])
+        if first is None:
+            return
+        refs = [first]
+        for seg in parts[1:]:
+            mm = re.match(
+                r"(?is)^\s*([A-Za-z_]\w*)(?:\s+(?:as\s+)?([A-Za-z_]\w*))?"
+                r"\s+on\s+.+$",
+                seg,
+            )
+            if mm is None:
+                return
+            refs.append((mm.group(1), mm.group(2) or mm.group(1)))
+        infos = self.store.tables()
+        if any(t not in infos for t, _ in refs):
+            return
+        segments: list[tuple[str, str, int, int]] = []
+        alias_cols: list[str] = []
+        off = 0
+        for table, alias in refs:
+            pk = infos[table].pk_cols
+            for i, c in enumerate(pk):
+                alias_cols.append(f'"{alias}"."{c}" AS __pk{off + i}')
+            segments.append((table, alias, off, len(pk)))
+            off += len(pk)
+        self._exec_sql = (
+            f"SELECT {', '.join(alias_cols)}, {select_list}"
+            f" FROM {from_clause}{tail}"
+        )
+        self._pk_prefix = off
+        self._pk_segments = segments
+        self._seg_index = [dict() for _ in segments]
+        self._local_membership = _membership_is_local(select_list, tail)
 
     def _evaluate(self) -> tuple[list[str], dict[tuple, tuple]]:
         cur = self.store.read_conn.execute(self._exec_sql)
@@ -345,6 +435,51 @@ class MatcherHandle:
         for key in self.rows:
             self.rowids[key] = self._next_rowid
             self._next_rowid += 1
+        self._index_rebuild()
+
+    # -- per-segment key index (join mode) -----------------------------------
+
+    def _index_rebuild(self) -> None:
+        if self._seg_index is None:
+            return
+        self._seg_index = [dict() for _ in self._pk_segments]
+        for key in self.rows:
+            self._index_add(key)
+
+    def _index_add(self, key: tuple) -> None:
+        if self._seg_index is None:
+            return
+        for i, (_t, _a, off, npk) in enumerate(self._pk_segments):
+            self._seg_index[i].setdefault(key[off:off + npk], set()).add(key)
+
+    def _index_discard(self, key: tuple) -> None:
+        if self._seg_index is None:
+            return
+        for i, (_t, _a, off, npk) in enumerate(self._pk_segments):
+            seg = key[off:off + npk]
+            bucket = self._seg_index[i].get(seg)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._seg_index[i][seg]
+
+    # -- shared row mutation + event emission --------------------------------
+
+    def _upsert(self, key, cells, events) -> None:
+        if key not in self.rows:
+            self.rowids.setdefault(key, self._next_rowid)
+            self._next_rowid += 1
+            self.rows[key] = cells
+            self._index_add(key)
+            events.append(self._emit(CHANGE_INSERT, key, cells))
+        elif self.rows[key] != cells:
+            self.rows[key] = cells
+            events.append(self._emit(CHANGE_UPDATE, key, cells))
+
+    def _delete_row(self, key, events) -> None:
+        events.append(self._emit(CHANGE_DELETE, key, self.rows.pop(key)))
+        self.rowids.pop(key, None)
+        self._index_discard(key)
 
     # -- change path (handle_candidates, pubsub.rs:1303-1570) ----------------
 
@@ -388,11 +523,30 @@ class MatcherHandle:
                     pass
         return events
 
-    def _candidate_keys(self, changes) -> list[tuple] | None:
+    def _candidate_keys(self, changes):
         """Distinct changed identity keys, or None when incremental
-        evaluation does not apply (filter_matchable_change's role)."""
+        evaluation does not apply (filter_matchable_change's role). Join
+        mode returns ("join", {table: {pk_tuple}}) for the per-segment
+        diff."""
         if changes is None or self._pk_prefix == 0 or not self._local_membership:
             return None
+        if self._pk_segments is not None:
+            seg_tables = {t for t, _, _, _ in self._pk_segments}
+            by_table: dict[str, dict[tuple, None]] = {}
+            for ch in changes:
+                if ch.table not in seg_tables:
+                    if ch.table in self.tables:
+                        return None  # untracked dep changed: full pass
+                    continue
+                try:
+                    by_table.setdefault(ch.table, {})[
+                        unpack_columns(ch.pk)
+                    ] = None
+                except Exception:
+                    return None
+            if sum(len(v) for v in by_table.values()) > self.MAX_CANDIDATES:
+                return None
+            return ("join", {t: set(v) for t, v in by_table.items()})
         keys: dict[tuple, None] = {}
         for ch in changes:
             if ch.table != self._pk_table:
@@ -407,7 +561,9 @@ class MatcherHandle:
             return None
         return list(keys)
 
-    def _diff_candidates(self, keys: list[tuple]) -> list[QueryEventChange]:
+    def _diff_candidates(self, keys) -> list[QueryEventChange]:
+        if isinstance(keys, tuple) and keys[0] == "join":
+            return self._diff_join(keys[1])
         if not keys:
             return []
         npk = self._pk_prefix
@@ -432,18 +588,52 @@ class MatcherHandle:
             cells = fresh.get(key)
             if cells is None:
                 if key in self.rows:
-                    events.append(
-                        self._emit(CHANGE_DELETE, key, self.rows.pop(key))
-                    )
-                    self.rowids.pop(key, None)
-            elif key not in self.rows:
-                self.rowids.setdefault(key, self._next_rowid)
-                self._next_rowid += 1
-                self.rows[key] = cells
-                events.append(self._emit(CHANGE_INSERT, key, cells))
-            elif self.rows[key] != cells:
-                self.rows[key] = cells
-                events.append(self._emit(CHANGE_UPDATE, key, cells))
+                    self._delete_row(key, events)
+            else:
+                self._upsert(key, cells, events)
+        return events
+
+    def _diff_join(self, by_table: dict) -> list[QueryEventChange]:
+        """Candidate diff for join subscriptions (handle_candidates over
+        multi-table PK temp tables, pubsub.rs:1303-1570): re-evaluate only
+        result rows whose changed-table PK segment matches a candidate —
+        a t2 update touches exactly the join rows built from that t2 row,
+        not the whole result set."""
+        if not by_table:
+            return []
+        conds: list[str] = []
+        params: list = []
+        for table, _alias, off, npk in self._pk_segments:
+            keys = by_table.get(table)
+            if not keys:
+                continue
+            cols = ", ".join(f'"__q"."__pk{off + i}"' for i in range(npk))
+            row_vals = ", ".join(
+                "(" + ", ".join("?" for _ in range(npk)) + ")" for _ in keys
+            )
+            conds.append(f"({cols}) IN (VALUES {row_vals})")
+            params.extend(v for key in keys for v in key)
+        sql = (
+            "SELECT * FROM (" + self._exec_sql + ") AS __q WHERE "
+            + " OR ".join(conds)
+        )
+        npk_total = self._pk_prefix
+        cur = self.store.read_conn.execute(sql, params)
+        fresh = {
+            tuple(row[:npk_total]): tuple(row[npk_total:])
+            for row in cur.fetchall()
+        }
+        # Affected existing rows via the per-segment index: O(candidates),
+        # never a scan of the materialized result set.
+        affected: set[tuple] = set()
+        for i, (table, _alias, _off, _npk) in enumerate(self._pk_segments):
+            for seg in by_table.get(table, ()):
+                affected |= self._seg_index[i].get(seg, set())
+        events: list[QueryEventChange] = []
+        for key, cells in fresh.items():
+            self._upsert(key, cells, events)
+        for key in [k for k in affected if k not in fresh and k in self.rows]:
+            self._delete_row(key, events)
         return events
 
     def _diff_full(self, new_rows) -> list[QueryEventChange]:
@@ -460,6 +650,7 @@ class MatcherHandle:
                 events.append(self._emit(CHANGE_DELETE, key, cells))
                 self.rowids.pop(key, None)
         self.rows = new_rows
+        self._index_rebuild()
         return events
 
     def _emit(self, kind, key, cells) -> QueryEventChange:

@@ -213,8 +213,9 @@ class _DatagramPlane(asyncio.DatagramProtocol):
     # unreliable plane's legitimate response to a flood).
     MAX_PENDING = 1024
 
-    def __init__(self, handler) -> None:
+    def __init__(self, handler, owner: "Transport | None" = None) -> None:
         self._handler = handler
+        self._owner = owner
         self.transport: asyncio.DatagramTransport | None = None
         # Strong refs: the event loop only weak-refs tasks, and a GC'd
         # dispatch task would silently swallow a ping/ack.
@@ -224,6 +225,9 @@ class _DatagramPlane(asyncio.DatagramProtocol):
         self.transport = transport
 
     def datagram_received(self, data: bytes, addr) -> None:
+        if self._owner is not None:
+            self._owner._count("datagrams_recv")
+            self._owner._count("bytes_recv", len(data))
         if len(self._pending) >= self.MAX_PENDING:
             return  # flood: drop like any saturated datagram socket
         try:
@@ -266,24 +270,79 @@ class Transport:
     the TLS stream path.
     """
 
+    # Outbound datagram sockets, addr-hashed (the reference's 8 QUIC
+    # client endpoints, transport.rs:54-57): spreads kernel socket-buffer
+    # pressure across sockets under gossip bursts.
+    N_CLIENT_ENDPOINTS = 8
+
     def __init__(
         self,
         ssl_server=None,
         ssl_client=None,
         connect_timeout: float = 3.0,
         send_timeout: float = 5.0,
+        metrics=None,
     ) -> None:
         self._pool: dict[tuple[str, int], tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
         self._locks: dict[tuple[str, int], asyncio.Lock] = {}
         self._breakers: dict[tuple[str, int], Breaker] = {}
         self._server: asyncio.AbstractServer | None = None
         self._udp: asyncio.DatagramTransport | None = None
+        self._client_udp: list[asyncio.DatagramTransport] = []
         self._ssl_server = ssl_server
         self._ssl_client = ssl_client
         self.connect_timeout = connect_timeout
         # Blocking-send abort (the reference aborts a sync send blocked
         # > 5 s, peer.rs:352-355; same guard here for any frame send).
         self.send_timeout = send_timeout
+        # Aggregate transport metrics (Transport::emit_metrics,
+        # transport.rs:225+): frames/datagrams/bytes both ways, pooled
+        # connections, open breakers.
+        self._m = None
+        if metrics is not None:
+            self.bind_metrics(metrics)
+
+    def bind_metrics(self, registry) -> None:
+        self._m = {
+            "frames_sent": registry.counter(
+                "corro_peer_streams_sent", "stream frames sent"
+            ),
+            "frames_recv": registry.counter(
+                "corro_peer_streams_recv", "stream frames received"
+            ),
+            "datagrams_sent": registry.counter(
+                "corro_peer_datagrams_sent", "UDP datagrams sent"
+            ),
+            "datagrams_recv": registry.counter(
+                "corro_peer_datagrams_recv", "UDP datagrams received"
+            ),
+            "bytes_sent": registry.counter(
+                "corro_peer_bytes_sent", "wire bytes sent (frames+datagrams)"
+            ),
+            "bytes_recv": registry.counter(
+                "corro_peer_bytes_recv", "wire bytes received"
+            ),
+            "send_failures": registry.counter(
+                "corro_peer_send_failures", "failed frame sends"
+            ),
+            "conns": registry.gauge(
+                "corro_peer_connections", "pooled outbound connections"
+            ),
+            "breakers_open": registry.gauge(
+                "corro_peer_breakers_open", "peers with an open circuit breaker"
+            ),
+        }
+
+    def _count(self, key: str, n: int = 1) -> None:
+        if self._m is not None:
+            self._m[key].inc(n)
+
+    def _sample_gauges(self) -> None:
+        if self._m is not None:
+            self._m["conns"].set(len(self._pool))
+            self._m["breakers_open"].set(
+                sum(1 for b in self._breakers.values() if not b.available())
+            )
 
     # -- circuit breaker -----------------------------------------------------
 
@@ -297,17 +356,20 @@ class Transport:
 
     def send_datagram(self, addr: tuple[str, int], msg: dict) -> bool:
         """Unreliable, non-blocking single-packet send (the SWIM plane,
-        Transport::send_datagram, transport.rs:66-90). Returns False and
-        falls back to nothing when the packet exceeds MAX_DATAGRAM or the
-        UDP socket is absent — callers needing delivery-or-fallback use
-        ``send_packet``."""
-        if self._udp is None:
+        Transport::send_datagram, transport.rs:66-90) over one of the
+        addr-hashed client endpoints. Returns False when the packet
+        exceeds MAX_DATAGRAM or the UDP sockets are absent — callers
+        needing delivery-or-fallback use ``send_packet``."""
+        if self._udp is None or not self._client_udp:
             return False
         body = encode_frame(msg)[4:]  # kind + payload; packet self-delimits
         if len(body) > MAX_DATAGRAM:
             return False
+        sock = self._client_udp[hash(addr) % len(self._client_udp)]
         try:
-            self._udp.sendto(body, addr)
+            sock.sendto(body, addr)
+            self._count("datagrams_sent")
+            self._count("bytes_sent", len(body))
             return True
         except OSError:
             return False
@@ -333,13 +395,19 @@ class Transport:
             for attempt in (0, 1):
                 try:
                     _, writer = await self._conn(addr, fresh=attempt > 0)
-                    writer.write(encode_frame(msg))
+                    frame = encode_frame(msg)
+                    writer.write(frame)
                     await asyncio.wait_for(writer.drain(), self.send_timeout)
                     br.ok()
+                    self._count("frames_sent")
+                    self._count("bytes_sent", len(frame))
+                    self._sample_gauges()
                     return True
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     self._drop(addr)
         br.fail()
+        self._count("send_failures")
+        self._sample_gauges()
         return False
 
     async def open_session(
@@ -353,10 +421,13 @@ class Transport:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(*addr, ssl=self._ssl_client), timeout
             )
-            writer.write(encode_frame(first))
+            frame = encode_frame(first)
+            writer.write(frame)
             await writer.drain()
             br.ok()
-            return Session(reader, writer)
+            self._count("frames_sent")
+            self._count("bytes_sent", len(frame))
+            return Session(reader, writer, counter=self._count)
         except (ConnectionError, OSError, asyncio.TimeoutError):
             br.fail()
             return None
@@ -394,12 +465,13 @@ class Transport:
         only); if the UDP bind fails, gossip degrades to stream-only."""
 
         async def on_conn(reader, writer):
-            session = Session(reader, writer)
+            session = Session(reader, writer, counter=self._count)
             try:
                 while True:
                     msg = await read_frame(reader)
                     if msg is None:
                         break
+                    self._count("frames_recv")
                     await handler(session, msg)
             except (ConnectionError, asyncio.CancelledError):
                 pass
@@ -416,11 +488,29 @@ class Transport:
             try:
                 loop = asyncio.get_running_loop()
                 self._udp, _ = await loop.create_datagram_endpoint(
-                    lambda: _DatagramPlane(handler),
+                    lambda: _DatagramPlane(handler, self),
                     local_addr=(sock[0], sock[1]),
                 )
+                # Addr-hashed outbound endpoints (transport.rs:54-57's 8
+                # client endpoints). SWIM replies target the peer's
+                # ADVERTISED addr (from_addr in the packet), never the
+                # packet's source, so ephemeral-port client sockets are
+                # send-only.
+                for _ in range(self.N_CLIENT_ENDPOINTS):
+                    t, _p = await loop.create_datagram_endpoint(
+                        asyncio.DatagramProtocol,
+                        local_addr=(sock[0], 0),
+                    )
+                    self._client_udp.append(t)
             except OSError:
+                # Atomic: a failed client-endpoint bind must not leave a
+                # recv-only gossip socket behind (or leak it past close()).
+                if self._udp is not None:
+                    self._udp.close()
                 self._udp = None
+                for t in self._client_udp:
+                    t.close()
+                self._client_udp = []
         return sock[0], sock[1]
 
     def close(self) -> None:
@@ -428,26 +518,42 @@ class Transport:
             self._drop(addr)
         if self._udp is not None:
             self._udp.close()
+        for t in self._client_udp:
+            t.close()
+        self._client_udp = []
         if self._server is not None:
             self._server.close()
 
 
 class Session:
-    """One connection usable for framed request/stream exchanges."""
+    """One connection usable for framed request/stream exchanges. The
+    optional counter keeps sync-session traffic visible to the transport
+    metrics (emit_metrics parity — sync dominates wire bytes during
+    catch-up)."""
 
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+    def __init__(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+        counter=None,
+    ):
         self.reader = reader
         self.writer = writer
+        self._count = counter or (lambda key, n=1: None)
 
     async def send(self, msg: dict) -> None:
-        self.writer.write(encode_frame(msg))
+        frame = encode_frame(msg)
+        self.writer.write(frame)
         await self.writer.drain()
+        self._count("frames_sent")
+        self._count("bytes_sent", len(frame))
 
     async def recv(self, timeout: float = 30.0) -> dict | None:
         try:
-            return await asyncio.wait_for(read_frame(self.reader), timeout)
+            msg = await asyncio.wait_for(read_frame(self.reader), timeout)
         except asyncio.TimeoutError:
             return None
+        if msg is not None:
+            self._count("frames_recv")
+        return msg
 
     def close(self) -> None:
         try:

@@ -628,3 +628,125 @@ def test_subscription_replays_events_missed_while_down(tmp_path):
             await b.stop()
 
     run(main())
+
+
+def test_join_subscription_pk_identity(tmp_path):
+    """Join subscriptions keep per-result-row PK identity (the Matcher's
+    multi-table PK aliasing, pubsub.rs:566-661): a cell update on either
+    side emits an UPDATE (not a delete+insert pair), one-to-many joins
+    keep distinct row identities, and candidate diffing — not a full
+    re-evaluation — serves join batches."""
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        try:
+            h = a.agent.subs.subscribe(
+                "SELECT a.text, b.text FROM tests a"
+                " JOIN tests2 b ON a.id = b.id"
+            )
+            assert h._pk_segments is not None, "join PK aliasing must engage"
+            assert h._local_membership
+
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'l1')"]]
+            )
+            await a.client.execute(
+                [["INSERT INTO tests2 (id, text) VALUES (1, 'r1')"]]
+            )
+
+            async def joined():
+                return any(ev.kind == "insert" for ev in h.history)
+
+            await poll_until(joined, timeout=10)
+
+            # Cell update on the RIGHT side: must surface as an update of
+            # the same row identity, not delete+insert.
+            n_before = len(h.history)
+            await a.client.execute(
+                [["UPDATE tests2 SET text = 'r1b' WHERE id = 1"]]
+            )
+
+            async def updated():
+                new = list(h.history)[n_before:]
+                return any(ev.kind == "update" for ev in new)
+
+            await poll_until(updated, timeout=10)
+            new = list(h.history)[n_before:]
+            assert not any(ev.kind == "delete" for ev in new), new
+            assert [list(h.rows.values())[0]] == [("l1", "r1b")]
+
+            # One-to-many: a second right-side row for the same left row
+            # creates a NEW identity (insert), leaving the first row alone.
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (2, 'l2')"]]
+            )
+            await a.client.execute(
+                [["INSERT INTO tests2 (id, text) VALUES (2, 'r2')"]]
+            )
+
+            async def two_rows():
+                return len(h.rows) == 2
+
+            await poll_until(two_rows, timeout=10)
+            assert len(set(h.rowids.values())) == 2
+
+            # Right-side delete removes exactly its join row.
+            await a.client.execute([["DELETE FROM tests2 WHERE id = 1"]])
+
+            async def one_left():
+                return len(h.rows) == 1
+
+            await poll_until(one_left, timeout=10)
+            assert list(h.rows.values()) == [("l2", "r2")]
+            kinds = [ev.kind for ev in h.history]
+            assert "delete" in kinds
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_join_subscription_large_sub_uses_candidate_path(tmp_path):
+    """Cost pin for joined subs (VERDICT weak #4): on a large joined
+    result set, a small change batch must go through candidate diffing,
+    never a full re-evaluation."""
+    from corrosion_tpu.agent.agent import Agent, AgentConfig
+    from corrosion_tpu.agent.subs import SubsManager
+    from corrosion_tpu.agent.testing import TEST_SCHEMA
+
+    a = Agent(AgentConfig(data_dir=str(tmp_path / "a"), schema_sql=TEST_SCHEMA))
+    a.subs = SubsManager(a.store)
+    try:
+        stmts = []
+        for i in range(500):
+            stmts.append(
+                Statement("INSERT INTO tests (id, text) VALUES (?, ?)",
+                          params=[i, f"l{i}"])
+            )
+            stmts.append(
+                Statement("INSERT INTO tests2 (id, text) VALUES (?, ?)",
+                          params=[i, f"r{i}"])
+            )
+        a.execute(stmts)
+        h = a.agent_subscribe = a.subs.subscribe(
+            "SELECT a.id, b.text FROM tests a JOIN tests2 b ON a.id = b.id"
+        )
+        assert len(h.rows) == 500
+        evals = 0
+        orig = h._evaluate
+
+        def counting():
+            nonlocal evals
+            evals += 1
+            return orig()
+
+        h._evaluate = counting
+        a.execute(
+            [Statement("UPDATE tests2 SET text = 'bump' WHERE id = 250")]
+        )
+        assert evals == 0, "small join batch must not full-re-evaluate"
+        assert h.rows[(250, 250)] == (250, "bump")
+        kinds = [ev.kind for ev in h.history]
+        assert kinds[-1] == "update"
+    finally:
+        a.store.close()
