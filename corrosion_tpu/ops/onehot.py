@@ -115,9 +115,13 @@ def rowmax(
         idx = jnp.where(mask, idx, -1)
         val = jnp.where(mask, val, 0)
     if not _use_pallas(r * m * width):
+        # Reduce over the MINOR-MOST axis: [R, W, M] with the M messages
+        # last lets XLA fuse the compare+select straight into a row
+        # reduction (the [R, M, W] middle-axis form materialized ~30 GB
+        # per call at wan_100k shapes).
         ids = jnp.arange(width, dtype=idx.dtype)
-        hit = idx[:, :, None] == ids[None, None, :]
-        return jnp.max(jnp.where(hit, val[:, :, None], 0), axis=1)
+        hit = idx[:, None, :] == ids[None, :, None]
+        return jnp.max(jnp.where(hit, val[:, None, :], 0), axis=2)
     bn = _block_rows(m, width)
     rows_p = -(-r // bn) * bn
     out = pl.pallas_call(
