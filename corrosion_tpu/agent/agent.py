@@ -899,11 +899,17 @@ class Agent:
             "corro_runtime_counted_handles",
             "tasks tracked by the counted-spawn registry",
         )
+        log = logging.getLogger(__name__)
         interval = 1.0
         while not self.tripwire.tripped:
             t0 = time.monotonic()
             await asyncio.sleep(interval)
-            lag_hist.observe(max(time.monotonic() - t0 - interval, 0.0))
+            lag = max(time.monotonic() - t0 - interval, 0.0)
+            lag_hist.observe(lag)
+            if lag > 1.0:
+                # Slow-turn watchdog (the foca loop warns past 1 s,
+                # broadcast/mod.rs:296-300): something blocked the loop.
+                log.warning("event loop blocked for %.2fs", lag)
             try:
                 tasks_g.set(len(asyncio.all_tasks()))
             except RuntimeError:
