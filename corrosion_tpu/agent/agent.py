@@ -92,6 +92,9 @@ class AgentConfig:
     # WAL truncation cadence (the reference checkpoints + times WAL
     # truncation in its db_cleanup loop, agent.rs:956-967, 1413-1435).
     wal_checkpoint_interval: float = 15.0
+    # Member-state persistence cadence (diff_member_states every 60 s,
+    # broadcast/mod.rs:570-702); persisted members seed rejoin at restart.
+    member_persist_interval: float = 60.0
     tls: "AgentTls | None" = None  # gossip-plane TLS (None = plaintext)
     prometheus_addr: str = ""  # host:port for /metrics ("" = disabled)
     trace_export_path: str = ""  # JSON-lines span export ("" = in-memory)
@@ -251,7 +254,18 @@ class Agent:
         if self.subs is not None:
             # Restore persisted subscriptions (agent.rs:373-419).
             self.subs.restore()
+        # Rejoin via persisted member states (agent.rs:772-831): a restarted
+        # node reaches its old cluster even when the bootstrap seeds are
+        # gone. The failure detector prunes any that died while we were
+        # down.
+        self._members_persisted: dict[str, tuple] = {}
+        restored_members = self._load_members()
+        for m in restored_members[:10]:
+            await self.swim.announce(m.addr)
         self.tasks.spawn(self._swim_loop(), name="swim_loop")
+        self.tasks.spawn(
+            self._members_persist_loop(), name="diff_member_states"
+        )
         self.tasks.spawn(self._broadcast_loop(), name="broadcast_loop")
         self.tasks.spawn(self._ingest_loop(), name="handle_changes")
         self.tasks.spawn(self._sync_loop(), name="sync_loop")
@@ -308,6 +322,13 @@ class Agent:
         if self._empties:
             try:
                 await self._flush_empties()
+            except Exception:
+                pass
+        # Final member-state flush: a node cleanly restarted within the
+        # persist interval must still find its cluster in __corro_members.
+        if getattr(self, "_members_persisted", None) is not None:
+            try:
+                await self._persist_members_once()
             except Exception:
                 pass
         self.transport.close()
@@ -880,6 +901,90 @@ class Agent:
                 # the failure entirely.
                 logging.getLogger(__name__).debug(
                     "metrics sample failed", exc_info=True
+                )
+
+    # -- member-state persistence (diff_member_states) -------------------------
+
+    def _load_members(self) -> list:
+        """Seed Members from __corro_members (setup-time, before loops)."""
+        from corrosion_tpu.agent.membership import DOWN, SUSPECT
+
+        restored = []
+        with self.store._wlock("members_load"):
+            # Down rows are last-run corpses: the live cluster re-teaches
+            # anything real, and without this a restart before the 48 h GC
+            # horizon would orphan them forever (no in-memory entry means
+            # the persist loop's `gone` diff never covers them).
+            self.store.conn.execute(
+                "DELETE FROM __corro_members WHERE state = ?", (DOWN,)
+            )
+        for aid, addr_s, state, inc, _ts in self.store.conn.execute(
+            "SELECT actor_id, addr, state, incarnation, updated_at"
+            " FROM __corro_members"
+        ).fetchall():
+            if aid == self.actor_id:
+                continue
+            host, _, port = addr_s.rpartition(":")
+            addr = (host, int(port))
+            if self.members.apply_update(aid, addr, state, inc):
+                m = self.members.states[aid]
+                if state == SUSPECT:
+                    # Fresh suspicion timer: a stale persisted suspect_at
+                    # of 0 would expire to DOWN on the first probe round
+                    # and gossip a spurious DOWN rumor cluster-wide.
+                    m.suspect_at = time.monotonic()
+                restored.append(m)
+        return restored
+
+    async def _persist_members_once(self) -> None:
+        """One diff-persist pass: only rows whose (addr, state,
+        incarnation) moved are written; members GC'd from the in-memory
+        table are deleted."""
+        current = {
+            aid: (f"{m.addr[0]}:{m.addr[1]}", m.state, m.incarnation)
+            for aid, m in self.members.states.items()
+        }
+        changed = [
+            (aid, v) for aid, v in current.items()
+            if self._members_persisted.get(aid) != v
+        ]
+        gone = [aid for aid in self._members_persisted if aid not in current]
+        if not changed and not gone:
+            return
+        now = time.time()
+
+        def db_work() -> None:
+            with self.store._wlock("members_persist"):
+                self.store.conn.executemany(
+                    "INSERT OR REPLACE INTO __corro_members"
+                    " VALUES (?, ?, ?, ?, ?)",
+                    [
+                        (aid, addr, state, inc, now)
+                        for aid, (addr, state, inc) in changed
+                    ],
+                )
+                self.store.conn.executemany(
+                    "DELETE FROM __corro_members WHERE actor_id = ?",
+                    [(aid,) for aid in gone],
+                )
+
+        if self.pool is not None:
+            await self.pool.write_low(db_work)
+        else:
+            db_work()
+        self._members_persisted = current
+
+    async def _members_persist_loop(self) -> None:
+        """Persist member-state diffs on a cadence (diff_member_states,
+        broadcast/mod.rs:570-702); stop() runs a final pass so a clean
+        shutdown loses nothing."""
+        while not self.tripwire.tripped:
+            await asyncio.sleep(self.cfg.member_persist_interval)
+            try:
+                await self._persist_members_once()
+            except Exception:
+                logging.getLogger(__name__).debug(
+                    "member persist failed", exc_info=True
                 )
 
     async def _runtime_metrics_loop(self) -> None:

@@ -255,6 +255,16 @@ class Store:
                 " last_seq INTEGER NOT NULL, ts INTEGER NOT NULL,"
                 " PRIMARY KEY (actor_id, version, start_seq)) WITHOUT ROWID"
             )
+            # SWIM member states persisted for restart rejoin + operator
+            # introspection (diff_member_states upserts into
+            # __corro_members every 60 s, broadcast/mod.rs:570-702; loaded
+            # back at setup, agent.rs:772-831).
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS __corro_members ("
+                " actor_id TEXT PRIMARY KEY, addr TEXT NOT NULL,"
+                " state TEXT NOT NULL, incarnation INTEGER NOT NULL,"
+                " updated_at REAL NOT NULL) WITHOUT ROWID"
+            )
             # A crash between apply_changes' COMMIT and its flag reset would
             # otherwise leave apply_remote=1 persisted, silently muting all
             # local-change triggers on restart.

@@ -750,3 +750,53 @@ def test_join_subscription_large_sub_uses_candidate_path(tmp_path):
         assert kinds[-1] == "update"
     finally:
         a.store.close()
+
+
+def test_members_persist_and_rejoin_without_bootstrap(tmp_path):
+    """diff_member_states parity (broadcast/mod.rs:570-702 + agent.rs:772-
+    831): member states persist to __corro_members on a cadence, and a
+    restarted agent rejoins its cluster from them with NO bootstrap seeds."""
+    async def main():
+        a = await launch_test_agent(
+            str(tmp_path / "a"), probe_interval=0.1,
+            member_persist_interval=0.2,
+        )
+        b = await launch_test_agent(
+            str(tmp_path / "b"), bootstrap=[a.gossip_addr],
+            probe_interval=0.1, member_persist_interval=0.2,
+        )
+        try:
+            async def persisted():
+                rows = b.agent.store.conn.execute(
+                    "SELECT actor_id, state FROM __corro_members"
+                ).fetchall()
+                return any(r[0] == a.agent.actor_id for r in rows)
+
+            await poll_until(persisted, timeout=10.0)
+        finally:
+            await b.stop()
+
+        # Restart b with NO bootstrap: it must rejoin via the persisted
+        # member table (a's gossip addr is stable here).
+        b2 = await launch_test_agent(
+            str(tmp_path / "b"), probe_interval=0.1,
+            member_persist_interval=0.2,
+        )
+        try:
+            assert b2.agent.cfg.bootstrap == []
+
+            async def rejoined():
+                return any(
+                    m.actor_id == a.agent.actor_id
+                    for m in b2.agent.members.alive()
+                ) and any(
+                    m.actor_id == b2.agent.actor_id
+                    for m in a.agent.members.alive()
+                )
+
+            await poll_until(rejoined, timeout=10.0)
+        finally:
+            await b2.stop()
+            await a.stop()
+
+    run(main())
