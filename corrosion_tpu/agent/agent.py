@@ -468,6 +468,18 @@ class Agent:
             # Backups strip __corro_subs (node-local): recreate it and
             # re-persist this node's live subscriptions.
             self.subs.reinit_after_restore()
+        # Backups also strip __corro_members: recreate it and force the
+        # next persist pass to rewrite every live member (an empty diff
+        # snapshot makes all rows "changed"), or member persistence would
+        # die silently until the next full restart.
+        with self.store._wlock("members_reinit"):
+            self.store.conn.execute(
+                "CREATE TABLE IF NOT EXISTS __corro_members ("
+                " actor_id TEXT PRIMARY KEY, addr TEXT NOT NULL,"
+                " state TEXT NOT NULL, incarnation INTEGER NOT NULL,"
+                " updated_at REAL NOT NULL) WITHOUT ROWID"
+            )
+        self._members_persisted = {}
         return self.actor_id
 
     def _persist_bookkeeping(self, actor, version, dbv, last_seq, ts) -> None:
@@ -907,6 +919,7 @@ class Agent:
 
     def _load_members(self) -> list:
         """Seed Members from __corro_members (setup-time, before loops)."""
+        from corrosion_tpu.agent.config import parse_addr
         from corrosion_tpu.agent.membership import DOWN, SUSPECT
 
         restored = []
@@ -924,8 +937,7 @@ class Agent:
         ).fetchall():
             if aid == self.actor_id:
                 continue
-            host, _, port = addr_s.rpartition(":")
-            addr = (host, int(port))
+            addr = parse_addr(addr_s)
             if self.members.apply_update(aid, addr, state, inc):
                 m = self.members.states[aid]
                 if state == SUSPECT:

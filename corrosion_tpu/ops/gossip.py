@@ -839,13 +839,17 @@ def _sync_rows(
                 cum[:, None, :] <= e[None, :, None], axis=2, dtype=jnp.int32
             )
             w_idx = jnp.minimum(w_idx, cfg.n_writers - 1)
+            # One-hot rowgathers (fused) — take_along_axis at [R, B]←[R, W]
+            # lowers as a serialized dynamic gather.
             prev = jnp.where(
                 w_idx > 0,
-                jnp.take_along_axis(cum, jnp.maximum(w_idx - 1, 0), axis=1),
+                _onehot_rowgather(
+                    cum.astype(jnp.uint32), jnp.maximum(w_idx - 1, 0)
+                ).astype(jnp.int32),
                 0,
             )
             ver = (
-                jnp.take_along_axis(contig0, w_idx, axis=1)
+                _onehot_rowgather(contig0, w_idx)
                 + 1
                 + (e[None, :] - prev).astype(jnp.uint32)
             )

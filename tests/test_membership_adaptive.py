@@ -100,4 +100,3 @@ def test_sparse_gc_disabled_keeps_down():
     )
     kept = (packed_sev(state.exc_pkd) == SEV_DOWN) & (state.exc_tgt == 3)
     assert bool(jnp.any(kept))
-
