@@ -15,16 +15,25 @@ TPU-native equivalent of the reference's dissemination machinery:
   ``sync_budget`` in versions).
 
 State model: ``W`` writer streams; node i tracks per writer w a contiguous
-watermark ``contig[i, w]`` (i holds versions 1..contig) and ``seen[i, w]``
-(highest version heard of — the gap ``seen - contig`` is exactly the
-reference's `sync_need`). A change (w, v) is *visible* at i once
-``contig[i, w] >= v``; version-granular tracking matches the reference's
-bookkeeping (`__corro_bookkeeping` versions), with sub-version seq chunking
-left to the host agent.
+watermark ``contig[i, w]`` (i holds versions 1..contig), ``seen[i, w]``
+(highest version heard of), and — when ``window_k > 0`` — an out-of-order
+possession window ``oo[:, i, w]``: a ``window_k``-bit little-endian bitmask
+whose bit b means "i also holds version contig + 1 + b". The reference
+applies *complete* versions in any order and tracks arbitrary gap ranges
+per actor (`process_multiple_changes`, corro-agent/src/agent.rs:1809-2060;
+gap ranges in `sync_need`, corro-types/src/agent.rs:1041-1046); the window
+is the bounded-tensor form of that RangeSet — versions applied ahead of a
+loss-induced gap become visible immediately, while anti-entropy fills the
+holes and promotes the watermark through them. A change (w, v) is *visible*
+at i once ``contig[i, w] >= v`` or its window bit is set; the unbounded
+tail (v > contig + window_k) degrades to the old pessimistic in-order
+behavior (tracked in ``seen`` only, healed by sync), which under-claims
+possession — always safe, never wrong.
 
-In-order delivery without per-pair buffers: queues stay version-sorted, and
-delivery scans queue slots in order, so a burst of versions from one sender
-applies in sequence within a single round.
+Delivery without per-pair buffers: queues stay version-sorted, and delivery
+scans queue slots in order, so a burst of versions from one sender applies
+in sequence within a single round; arrivals beyond a gap land in the
+window.
 """
 
 from __future__ import annotations
@@ -82,8 +91,18 @@ class GossipConfig:
     # cell key space has n_cells keys; each write touches cells_per_write.
     n_cells: int = 0
     cells_per_write: int = 1
+    # Out-of-order possession window (bits per (node, writer) above contig;
+    # multiple of 32, 0 = strict in-order). Models the reference's apply-
+    # in-any-order + gap-range bookkeeping (agent.rs:1809-2060) within a
+    # bounded tensor; see the module docstring.
+    window_k: int = 32
 
     def __post_init__(self):
+        if self.window_k < 0 or self.window_k % 32 != 0:
+            raise ValueError(
+                f"window_k must be a non-negative multiple of 32, got "
+                f"{self.window_k}"
+            )
         if self.sync_peers > self.sync_candidates:
             raise ValueError(
                 f"sync_peers ({self.sync_peers}) must be <= "
@@ -202,6 +221,8 @@ class DataState(NamedTuple):
     head: jax.Array  # u32[W] writer's committed version head
     contig: jax.Array  # u32[N, W] contiguous watermark per (node, writer)
     seen: jax.Array  # u32[N, W] highest version heard of
+    oo: jax.Array  # u32[B, N, W] out-of-order window words (B = window_k/32)
+    oo_any: jax.Array  # bool[] any window bit set anywhere (lax.cond gate)
     q_writer: jax.Array  # i32[N, Q] (-1 = empty)
     q_ver: jax.Array  # u32[N, Q]
     q_tx: jax.Array  # i32[N, Q] transmissions left
@@ -214,6 +235,8 @@ def init_data(cfg: GossipConfig) -> DataState:
         head=jnp.zeros((w,), jnp.uint32),
         contig=jnp.zeros((n, w), jnp.uint32),
         seen=jnp.zeros((n, w), jnp.uint32),
+        oo=jnp.zeros((cfg.window_k // 32, n, w), jnp.uint32),
+        oo_any=jnp.array(False),
         q_writer=jnp.full((n, q), -1, jnp.int32),
         q_ver=jnp.zeros((n, q), jnp.uint32),
         q_tx=jnp.zeros((n, q), jnp.int32),
@@ -221,11 +244,131 @@ def init_data(cfg: GossipConfig) -> DataState:
     )
 
 
+# -- out-of-order possession window -------------------------------------------
+#
+# The window is a B-word little-endian bitfield per (node, writer), anchored
+# one above contig: bit b of the field means possession of version
+# contig + 1 + b. All ops are word-unrolled elementwise jnp (B is 1-2 in
+# practice), so they fuse into the surrounding round.
+
+
+def _trailing_ones(oo: jax.Array) -> jax.Array:
+    """i32[...]: count of consecutive set bits from bit 0 of the B-word
+    field — how far contig can promote through the window."""
+    t = jnp.zeros(oo.shape[1:], jnp.int32)
+    carry = jnp.ones(oo.shape[1:], bool)
+    for b in range(oo.shape[0]):
+        tb = jax.lax.population_count(
+            oo[b] & ~(oo[b] + jnp.uint32(1))
+        ).astype(jnp.int32)
+        t = t + jnp.where(carry, tb, 0)
+        carry = carry & (tb == 32)
+    return t
+
+
+def _window_shift(oo: jax.Array, t: jax.Array) -> jax.Array:
+    """Right-shift the B-word bitfield by t (i32[...], 0 <= t <= 32B) —
+    the re-anchor after contig advances by t."""
+    nw = oo.shape[0]
+    outs = []
+    for i in range(nw):
+        acc = jnp.zeros_like(oo[i])
+        for j in range(i, nw):
+            s = t - 32 * (j - i)
+            sr = jnp.clip(s, 0, 31).astype(jnp.uint32)
+            sl = jnp.clip(-s, 0, 31).astype(jnp.uint32)
+            acc = (
+                acc
+                | jnp.where((s >= 0) & (s < 32), oo[j] >> sr, jnp.uint32(0))
+                | jnp.where((s > -32) & (s < 0), oo[j] << sl, jnp.uint32(0))
+            )
+        outs.append(acc)
+    return jnp.stack(outs) if nw else oo
+
+
+def window_absorb(
+    contig: jax.Array,  # u32[..., W] watermark BEFORE this round's advance
+    oo: jax.Array,  # u32[B, ..., W] window anchored at ``contig``
+    adv: jax.Array,  # i32[..., W] in-order advance being applied now
+    new_bits: jax.Array,  # u32[B, ..., W] new possession, anchored at contig+adv
+) -> tuple[jax.Array, jax.Array]:
+    """Advance the watermark by ``adv``, fold newly-possessed out-of-order
+    versions into the window, then promote contig through any now-contiguous
+    prefix (the RangeSet-coalesce step of the reference's bookkeeping,
+    corro-types/src/agent.rs:1009-1047). Returns (contig', oo')."""
+    oo = _window_shift(oo, adv) | new_bits
+    t = _trailing_ones(oo)
+    return (
+        contig + adv.astype(jnp.uint32) + t.astype(jnp.uint32),
+        _window_shift(oo, t),
+    )
+
+
+def _window_admit(
+    oo: jax.Array,  # u32[B, N, W] window anchored at contig_pre
+    contig_pre: jax.Array,  # u32[N, W]
+    adv: jax.Array,  # u32[N, W] this round's in-order advance
+    adv_m: jax.Array,  # u32[N, K] adv gathered per message's (row, writer)
+    d: jax.Array,  # u32[N, K] true delta of each message above contig_pre
+    valid: jax.Array,  # bool[N, K] live, deduped messages (sentinels out)
+    wk: int,
+    gather_word,  # (u32[N, W]) -> u32[N, K]: per-message word lookup
+    assemble_word,  # (u32[N, K]) -> u32[N, W]: OR contributions by writer
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared out-of-order admission for both delivery paths (they differ
+    only in gather/scatter primitive): decide which arrivals land in the
+    window, assemble their bits, absorb. Each admitted (row, writer, bit)
+    is unique — ``valid`` is deduped and already-set bits are masked — so
+    the assemble step's ADD is an exact bitwise OR. Returns
+    (contig', oo', newly_possessed mask)."""
+    d_rel = d - adv_m  # meaningful only when d > adv_m
+    in_win = valid & (d > adv_m) & (d_rel <= jnp.uint32(wk))
+    # Already possessed in the OLD window (bit d-1 relative to contig_pre)?
+    # Those were merged + rebroadcast at first receipt — only `seen` cares
+    # about this copy.
+    bit_old = d - 1
+    prev_poss = jnp.zeros_like(in_win)
+    for b in range(oo.shape[0]):
+        wordv = gather_word(oo[b])
+        sh = jnp.minimum(bit_old - jnp.uint32(32 * b), jnp.uint32(31))
+        inb = (bit_old >= 32 * b) & (bit_old < 32 * (b + 1))
+        prev_poss = prev_poss | (inb & (((wordv >> sh) & 1) == 1))
+    new_poss = in_win & ~prev_poss
+    bit_new = d_rel - 1
+    words = []
+    for b in range(oo.shape[0]):
+        sh = jnp.minimum(bit_new - jnp.uint32(32 * b), jnp.uint32(31))
+        inb = new_poss & (bit_new >= 32 * b) & (bit_new < 32 * (b + 1))
+        words.append(
+            assemble_word(
+                jnp.where(inb, jnp.uint32(1) << sh, jnp.uint32(0))
+            )
+        )
+    contig2, oo2 = window_absorb(
+        contig_pre, oo, adv.astype(jnp.int32), jnp.stack(words)
+    )
+    return contig2, oo2, new_poss
+
+
+def window_possession(data: DataState) -> jax.Array:
+    """i64-free possession count per (node, writer): contig + set window
+    bits. (Diagnostics/tests; visibility() answers per-version queries.)"""
+    bits = jnp.zeros(data.contig.shape, jnp.uint32)
+    for b in range(data.oo.shape[0]):
+        bits = bits + jax.lax.population_count(data.oo[b])
+    return data.contig + bits
+
+
 # Row-local scatter-max / take_along_axis as one-hot reductions (Pallas
 # VMEM kernels at scale, jnp broadcast below threshold — see ops/onehot.py
 # for the measured rationale).
 _onehot_rowmax = onehot.rowmax
 _onehot_rowgather = onehot.rowgather
+
+# Writer-axis width above which delivery switches from the dense one-hot
+# form to the sort+scatter form (module-level so tests can force either
+# path at small sizes).
+_FAST_MAX_WRITERS = 2048
 
 
 def _merge_versions_dense(
@@ -388,8 +531,9 @@ def broadcast_round(
         fast = (
             cfg.rebroadcast_fresh_budget
             and not cfg.rebroadcast_stale
-            and w_count <= 2048
+            and w_count <= _FAST_MAX_WRITERS
         )
+        wk = cfg.window_k
         if fast:
             # ---- 3a. delta-packed one-hot delivery (default policy) --------
             # Two structural moves, both TPU-shaped:
@@ -410,16 +554,17 @@ def broadcast_round(
             mw_safe = jnp.maximum(m_w, 0)
             contig_pre = contig
             base_m = _onehot_rowgather(contig_pre, mw_safe)  # u32[N, kk]
-            k2 = kk + 3
+            lim = max(kk, wk)
+            k2 = lim + 3
             assert w_count * k2 < (1 << 32) - 1, "packed delivery key overflow"
             # Stale copies (v <= contig) affect nothing at all (seen >=
-            # contig is invariant); far-ahead copies (delta > kk — more
-            # versions than messages, so unapplyable this round) matter
-            # only for `seen`, so their delta clamps to the kk+1 sentinel
+            # contig is invariant); far-ahead copies (delta > max(kk, wk) —
+            # beyond both the longest possible run and the window) matter
+            # only for `seen`, so their delta clamps to the lim+1 sentinel
             # and their true version rides the sort as an operand.
             useful = m_ok & (m_v > base_m)
             d_raw = jnp.where(useful, m_v - base_m, 0)
-            dc = jnp.minimum(d_raw, jnp.uint32(kk + 1))
+            dc = jnp.minimum(d_raw, jnp.uint32(lim + 1))
             sent_key = jnp.uint32(w_count * k2)
             pkd = jnp.where(
                 useful, m_w.astype(jnp.uint32) * k2 + dc, sent_key
@@ -441,7 +586,8 @@ def broadcast_round(
             )
             # Deltas are relative to contig, so a run is simply the chain
             # 1, 2, ... (duplicates repeat a delta and keep the chain);
-            # clamped far-ahead entries (kk+1) never extend a run.
+            # clamped far-ahead entries (lim+1) never extend a run, and a
+            # run can't be longer than the kk messages that carry it.
             ok_link = (
                 jnp.where(seg_start, d2 == 1, d2 <= prev_d + 1)
                 & (d2 <= kk)
@@ -454,15 +600,57 @@ def broadcast_round(
             # at scale): the applied watermark advance per (row, writer) is
             # the max applied delta (runs are 1..len), and `seen` is the
             # max heard version.
-            contig = contig_pre + _onehot_rowmax(w2, d2, applied, w_count)
+            adv = _onehot_rowmax(w2, d2, applied, w_count)  # u32[N, W]
             seen = jnp.maximum(
                 seen, _onehot_rowmax(w2, v2, valid2, w_count)
             )
-            # First receipts: one copy per newly applied version. Stale and
-            # duplicate copies re-merge content already merged when the
+            # First receipts: one copy per newly possessed version. Stale
+            # and duplicate copies re-merge content already merged when the
             # version was first applied/granted — idempotent, so masking
             # them off the CRDT merge changes nothing but the traffic.
-            fresh = applied & ~((~seg_start) & (d2 == prev_d))
+            first_copy = ~((~seg_start) & (d2 == prev_d))
+            fresh_run = applied & first_copy
+            if wk:
+                # Out-of-order arrivals land in the possession window
+                # (module docstring). All window machinery — the per-message
+                # advance gather, the old-bit check, the bit assembly and
+                # the absorb shifts — rides a lax.cond gated on "any live
+                # window bit or any arrival beyond its run", so rounds with
+                # purely in-order delivery (the no-loss steady state) pay
+                # one elementwise predicate and nothing else.
+                oo_pred = data.oo_any | jnp.any(
+                    valid2 & ~applied & (d2 <= jnp.uint32(lim))
+                )
+
+                def _with_window(oo):
+                    # d2 <= lim excludes the clamped sentinel: its TRUE
+                    # delta is unknown (> lim), so admitting it would set a
+                    # bit for a version the node does not hold. Deltas are
+                    # window-relative above contig_pre + adv (adv gathered
+                    # per message's writer).
+                    contig2, oo2, new_poss = _window_admit(
+                        oo, contig_pre, adv,
+                        _onehot_rowgather(adv, w2),
+                        d2,
+                        valid2 & first_copy & (d2 <= jnp.uint32(lim)),
+                        wk,
+                        lambda word: _onehot_rowgather(word, w2),
+                        lambda contrib: onehot.rowsum(
+                            w2, contrib, None, w_count
+                        ),
+                    )
+                    return contig2, oo2, fresh_run | new_poss, jnp.any(oo2)
+
+                def _no_window(oo):
+                    return contig_pre + adv, oo, fresh_run, jnp.array(False)
+
+                contig, oo_new, fresh, oo_any_new = jax.lax.cond(
+                    oo_pred, _with_window, _no_window, data.oo
+                )
+            else:
+                contig = contig_pre + adv
+                oo_new, oo_any_new = data.oo, data.oo_any
+                fresh = fresh_run
             if cfg.n_cells > 0:
                 cells, m = _merge_versions_dense(
                     cells, None, w2, v2, fresh, None, n, cfg
@@ -511,9 +699,11 @@ def broadcast_round(
                 ok_link & valid2, seg_start
             )
             # Applied = delivered versions on an unbroken run from contig+1.
-            rw2 = nodes[:, None] * w_count + jnp.minimum(w2, w_count - 1)
+            contig_pre = contig
+            w2c = jnp.minimum(w2, w_count - 1)
+            rw2 = nodes[:, None] * w_count + w2c
             applied_v = jnp.where(run & valid2, v2, 0)
-            contig = (
+            contig_run = (
                 contig.reshape(-1)
                 .at[rw2.reshape(-1)]
                 .max(applied_v.reshape(-1))
@@ -525,13 +715,56 @@ def broadcast_round(
                 .max(jnp.where(valid2, v2, 0).reshape(-1))
                 .reshape(n, w_count)
             )
+            prev_same = (~seg_start) & (v2 == prev_v)
+
+            if wk:
+                # Out-of-order window, sort+scatter flavor (see the fast
+                # path above for the policy comments). Uniqueness of each
+                # (row, writer, bit) contribution makes scatter-ADD of
+                # distinct powers of two an exact bitwise OR.
+                adv = contig_run - contig_pre  # u32[N, W]
+                oo_pred = data.oo_any | jnp.any(
+                    valid2 & ~run & (v2 > base)
+                )
+
+                def _with_window(oo):
+                    contig2, oo2, new_poss = _window_admit(
+                        oo, contig_pre, adv,
+                        take(adv, w2c, axis=1),
+                        jnp.where(valid2, v2 - base, 0),
+                        valid2 & ~prev_same,
+                        wk,
+                        lambda word: take(word, w2c, axis=1),
+                        lambda contrib: (
+                            jnp.zeros((n * w_count,), jnp.uint32)
+                            .at[rw2.reshape(-1)]
+                            .add(contrib.reshape(-1))
+                            .reshape(n, w_count)
+                        ),
+                    )
+                    return contig2, oo2, new_poss, jnp.any(oo2)
+
+                def _no_window(oo):
+                    return (
+                        contig_run, oo,
+                        jnp.zeros_like(valid2), jnp.array(False),
+                    )
+
+                contig, oo_new, extra_poss, oo_any_new = jax.lax.cond(
+                    oo_pred, _with_window, _no_window, data.oo
+                )
+            else:
+                contig = contig_run
+                oo_new, oo_any_new = data.oo, data.oo_any
+                extra_poss = jnp.zeros_like(valid2)
 
             if cfg.n_cells > 0:
-                # Receivers materialize every message on the applied run.
-                # Row-dense merge (the cell-key axis is always narrow).
+                # Receivers materialize every message on the applied run
+                # plus window-possessed arrivals. Row-dense merge (the
+                # cell-key axis is always narrow).
                 cells, m = _merge_versions_dense(
-                    cells, None, jnp.minimum(w2, w_count - 1), v2,
-                    run & valid2, None, n, cfg,
+                    cells, None, w2c, v2,
+                    (run & valid2) | extra_poss, None, n, cfg,
                 )
                 n_merges += m
 
@@ -542,11 +775,13 @@ def broadcast_round(
             # keep circulating at inherited budgets), while the fresh-budget
             # policy admits only first receipts but with the holder's full
             # budget (the reference's per-holder requeue,
-            # broadcast/mod.rs:549-563).
-            prev_same = (~seg_start) & (v2 == prev_v)
+            # broadcast/mod.rs:549-563). Window-possessed arrivals are
+            # newly applied changes and rebroadcast like any other
+            # (agent.rs:2040-2057).
             fresh = run & valid2 & ~prev_same
             if not cfg.rebroadcast_stale:
                 fresh &= v2 > base
+            fresh = fresh | extra_poss
             if cfg.rebroadcast_fresh_budget:
                 intake_ok = fresh
                 in_budget = jnp.full_like(tx2, cfg.max_transmissions)
@@ -556,7 +791,7 @@ def broadcast_round(
             in_mask, (in_w, in_v, in_tx) = routing.rebuild_bounded_queue(
                 intake_ok,
                 -v2.astype(jnp.int32),  # oldest versions first, like the queue
-                (jnp.minimum(w2, w_count - 1), v2, in_budget),
+                (w2c, v2, in_budget),
                 k_in,
             )
             in_w = jnp.where(in_mask, in_w, -1)
@@ -575,6 +810,7 @@ def broadcast_round(
         in_v = jnp.zeros((n, 0), jnp.uint32)
         in_tx = jnp.zeros((n, 0), jnp.int32)
         sent_any = jnp.zeros((n,), dtype=bool)
+        oo_new, oo_any_new = data.oo, data.oo_any
 
     # ---- 5. queue rebuild (oldest versions first, like the FIFO buffer) ----
     # An entry's tx budget burns only when the sender actually reached at
@@ -626,6 +862,8 @@ def broadcast_round(
             head=head,
             contig=contig,
             seen=seen,
+            oo=oo_new,
+            oo_any=oo_any_new,
             q_writer=q_writer,
             q_ver=q_ver,
             q_tx=q_tx,
@@ -811,6 +1049,34 @@ def _sync_rows(
         jnp.int32(cfg.sync_budget) - (cum - per_w), 0, per_w
     ).astype(jnp.uint32)
     contig_r = contig0 + grant
+
+    # Healing a gap promotes the watermark through any out-of-order
+    # versions possessed above it (the RangeSet coalesce the reference does
+    # on insert, agent.rs:1009-1047). Gated on oo_any: window-free rounds
+    # skip the gathers, shifts, and the cluster-wide flag recompute.
+    if cfg.window_k:
+
+        def _absorb(args):
+            c_r, oo_full = args
+            oo_r = oo_full[:, rows]
+            c2, oo2 = window_absorb(
+                contig0, oo_r, grant.astype(jnp.int32),
+                jnp.zeros_like(oo_r),
+            )
+            oo_out = oo_full.at[:, jnp.where(row_ok, rows, cfg.n_nodes)].set(
+                oo2, mode="drop"
+            )
+            c2 = jnp.where(row_ok[:, None], c2, c_r)
+            return c2, oo_out, jnp.any(oo_out)
+
+        contig_r, oo_new, oo_any_new = jax.lax.cond(
+            data.oo_any,
+            _absorb,
+            lambda args: (args[0], args[1], data.oo_any),
+            (contig_r, data.oo),
+        )
+    else:
+        oo_new, oo_any_new = data.oo, data.oo_any
     seen_r = jnp.maximum(seen_r, contig_r)
 
     cells = data.cells
@@ -822,7 +1088,10 @@ def _sync_rows(
         # (peer.rs:610-666) — and scatter-merge their derived cells.
         # Wrapped in lax.cond: a session round that granted nothing (the
         # converged steady state) skips the worst-case-sized enumeration.
-        gr = (contig_r - contig0).astype(jnp.int32)  # [R, W]
+        # Enumerates the GRANTED ranges only — versions promoted out of the
+        # window were merged when they first arrived, and grant <= budget
+        # keeps the [R, B] enumeration exact.
+        gr = grant.astype(jnp.int32)  # [R, W]
 
         def enumerate_and_merge(cells):
             cum = jnp.cumsum(gr, axis=1)  # [R, W]
@@ -883,7 +1152,13 @@ def _sync_rows(
         "sessions": jnp.sum(jnp.any(ok_c, axis=1)),
         "cell_merges": n_merges,
     }
-    return data._replace(contig=contig, seen=seen, cells=cells), stats
+    return (
+        data._replace(
+            contig=contig, seen=seen, cells=cells, oo=oo_new,
+            oo_any=oo_any_new,
+        ),
+        stats,
+    )
 
 
 def node_cells(data: DataState, cfg: GossipConfig) -> crdt.CellState:
@@ -937,25 +1212,68 @@ def serial_merge_reference(
 
 
 def total_need(data: DataState) -> jax.Array:
-    """Cluster-wide outstanding need (Σ seen - contig) — the `corro.sync.*`
-    needs gauge analogue."""
-    return jnp.sum((data.seen - data.contig).astype(jnp.uint32), dtype=jnp.uint32)
+    """Cluster-wide outstanding need (Σ heard-of minus possessed) — the
+    `corro.sync.*` needs gauge analogue. Window-possessed versions are not
+    needed (their content is applied; only the watermark lags)."""
+    need = jnp.sum(
+        (data.seen - data.contig).astype(jnp.uint32), dtype=jnp.uint32
+    )
+    if data.oo.shape[0] == 0:
+        return need
+
+    def _minus_window(oo):
+        pop = jnp.uint32(0)
+        for b in range(oo.shape[0]):
+            pop = pop + jnp.sum(
+                jax.lax.population_count(oo[b]), dtype=jnp.uint32
+            )
+        return need - pop
+
+    return jax.lax.cond(data.oo_any, _minus_window, lambda oo: need, data.oo)
 
 
 def visibility(data: DataState, sample_writer: jax.Array, sample_ver: jax.Array) -> jax.Array:
-    """bool[S, N]: is sampled write s visible at each node yet?
+    """bool[S, N]: is sampled write s visible at each node yet? Visible =
+    at or below the contiguous watermark, OR possessed out-of-order in the
+    window (the reference applies complete versions in any order —
+    agent.rs:1809-2060 — so an applied version is queryable immediately).
 
     The column gather contig[:, sample_writer] is strided and lowers
     poorly at [100k, 512]→[100k, S]; a one-hot f32 matmul rides the MXU
     instead (exact: one nonzero per output column, values < 2^24 in f32
-    with HIGHEST precision)."""
+    with HIGHEST precision). Window words split into u16 halves for the
+    same exactness."""
     w = data.contig.shape[1]
     onehot = (
         jnp.arange(w, dtype=sample_writer.dtype)[:, None]
         == sample_writer[None, :]
     ).astype(jnp.float32)
-    c = jax.lax.dot(
-        data.contig.astype(jnp.float32), onehot,
-        precision=jax.lax.Precision.HIGHEST,
-    )  # [N, S]
-    return (c >= sample_ver[None, :].astype(jnp.float32)).T
+
+    def _dot(x):
+        return jax.lax.dot(
+            x.astype(jnp.float32), onehot,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # [N, S]
+
+    c = _dot(data.contig)
+    sv = sample_ver[None, :].astype(jnp.float32)
+    vis = c >= sv  # [N, S]
+    if data.oo.shape[0] == 0:
+        return vis.T
+
+    def _with_window(oo):
+        out = vis
+        c_int = c.astype(jnp.uint32)
+        bit = sample_ver[None, :] - c_int - 1  # u32, wraps when visible
+        for b in range(oo.shape[0]):
+            lo = _dot(oo[b] & jnp.uint32(0xFFFF)).astype(jnp.uint32)
+            hi = _dot(oo[b] >> 16).astype(jnp.uint32)
+            word = (hi << 16) | lo  # [N, S]
+            sh = jnp.minimum(bit - jnp.uint32(32 * b), jnp.uint32(31))
+            inb = (bit >= 32 * b) & (bit < 32 * (b + 1))
+            out = out | (inb & (((word >> sh) & 1) == 1))
+        return out
+
+    return jax.lax.cond(
+        data.oo_any, _with_window, lambda oo: vis, data.oo
+    ).T

@@ -137,6 +137,64 @@ def rowmax(
     return out[:r]
 
 
+# -- rowsum -------------------------------------------------------------------
+
+
+def _rowsum_kernel(idx_ref, val_ref, out_ref):
+    bn, m = idx_ref.shape
+    w = out_ref.shape[1]
+    ids = jax.lax.broadcasted_iota(jnp.int32, (_SUB_ROWS, m, w), 2)
+
+    def body(t, _):
+        r0 = t * _SUB_ROWS
+        hit = idx_ref[pl.ds(r0, _SUB_ROWS), :][:, :, None] == ids
+        # Bitcast, not astype: values like 1<<31 must survive the trip, and
+        # i32 addition is mod-2^32 identical to u32.
+        vi = jax.lax.bitcast_convert_type(
+            val_ref[pl.ds(r0, _SUB_ROWS), :], jnp.int32
+        )[:, :, None]
+        out_ref[pl.ds(r0, _SUB_ROWS), :] = jax.lax.bitcast_convert_type(
+            jnp.sum(jnp.where(hit, vi, 0), axis=1), jnp.uint32
+        )
+        return 0
+
+    jax.lax.fori_loop(0, bn // _SUB_ROWS, body, 0)
+
+
+def rowsum(
+    idx: jax.Array,  # i32[R, M] column index per entry
+    val: jax.Array,  # u32[R, M]
+    mask: jax.Array | None,  # bool[R, M] live entries (None = all)
+    width: int,
+) -> jax.Array:
+    """out[r, x] = sum (mod 2^32) over masked m with idx[r, m] == x of
+    val[r, m]. With each (r, x, bit) contributed at most once, this is a
+    row-local scatter-OR — how the gossip window assembles its possession
+    bitmasks without a serialized TPU scatter."""
+    r, m = idx.shape
+    val = val.astype(jnp.uint32)
+    if mask is not None:
+        idx = jnp.where(mask, idx, -1)
+        val = jnp.where(mask, val, 0)
+    if not _use_pallas(r * m * width):
+        ids = jnp.arange(width, dtype=idx.dtype)
+        hit = idx[:, None, :] == ids[None, :, None]
+        return jnp.sum(jnp.where(hit, val[:, None, :], 0), axis=2)
+    bn = _block_rows(m, width)
+    rows_p = -(-r // bn) * bn
+    out = pl.pallas_call(
+        _rowsum_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_p, width), jnp.uint32),
+        grid=(rows_p // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, width), lambda i: (i, 0)),
+    )(_pad_rows(idx.astype(jnp.int32), rows_p), _pad_rows(val, rows_p))
+    return out[:r]
+
+
 # -- rowgather ----------------------------------------------------------------
 
 

@@ -102,6 +102,9 @@ def shard_cluster_state(
         head=_put(d.head, mesh, rep),
         contig=_put(d.contig, mesh, row),
         seen=_put(d.seen, mesh, row),
+        # Window words are [B, N, W]: node axis is dim 1.
+        oo=_put(d.oo, mesh, P(None, axis, None)),
+        oo_any=_put(d.oo_any, mesh, rep),
         q_writer=_put(d.q_writer, mesh, row),
         q_ver=_put(d.q_ver, mesh, row),
         q_tx=_put(d.q_tx, mesh, row),

@@ -1,0 +1,371 @@
+"""Out-of-order possession window: unit, differential, and healing tests.
+
+The window (ops/gossip.py `window_absorb` + the delivery integrations) is
+the bounded-tensor form of the reference's apply-in-any-order bookkeeping
+(corro-agent/src/agent.rs:1809-2060; gap ranges in corro-types/src/
+agent.rs:1041-1046). The differential test here replays identical delivery
+traces through the REAL kernel delivery path (driven via queue surgery on
+a 3-node cluster) and through the host bookie (`BookedVersions`, itself
+vector-tested against the reference's own sync.rs cases), asserting the
+possession sets agree version by version.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.core.bookkeeping import BookedVersions, Current
+from corrosion_tpu.ops import gossip
+
+
+# -- window_absorb vs a big-int reference model -------------------------------
+
+
+def _absorb_ref(contig: int, bits: int, adv: int, new_bits: int, nbits: int):
+    """Python big-int model: shift by adv, OR, promote trailing ones."""
+    mask = (1 << nbits) - 1
+    bits = ((bits >> adv) | new_bits) & mask
+    t = 0
+    while bits & (1 << t):
+        t += 1
+    return contig + adv + t, (bits >> t) & mask
+
+
+@pytest.mark.parametrize("words", [1, 2])
+def test_window_absorb_matches_bigint_model(words):
+    rng = np.random.default_rng(7)
+    nbits = 32 * words
+    n = 64
+    contig = rng.integers(0, 1000, n).astype(np.uint32)
+    adv = rng.integers(0, nbits + 1, n).astype(np.int32)
+    raw = [int(rng.integers(0, 1 << 32)) for _ in range(n * words * 2)]
+    bits = [
+        sum(raw[i * words + b] << (32 * b) for b in range(words))
+        for i in range(n)
+    ]
+    newb = [
+        sum(raw[(n + i) * words + b] << (32 * b) for b in range(words))
+        for i in range(n)
+    ]
+    oo = np.zeros((words, n), np.uint32)
+    nb = np.zeros((words, n), np.uint32)
+    for i in range(n):
+        for b in range(words):
+            oo[b, i] = (bits[i] >> (32 * b)) & 0xFFFFFFFF
+            nb[b, i] = (newb[i] >> (32 * b)) & 0xFFFFFFFF
+    c2, oo2 = jax.jit(gossip.window_absorb)(
+        jnp.asarray(contig), jnp.asarray(oo), jnp.asarray(adv),
+        jnp.asarray(nb),
+    )
+    c2 = np.asarray(c2)
+    oo2 = np.asarray(oo2)
+    for i in range(n):
+        want_c, want_bits = _absorb_ref(
+            int(contig[i]), bits[i], int(adv[i]), newb[i], nbits
+        )
+        got_bits = sum(int(oo2[b, i]) << (32 * b) for b in range(words))
+        assert int(c2[i]) == want_c, f"row {i}"
+        assert got_bits == want_bits, f"row {i}"
+
+
+# -- differential trace replay: kernel delivery vs host bookie ----------------
+
+# Writer 0 carries the trace; writer 1 is the per-round beacon that makes
+# "did node 1 pull node 0 this round?" observable from `seen`.
+_QUEUE = 8
+
+
+def _mk_harness(window_k=32, **kw):
+    cfg = gossip.GossipConfig(
+        n_nodes=3,
+        n_writers=2,
+        queue=_QUEUE,
+        fanout_near=0,
+        fanout_far=4,
+        max_transmissions=6,
+        sync_interval=2,
+        sync_budget=16,
+        sync_chunk=16,
+        window_k=window_k,
+        **kw,
+    )
+    topo = gossip.make_topology([3], [0, 2])
+    data = gossip.init_data(cfg)
+    return cfg, topo, data
+
+
+def _seed_queue(data, batch, head, rnd):
+    """Surgery: node 0's queue holds ``batch`` of writer-0 versions plus the
+    round beacon (writer 1, version rnd+1); node 0 possesses everything."""
+    qw = np.full((3, _QUEUE), -1, np.int32)
+    qv = np.zeros((3, _QUEUE), np.uint32)
+    qt = np.zeros((3, _QUEUE), np.int32)
+    for j, v in enumerate(batch):
+        qw[0, j] = 0
+        qv[0, j] = v
+        qt[0, j] = 5
+    qw[0, len(batch)] = 1
+    qv[0, len(batch)] = rnd + 1
+    qt[0, len(batch)] = 5
+    contig = np.asarray(data.contig).copy()
+    seen = np.asarray(data.seen).copy()
+    contig[0, 0] = seen[0, 0] = head
+    contig[0, 1] = seen[0, 1] = rnd + 1
+    return data._replace(
+        head=jnp.asarray(np.array([head, rnd + 1], np.uint32)),
+        contig=jnp.asarray(contig),
+        seen=jnp.asarray(seen),
+        q_writer=jnp.asarray(qw),
+        q_ver=jnp.asarray(qv),
+        q_tx=jnp.asarray(qt),
+    )
+
+
+def _possessed(data, node, ver, wk):
+    """Kernel possession of (writer 0, ver) at ``node``: at/below the
+    watermark or bit-set in the window."""
+    contig = int(np.asarray(data.contig)[node, 0])
+    if ver <= contig:
+        return True
+    d = ver - contig - 1
+    if wk and d < wk:
+        word = int(np.asarray(data.oo)[d // 32, node, 0])
+        return bool((word >> (d % 32)) & 1)
+    return False
+
+
+def _local_shuffle(h, disp, rng):
+    """Versions 1..h in an order where element i lands within ``disp`` of
+    its sorted position — bounds every transient gap below 2*disp."""
+    keys = np.arange(1, h + 1) + rng.uniform(0, disp, h)
+    return np.array(sorted(range(1, h + 1), key=lambda v: keys[v - 1]))
+
+
+def _run_trace(order, batch_cap, window_k, seed=0, legacy=False):
+    """Replay a delivery order through the real broadcast path and the
+    bookie in lockstep; compare possession after every delivered round."""
+    cfg, topo, data = _mk_harness(window_k=window_k)
+    h = len(order)
+    alive = jnp.ones(3, bool)
+    part = jnp.zeros((1, 1), bool)
+    zero_w = jnp.zeros(2, jnp.uint32)
+    key = jax.random.PRNGKey(seed)
+    book = BookedVersions()
+    sent = 0
+    rnd = 0
+    if legacy:
+        old = gossip._FAST_MAX_WRITERS
+        gossip._FAST_MAX_WRITERS = 0
+        _clear_jit_caches()
+    try:
+        while sent < h and rnd < 400:
+            batch = order[sent : sent + batch_cap]
+            data = _seed_queue(data, batch, h, rnd)
+            key, k1 = jax.random.split(key)
+            data, _ = gossip.broadcast_round(
+                data, topo, alive, part, zero_w, k1, cfg
+            )
+            delivered = int(np.asarray(data.seen)[1, 1]) == rnd + 1
+            if delivered:
+                for v in batch:
+                    book.insert_many(
+                        int(v), int(v), Current(db_version=int(v), last_seq=0, ts=0)
+                    )
+                sent += len(batch)
+            rnd += 1
+    finally:
+        if legacy:
+            gossip._FAST_MAX_WRITERS = old
+            _clear_jit_caches()
+    assert sent == h, "trace did not finish (source never sampled?)"
+    return cfg, topo, data, book
+
+
+def _clear_jit_caches():
+    for fn in (gossip.broadcast_round, gossip.sync_round):
+        try:
+            fn.clear_cache()
+        except AttributeError:
+            pass
+
+
+@pytest.mark.parametrize("legacy", [False, True])
+def test_differential_vs_bookie_bounded_gaps(legacy):
+    """Gaps bounded below window_k: kernel possession == bookie possession
+    after every round, including out-of-order visibility mid-heal."""
+    rng = np.random.default_rng(3)
+    order = _local_shuffle(60, disp=8.0, rng=rng)
+    cfg, topo, data, book = _run_trace(
+        order, batch_cap=5, window_k=32, legacy=legacy
+    )
+    for v in range(1, 61):
+        assert _possessed(data, 1, v, 32) == book.contains_version(v), (
+            f"version {v} possession diverges from bookie"
+        )
+    # The whole trace was delivered, so both must hold everything; node 1's
+    # window drains fully (node 2, a bystander that missed one-round queue
+    # snapshots, may legitimately keep bits).
+    assert all(book.contains_version(v) for v in range(1, 61))
+    assert int(np.asarray(data.contig)[1, 0]) == 60
+    assert int(np.asarray(data.oo)[:, 1, 0].sum()) == 0
+
+
+@pytest.mark.parametrize("legacy", [False, True])
+def test_differential_mid_trace_and_need_sets(legacy):
+    """Check possession and need agreement at a mid-trace cut, where the
+    window is typically non-empty."""
+    rng = np.random.default_rng(11)
+    order = _local_shuffle(40, disp=10.0, rng=rng)
+    # Replay only a prefix: the trailing displaced versions leave holes.
+    prefix = order[:25]
+    cfg, topo, data, book = _run_trace(
+        np.asarray(prefix), batch_cap=4, window_k=32, legacy=legacy
+    )
+    kernel_poss = {v for v in range(1, 41) if _possessed(data, 1, v, 32)}
+    bookie_poss = {v for v in range(1, 41) if book.contains_version(v)}
+    assert kernel_poss == bookie_poss
+    # Need sets (heard-of but not possessed) agree too.
+    seen = int(np.asarray(data.seen)[1, 0])
+    last = book.last() or 0
+    assert seen == last
+    kernel_need = {v for v in range(1, seen + 1) if v not in kernel_poss}
+    bookie_need = set()
+    for s, e in book.sync_need():
+        bookie_need.update(range(s, e + 1))
+    assert kernel_need == bookie_need
+
+
+def test_window_overflow_underclaims_then_sync_heals():
+    """Displacement beyond window_k: the kernel may under-claim (safety:
+    kernel possession ⊆ bookie possession) and anti-entropy heals the
+    difference, promoting the watermark through window-held versions."""
+    h = 50
+    # Adversarial order: the tail first, then the head — gaps of ~40 > 32.
+    order = np.concatenate([np.arange(41, h + 1), np.arange(1, 41)])
+    cfg, topo, data, book = _run_trace(order, batch_cap=5, window_k=32)
+    kernel_poss = {v for v in range(1, h + 1) if _possessed(data, 1, v, 32)}
+    bookie_poss = {v for v in range(1, h + 1) if book.contains_version(v)}
+    assert kernel_poss <= bookie_poss
+    assert bookie_poss == set(range(1, h + 1))
+    # Sync against node 0 (which holds everything) heals the rest.
+    alive = jnp.ones(3, bool)
+    part = jnp.zeros((1, 1), bool)
+    key = jax.random.PRNGKey(9)
+    for r in range(40):
+        key, k1 = jax.random.split(key)
+        data, _ = gossip.sync_round(
+            data, topo, alive, part, jnp.int32(r), k1, cfg
+        )
+    assert int(np.asarray(data.contig)[1, 0]) == h
+    assert not bool(np.asarray(data.oo_any))
+
+
+# -- engine-level behavior ----------------------------------------------------
+
+
+def _mini_cluster(window_k, loss=0.35, n=16):
+    cfg = gossip.GossipConfig(
+        n_nodes=n,
+        n_writers=1,
+        queue=8,
+        fanout_near=2,
+        fanout_far=1,
+        max_transmissions=5,
+        loss_prob=loss,
+        sync_interval=6,
+        sync_budget=64,
+        sync_chunk=64,
+        window_k=window_k,
+    )
+    topo = gossip.make_topology([n], [0])
+    return cfg, topo, gossip.init_data(cfg)
+
+
+def test_lossy_run_exercises_window_and_converges():
+    """Under heavy loss, some node must at some point hold a version
+    out-of-order (visible above a gap) — the pessimism the window removes —
+    and the run still converges with an empty window."""
+    cfg, topo, data = _mini_cluster(window_k=32)
+    alive = jnp.ones(16, bool)
+    part = jnp.zeros((1, 1), bool)
+    key = jax.random.PRNGKey(2)
+    w = jnp.zeros(1, jnp.uint32)
+    saw_window = False
+    for r in range(60):
+        key, k1, k2 = jax.random.split(key, 3)
+        writes = w.at[0].set(2 if r < 15 else 0)
+        data, _ = gossip.broadcast_round(
+            data, topo, alive, part, writes, k1, cfg
+        )
+        if bool(np.asarray(data.oo_any)):
+            saw_window = True
+            # Out-of-order possession is *visible*: some (node, version)
+            # with contig < version must report visible=True.
+            oo = np.asarray(data.oo)
+            contig = np.asarray(data.contig)
+            rows = np.nonzero(oo.any(axis=0).any(axis=1))[0]
+            node = int(rows[0])
+            d = int(np.nonzero(
+                [(int(oo[b, node, 0]) >> (i % 32)) & 1
+                 for i in range(32) for b in [i // 32]]
+            )[0][0])
+            ver = int(contig[node, 0]) + 1 + d
+            vis = gossip.visibility(
+                data, jnp.array([0]), jnp.array([ver], jnp.uint32)
+            )
+            assert bool(np.asarray(vis)[0, node]), (
+                "window-possessed version must be visible"
+            )
+        data, _ = gossip.sync_round(
+            data, topo, alive, part, jnp.int32(r), k2, cfg
+        )
+    assert saw_window, "loss config never exercised the window"
+    assert bool((np.asarray(data.contig)[:, 0] == 30).all())
+    assert not bool(np.asarray(data.oo_any))
+    assert int(gossip.total_need(data)) == 0
+
+
+def test_window_off_matches_old_inorder_semantics():
+    """window_k=0 keeps the strict in-order model: no oo state, converges
+    the old way."""
+    cfg, topo, data = _mini_cluster(window_k=0)
+    assert data.oo.shape == (0, 16, 1)
+    alive = jnp.ones(16, bool)
+    part = jnp.zeros((1, 1), bool)
+    key = jax.random.PRNGKey(2)
+    for r in range(70):
+        key, k1, k2 = jax.random.split(key, 3)
+        writes = jnp.asarray([2 if r < 15 else 0], jnp.uint32)
+        data, _ = gossip.broadcast_round(
+            data, topo, alive, part, writes, k1, cfg
+        )
+        data, _ = gossip.sync_round(
+            data, topo, alive, part, jnp.int32(r), k2, cfg
+        )
+    assert bool((np.asarray(data.contig)[:, 0] == 30).all())
+
+
+def test_total_need_excludes_window_possession():
+    cfg, topo, data = _mk_harness(window_k=32)
+    contig = np.asarray(data.contig).copy()
+    seen = np.asarray(data.seen).copy()
+    oo = np.asarray(data.oo).copy()
+    # Node 1 heard of 10 versions, holds 1..4 contiguous + {6, 8} windowed.
+    contig[1, 0] = 4
+    seen[1, 0] = 10
+    oo[0, 1, 0] = 0b1010  # bits 1,3 -> versions 6 and 8
+    data = data._replace(
+        contig=jnp.asarray(contig),
+        seen=jnp.asarray(seen),
+        oo=jnp.asarray(oo),
+        oo_any=jnp.array(True),
+    )
+    assert int(gossip.total_need(data)) == 6 - 2  # 5..10 minus {6, 8}
+    assert np.asarray(gossip.window_possession(data))[1, 0] == 6
+
+
+def test_config_validates_window():
+    with pytest.raises(ValueError):
+        gossip.GossipConfig(n_nodes=4, n_writers=1, window_k=31)
+    gossip.GossipConfig(n_nodes=4, n_writers=1, window_k=64)
