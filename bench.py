@@ -55,6 +55,7 @@ def main() -> None:
     from corrosion_tpu import models
     from corrosion_tpu.ops import gossip as gossip_ops
     from corrosion_tpu.ops import swim as swim_ops
+    from corrosion_tpu.sim import engine as sim_engine
     from corrosion_tpu.sim import simulate, visibility_latencies
 
     if on_accel:
@@ -114,6 +115,41 @@ def main() -> None:
         ),
         sw,
     )
+    # Fourth stage: per-round visibility tracking + metric reduces (the
+    # cluster_round tail after the three planes) — previously the
+    # unattributed ~35% of step time where regressions could hide.
+    s_writer = jnp.asarray(sched.sample_writer)
+    s_ver = jnp.asarray(sched.sample_ver)
+    s_round = jnp.asarray(sched.sample_round)
+
+    # NOTE: the big arrays ride the CARRY, never the closure — a closed-over
+    # DataState would be embedded as compile-payload constants (hundreds of
+    # MB at 10k; the axon compile tunnel rejects it outright).
+    def track_step(carry, i):
+        d, vis_round = carry
+        vis_now = gossip_ops.visibility(d, s_writer, s_ver)
+        active = i >= s_round
+        vr = jnp.where(
+            (vis_round < 0) & vis_now & active[:, None], i, vis_round
+        )
+        # Keep the need reduce live (it is part of every round's stats).
+        need = gossip_ops.total_need(d)
+        return d, vr + (need * jnp.uint32(0)).astype(vr.dtype)
+
+    track_ms = _time_plane(track_step, (data, final.vis_round))
+
+    # Whole cluster_round as one unit: the honest per-round device time the
+    # four stages must sum to (wall-clock step_ms additionally carries
+    # host-side chunk dispatch).
+    def full_step(st, i):
+        st2, _ = sim_engine.cluster_round(
+            st, topo, writes, part, jnp.zeros((1,), bool),
+            jnp.zeros((1,), bool), s_writer, s_ver, s_round,
+            jax.random.fold_in(key, i), cfg, False,
+        )
+        return st2
+
+    full_ms = _time_plane(full_step, final)
 
     state_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(final.data)
@@ -148,11 +184,19 @@ def main() -> None:
                 "p50_s": round(lat["p50_s"], 2),
                 "throughput_changes_per_s": round(applied / wall, 1),
                 "step_ms": round(step_ms, 1),
+                # One fused cluster_round per device step; the four stages
+                # must sum to it (residual = fusion/measurement slack, kept
+                # visible so regressions can't hide in unattributed time).
+                "step_inner_ms": round(full_ms, 1),
                 "plane_ms": {
                     "swim": round(swim_ms, 1),
                     "broadcast": round(bcast_ms, 1),
                     "sync": round(sync_ms, 1),
+                    "track": round(track_ms, 1),
                 },
+                "residual_ms": round(
+                    full_ms - swim_ms - bcast_ms - sync_ms - track_ms, 1
+                ),
             }
         )
     )
