@@ -13,6 +13,7 @@ process_fully_buffered_changes :1667-1806).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import random
@@ -58,6 +59,12 @@ class AgentConfig:
     schema_sql: str = ""
     probe_interval: float = 0.25
     broadcast_interval: float = 0.05  # flush tick (500 ms in the reference)
+    # Pending-broadcast byte budget (the reference cuts its broadcast
+    # buffer at 64 KiB, broadcast/mod.rs:357): over budget, oldest
+    # retransmission backlog sheds first; never-sent local frames survive
+    # to 8x this before shedding — so a member-less agent under sustained
+    # write load holds bounded memory (see _pending_push).
+    broadcast_buffer_bytes: int = 64 * 1024
     sync_interval: float = 0.5  # backoff floor 1 s in the reference
     fanout: int = 3  # num_indirect_probes analogue
     max_transmissions: int = 4
@@ -118,12 +125,35 @@ class AgentTls:
     insecure: bool = False
 
 
+class _StreakLogger:
+    """Failure logging for periodic loops: WARNING on the first failure of
+    a streak, DEBUG on repeats — a permanently failing loop stays visible
+    without log spam (the reference warns on loop errors)."""
+
+    def __init__(self, msg: str) -> None:
+        self._log = logging.getLogger(__name__)
+        self._msg = msg
+        self._failing = False
+
+    def ok(self) -> None:
+        self._failing = False
+
+    def fail(self) -> None:
+        self._log.log(
+            logging.DEBUG if self._failing else logging.WARNING,
+            self._msg,
+            exc_info=True,
+        )
+        self._failing = True
+
+
 @dataclass
 class PendingBroadcast:
     """An entry in the broadcast pending queue (broadcast/mod.rs:716-738)."""
 
     frame: dict
     tx_left: int
+    size: int = 0  # encoded-size estimate, counted against the byte budget
 
 
 class Agent:
@@ -181,6 +211,15 @@ class Agent:
         self.api_addr: tuple[str, int] | None = None
         self.swim: Swim | None = None
         self._pending: list[PendingBroadcast] = []
+        self._pending_bytes = 0
+        self._m_bcast_pending_bytes = self.metrics.gauge(
+            "corro_broadcast_pending_bytes",
+            "bytes queued in the pending-broadcast buffer",
+        )
+        self._m_bcast_dropped = self.metrics.counter(
+            "corro_broadcast_dropped",
+            "pending broadcasts dropped over the byte budget (sync heals)",
+        )
         # Cleared version ranges awaiting persistence, batched like
         # write_empties_loop (agent.rs:2522-2571).
         self._empties: dict[str, RangeSet] = {}
@@ -506,9 +545,59 @@ class Agent:
         }
 
     def _queue_broadcast(self, frame: dict) -> None:
-        self._pending.append(
-            PendingBroadcast(frame=frame, tx_left=self.cfg.max_transmissions)
+        self._pending_push(
+            PendingBroadcast(
+                frame=frame,
+                tx_left=self.cfg.max_transmissions,
+                # Size estimate for the byte budget; blob values count at
+                # their hex length (the codec encodes them binary — close
+                # enough for a budget, no second encode at send time).
+                size=len(
+                    json.dumps(
+                        frame,
+                        separators=(",", ":"),
+                        default=lambda o: o.hex()
+                        if isinstance(o, (bytes, bytearray, memoryview))
+                        else str(o),
+                    )
+                ),
+            )
         )
+
+    def _pending_push(self, pb: PendingBroadcast) -> None:
+        """Append to the pending buffer under the byte budget.
+
+        Two-tier shed, mirroring what the reference's 64 KiB buffer cutoff
+        (broadcast/mod.rs:357) actually loses: over the soft budget, drop
+        oldest RETRANSMISSION backlog first — frames already sent at least
+        once, whose lost redundancy anti-entropy covers. Never-sent frames
+        are the only broadcast copy of local writes (the reference never
+        drops those), so they survive up to a hard multiple of the budget;
+        only a member-less agent under sustained write load reaches that,
+        and a late-joining peer recovers the difference via sync."""
+        self._pending.append(pb)
+        self._pending_bytes += pb.size
+        soft = self.cfg.broadcast_buffer_bytes
+        if self._pending_bytes > soft:
+            kept = []
+            last = len(self._pending) - 1
+            for i, p in enumerate(self._pending):
+                if (
+                    self._pending_bytes > soft
+                    and i < last
+                    and p.tx_left < self.cfg.max_transmissions
+                ):
+                    self._pending_bytes -= p.size
+                    self._m_bcast_dropped.inc()
+                else:
+                    kept.append(p)
+            self._pending = kept
+        hard = soft * 8
+        while self._pending_bytes > hard and len(self._pending) > 1:
+            dropped = self._pending.pop(0)
+            self._pending_bytes -= dropped.size
+            self._m_bcast_dropped.inc()
+        self._m_bcast_pending_bytes.set(self._pending_bytes)
 
     # -- gossip inbound -------------------------------------------------------
 
@@ -557,12 +646,14 @@ class Agent:
             members_gauge.set(len(self.members.alive()))
             if not self._pending:
                 continue
-            pending, self._pending = self._pending, []
-            members = self.members.alive()
-            if not members:
-                # No peers yet: requeue, budgets intact (sendable gating).
-                self._pending = pending
+            if not self.members.alive():
+                # No peers yet: entries stay queued, budgets intact
+                # (sendable gating); _pending_push's byte budget is what
+                # bounds a member-less agent under sustained writes.
                 continue
+            pending, self._pending = self._pending, []
+            self._pending_bytes = 0
+            members = self.members.alive()
             ring0 = self.members.ring0()
             for pb in pending:
                 # Ring-0 eager + random far targets (mod.rs:465-473,522-537).
@@ -577,7 +668,7 @@ class Agent:
                     )
                 pb.tx_left -= 1
                 if pb.tx_left > 0:
-                    self._pending.append(pb)
+                    self._pending_push(pb)
 
     # -- ingest pipeline (handle_changes + process_multiple_changes) ----------
 
@@ -778,23 +869,14 @@ class Agent:
     async def _compact_loop(self) -> None:
         """Periodically find fully-overwritten versions and clear them
         (clear_overwritten_versions, agent.rs:995-1126)."""
-        log = logging.getLogger(__name__)
-        failing = False
+        streak = _StreakLogger("clear_overwritten_versions failed")
         while not self.tripwire.tripped:
             await asyncio.sleep(self.cfg.compact_interval)
             try:
                 await self._compact_once()
-                failing = False
+                streak.ok()
             except Exception:
-                # Warn on the first failure of a streak (the reference warns
-                # on compaction errors); repeats at debug so a permanently
-                # failing flush is visible without log spam.
-                log.log(
-                    logging.DEBUG if failing else logging.WARNING,
-                    "clear_overwritten_versions failed",
-                    exc_info=True,
-                )
-                failing = True
+                streak.fail()
 
     async def _compact_once(self) -> None:
         for actor, booked in list(self.bookie.items()):
@@ -828,24 +910,15 @@ class Agent:
     async def _empties_loop(self) -> None:
         """Batch queued cleared ranges into collapsed bookkeeping rows
         (write_empties_loop, agent.rs:2522-2571)."""
-        log = logging.getLogger(__name__)
-        failing = False
+        streak = _StreakLogger("write_empties flush failed; batch re-queued")
         while not self.tripwire.tripped:
             await asyncio.sleep(self.cfg.empties_flush_interval)
             if self._empties:
                 try:
                     await self._flush_empties()
-                    failing = False
+                    streak.ok()
                 except Exception:
-                    # First failure of a streak at warning: _flush_empties
-                    # re-merges the batch, so a permanent failure would
-                    # otherwise retry silently forever while _empties grows.
-                    log.log(
-                        logging.DEBUG if failing else logging.WARNING,
-                        "write_empties flush failed; batch re-queued",
-                        exc_info=True,
-                    )
-                    failing = True
+                    streak.fail()
 
     async def _flush_empties(self) -> None:
         empties, self._empties = self._empties, {}
@@ -1082,24 +1155,28 @@ class Agent:
     # -- SWIM loop -------------------------------------------------------------
 
     async def _swim_loop(self) -> None:
+        streak = _StreakLogger("SWIM probe round failed")
         while not self.tripwire.tripped:
             await asyncio.sleep(self.cfg.probe_interval)
             try:
                 await self.swim.probe_round()
+                streak.ok()
             except Exception:
-                pass
+                streak.fail()
 
     # -- sync (client: handle_sync/parallel_sync; server: serve_sync) ---------
 
     async def _sync_loop(self) -> None:
+        streak = _StreakLogger("sync session failed")
         while not self.tripwire.tripped:
             await asyncio.sleep(
                 self.cfg.sync_interval * (0.75 + random.random() * 0.5)
             )
             try:
                 await self._sync_once()
+                streak.ok()
             except Exception:
-                pass
+                streak.fail()
 
     async def _sync_once(self) -> None:
         """Concurrent multi-peer sync (parallel_sync, peer.rs:925-1286):
