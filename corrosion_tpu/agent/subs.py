@@ -169,6 +169,14 @@ class MatcherHandle:
         self.history: deque[QueryEventChange] = deque(maxlen=MAX_CHANGE_HISTORY)
         self._listeners: list[asyncio.Queue] = []
         self._touched: list[tuple] = []
+        # Fallback (full re-evaluation) cost control: once an evaluation
+        # proves expensive, later change batches coalesce into one deferred
+        # re-snapshot per FALLBACK_MIN_INTERVAL instead of re-scanning per
+        # batch (see process()).
+        self._last_full = 0.0
+        self._full_expensive = False
+        self._dirty = False
+        self._flush_handle: asyncio.TimerHandle | None = None
         self._db: sqlite3.Connection | None = None
         restored = False
         if db_dir is not None:
@@ -295,6 +303,9 @@ class MatcherHandle:
         db.execute("COMMIT")
 
     def close(self) -> None:
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
         if self._db is not None:
             try:
                 self._db.close()
@@ -489,6 +500,16 @@ class MatcherHandle:
     # Candidate batches above this fall back to a full re-evaluation (one
     # scan beats thousands of point lookups).
     MAX_CANDIDATES = 512
+    # Fallback cost guards: an evaluation materializing more rows than
+    # MAX_FALLBACK_ROWS or taking longer than FALLBACK_EVAL_BUDGET seconds
+    # marks the sub "expensive"; expensive subs re-snapshot at most once
+    # per FALLBACK_MIN_INTERVAL, coalescing intervening change batches
+    # (the reference's candidate path never full-scans, pubsub.rs:1303-
+    # 1570 — shapes it can't cover incrementally must not be allowed to
+    # stall the ingest loop per batch either).
+    MAX_FALLBACK_ROWS = 10_000
+    FALLBACK_EVAL_BUDGET = 0.05
+    FALLBACK_MIN_INTERVAL = 2.0
 
     def process(
         self, changes: list[Change] | None = None
@@ -499,14 +520,30 @@ class MatcherHandle:
         re-evaluated (the reference's handle_candidates: temp PK tables +
         rewritten per-table queries, pubsub.rs:1303-1570) — O(changed rows),
         not O(result set). Other shapes (joins, aggregates, no batch) fall
-        back to full snapshot diffing.
+        back to full snapshot diffing, rate-limited once proven expensive
+        (per-batch work stays bounded; events still arrive, one interval
+        late at worst).
         """
         self._touched: list[tuple] = []
-        candidates = self._candidate_keys(changes)
+        # An overdue deferred re-snapshot flushes on ANY process() call —
+        # the safety net for contexts with no event loop, where
+        # _schedule_flush could not arm its timer.
+        overdue = self._dirty and (
+            time.monotonic() - self._last_full >= self.FALLBACK_MIN_INTERVAL
+        )
+        candidates = None if overdue else self._candidate_keys(changes)
         if candidates is None:
-            cols, new_rows = self._evaluate()
-            self.columns = cols
-            events = self._diff_full(new_rows)
+            if (
+                not overdue
+                and changes is not None
+                and self._full_expensive
+                and time.monotonic() - self._last_full
+                < self.FALLBACK_MIN_INTERVAL
+            ):
+                self._dirty = True
+                self._schedule_flush()
+                return []
+            events = self._full_pass()
         else:
             events = self._diff_candidates(candidates)
         # The deque stays populated either way: a bounded in-memory cache
@@ -522,6 +559,43 @@ class MatcherHandle:
                 except asyncio.QueueFull:
                     pass
         return events
+
+    def _full_pass(self) -> list[QueryEventChange]:
+        """Full re-evaluation + snapshot diff, tracking its own cost."""
+        t0 = time.monotonic()
+        cols, new_rows = self._evaluate()
+        self.columns = cols
+        events = self._diff_full(new_rows)
+        now = time.monotonic()
+        self._last_full = now
+        self._full_expensive = (
+            len(new_rows) > self.MAX_FALLBACK_ROWS
+            or (now - t0) > self.FALLBACK_EVAL_BUDGET
+        )
+        self._dirty = False
+        return events
+
+    def _schedule_flush(self) -> None:
+        """Arm a one-shot timer so a deferred re-snapshot happens even if
+        no further change batch arrives (outside an event loop — unit-test
+        contexts — the next process() call flushes instead)."""
+        if self._flush_handle is not None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        delay = max(
+            0.0,
+            self.FALLBACK_MIN_INTERVAL
+            - (time.monotonic() - self._last_full),
+        )
+        self._flush_handle = loop.call_later(delay, self._flush_deferred)
+
+    def _flush_deferred(self) -> None:
+        self._flush_handle = None
+        if self._dirty:
+            self.process(None)
 
     def _candidate_keys(self, changes):
         """Distinct changed identity keys, or None when incremental
