@@ -8,12 +8,14 @@ SELECTs run on the store's read connection; writes run through
 Agent.execute so version allocation, bookkeeping, and dissemination are
 identical to the HTTP path (the parity that matters, lib.rs write path).
 
-Everything is typed as text on the wire (like psql's default rendering).
 Both protocol flows are served: the simple-query flow ('Q') and the
 extended flow (Parse/Bind/Describe/Execute/Close/Sync/Flush — what libpq's
 PQexecParams and most drivers send), with PG's ``$N`` placeholders
-translated to SQLite ``?N``. Text parameter/result format only; a client
-requesting binary gets a clean protocol error.
+translated to SQLite ``?N``. Parameters and results support both wire
+formats: text (psql's default rendering) and binary (format code 1) for
+the core scalar types (int2/4/8, float4/8, bool, bytea, text). SQL
+translation is token-level (agent/pgsql.py's lexer), mirroring corro-pg's
+parse-before-rewrite approach.
 """
 
 from __future__ import annotations
@@ -25,6 +27,7 @@ import sqlite3
 import struct
 from typing import TYPE_CHECKING
 
+from corrosion_tpu.agent import pgsql
 from corrosion_tpu.core.values import Statement
 
 if TYPE_CHECKING:
@@ -180,229 +183,18 @@ def _is_query(sql: str) -> bool:
 
 def translate_pg_sql(sql: str) -> str:
     """PG->SQLite surface translation (corro-pg's parse_query,
-    lib.rs:306-472 via sqlparser; here: the dialect constructs drivers and
-    hand-written PG SQL actually emit — session shims, ``::`` casts,
-    boolean literals, ILIKE, E'...' escape strings)."""
-    s = sql.strip().rstrip(";")
-    upper = s.upper()
-    if upper in ("BEGIN", "COMMIT", "ROLLBACK", "START TRANSACTION"):
-        return ""  # the agent wraps writes in its own transaction
-    if upper.startswith("SET ") or upper.startswith("SHOW "):
-        return ""
-    # Session-introspection shims clients issue at connect time — applied
-    # only OUTSIDE string/identifier literals (an INSERT of the literal
-    # 'current_user' must pass through untouched).
-    s = _sub_unquoted(s, _SESSION_SHIMS)
-    s = _sub_unquoted(s, _DIALECT_SUBS)
-    s = _translate_casts(s)
-    s = _translate_estrings(s)
-    return s
-
-
-# PG type name → SQLite CAST target (affinity groups).
-_PG_TYPE_MAP = {
-    "int2": "INTEGER", "int4": "INTEGER", "int8": "INTEGER",
-    "smallint": "INTEGER", "integer": "INTEGER", "int": "INTEGER",
-    "bigint": "INTEGER", "serial": "INTEGER", "bigserial": "INTEGER",
-    "oid": "INTEGER", "bool": "INTEGER", "boolean": "INTEGER",
-    "float4": "REAL", "float8": "REAL", "real": "REAL",
-    "numeric": "REAL", "decimal": "REAL",
-    "text": "TEXT", "varchar": "TEXT", "char": "TEXT", "bpchar": "TEXT",
-    "name": "TEXT", "uuid": "TEXT", "json": "TEXT", "jsonb": "TEXT",
-    "regclass": "TEXT", "regtype": "TEXT",
-    "bytea": "BLOB",
-}
-
-_DIALECT_SUBS = [
-    # Boolean literals → SQLite integers (corro-pg translates via sqlparser).
-    (re.compile(r"(?i)\btrue\b"), "1"),
-    (re.compile(r"(?i)\bfalse\b"), "0"),
-    # SQLite LIKE is already case-insensitive for ASCII.
-    (re.compile(r"(?i)\bilike\b"), "LIKE"),
-]
-
-# `token::type` where token is a quote-terminated literal, number,
-# placeholder, identifier, or closing paren. Paren-closed expressions keep
-# their value and drop the cast (SQLite's dynamic typing absorbs it);
-# simple tokens become CAST(token AS affinity).
-_CAST_RE = re.compile(
-    r"(\)|\?\d*|[A-Za-z_][\w.]*|\d+(?:\.\d+)?)\s*::\s*"
-    r"([A-Za-z_][\w]*)(?:\s*\(\s*\d+\s*\))?"
-)
-
-
-def _translate_casts(sql: str) -> str:
-    def repl(m: re.Match) -> str:
-        token, typ = m.group(1), m.group(2).lower()
-        target = _PG_TYPE_MAP.get(typ)
-        if token == ")" or target is None:
-            return token  # drop the cast, keep the value
-        return f"CAST({token} AS {target})"
-
-    # Merge adjacent quoted segments first: a doubled-quote literal
-    # ('it''s') scans as two adjacent quoted runs, and a cast applied to
-    # it must wrap the WHOLE literal, not the final fragment.
-    parts: list[tuple[bool, str]] = []
-    for quoted, seg in _split_quoted(sql):
-        if quoted and parts and parts[-1][0]:
-            parts[-1] = (True, parts[-1][1] + seg)
-        else:
-            parts.append((quoted, seg))
-    out = []
-    for quoted, seg in parts:
-        if quoted:
-            # A cast can follow a string literal: 'x'::text — handled by
-            # peeking in the NEXT unquoted segment (the '::type' prefix).
-            out.append(seg)
-        else:
-            # Cast applied to the preceding quoted literal.
-            m = re.match(r"\s*::\s*([A-Za-z_][\w]*)(?:\s*\(\s*\d+\s*\))?", seg)
-            if m and out and out[-1].startswith(("'", '"')):
-                typ = m.group(1).lower()
-                target = _PG_TYPE_MAP.get(typ)
-                lit = out.pop()
-                if target is None:
-                    out.append(lit)
-                else:
-                    out.append(f"CAST({lit} AS {target})")
-                seg = seg[m.end():]
-            out.append(_CAST_RE.sub(repl, seg))
-    return "".join(out)
-
-
-_ESCAPES = {
-    "n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f",
-    "\\": "\\", "'": "'", '"': '"',
-}
-
-
-def _translate_estrings(sql: str) -> str:
-    """PG E'...' escape strings → standard SQL literals (SQLite has no
-    backslash escapes; a passed-through E-string would keep literal
-    backslashes)."""
-    parts = _split_quoted(sql)
-    out: list[str] = []
-    for i, (quoted, seg) in enumerate(parts):
-        if (
-            quoted
-            and seg.startswith("'")
-            and out
-            and out[-1]
-            and out[-1][-1] in "eE"
-            and (len(out[-1]) < 2 or not (
-                out[-1][-2].isalnum() or out[-1][-2] == "_"
-            ))
-        ):
-            body = seg[1:-1] if seg.endswith("'") and len(seg) > 1 else seg[1:]
-            decoded = []
-            j = 0
-            while j < len(body):
-                if body[j] == "\\" and j + 1 < len(body):
-                    decoded.append(_ESCAPES.get(body[j + 1], body[j + 1]))
-                    j += 2
-                else:
-                    decoded.append(body[j])
-                    j += 1
-            out[-1] = out[-1][:-1]  # drop the E prefix
-            out.append("'" + "".join(decoded).replace("'", "''") + "'")
-        else:
-            out.append(seg)
-    return "".join(out)
-
-
-_SESSION_SHIMS = [
-    (re.compile(r"(?i)\bversion\s*\(\s*\)"),
-     "'corrosion-tpu (PostgreSQL 14 compatible)'"),
-    (re.compile(r"(?i)\bcurrent_database\s*\(\s*\)"), "'corrosion'"),
-    (re.compile(r"(?i)\bcurrent_schema\s*\(\s*\)"), "'public'"),
-    (re.compile(r"(?i)\bpg_backend_pid\s*\(\s*\)"), "1"),
-    (re.compile(r"(?i)\b(current_user|session_user)\b"), "'corrosion'"),
-]
-
-
-# A dollar-quote opener: $$ or $tag$ (tags are identifiers, so a $N
-# parameter placeholder never matches).
-_DOLLAR_TAG = re.compile(r"\$(?:[A-Za-z_][A-Za-z_0-9]*)?\$")
-
-
-def _split_quoted(sql: str) -> list[tuple[bool, str]]:
-    """Split SQL into (is_quoted, segment) runs; quoted segments include
-    their delimiters. A doubled quote ('it''s') splits into two adjacent
-    quoted segments — the literal's content never lands in an unquoted
-    run, which is the property the callers rely on. Recognizes PG
-    dollar-quoted blocks ($$...$$ / $tag$...$tag$) and backslash escapes
-    inside E'...' literals, so shim/placeholder rewriting never corrupts
-    their contents."""
-    out: list[tuple[bool, str]] = []
-    buf: list[str] = []
-    i, n = 0, len(sql)
-
-    def flush() -> None:
-        if buf:
-            out.append((False, "".join(buf)))
-            buf.clear()
-
-    while i < n:
-        ch = sql[i]
-        if ch in ("'", '"'):
-            # E'...' (the E stays in the unquoted run) honors backslash
-            # escapes; plain literals treat backslash as data.
-            esc = (
-                ch == "'"
-                and buf
-                and buf[-1] in "eE"
-                and (len(buf) < 2 or not (buf[-2].isalnum() or buf[-2] == "_"))
-            )
-            flush()
-            j = i + 1
-            while j < n and sql[j] != ch:
-                j += 2 if esc and sql[j] == "\\" else 1
-            end = min(j + 1, n)
-            out.append((True, sql[i:end]))
-            i = end
-            continue
-        if ch == "$":
-            m = _DOLLAR_TAG.match(sql, i)
-            if m:
-                tag = m.group(0)
-                close = sql.find(tag, m.end())
-                end = n if close < 0 else close + len(tag)
-                flush()
-                out.append((True, sql[i:end]))
-                i = end
-                continue
-        buf.append(ch)
-        i += 1
-    flush()
-    return out
-
-
-def _sub_unquoted(sql: str, subs) -> str:
-    parts = []
-    for quoted, seg in _split_quoted(sql):
-        if not quoted:
-            for pat, repl in subs:
-                seg = pat.sub(repl, seg)
-        parts.append(seg)
-    return "".join(parts)
+    lib.rs:306-472 via sqlparser). Token-level — see agent/pgsql.py for
+    the lexer: strings, comments, dollar-quotes, and identifiers are
+    single tokens, so nothing inside them can be rewritten."""
+    return pgsql.translate(sql)
 
 
 def _mentions_catalog(sql: str) -> bool:
-    return any(
-        _CATALOG_RE.search(seg)
-        for quoted, seg in _split_quoted(sql)
-        if not quoted
-    )
+    return pgsql.mentions_catalog(sql)
 
 
 # -- pg_catalog (the reference's vtabs: corro-pg/src/vtab/{pg_type 405,
 # pg_class 113, pg_namespace 108, pg_database 166, pg_range} LoC) ----------
-
-_CATALOG_RE = re.compile(
-    r"(?i)\b(?:pg_catalog\.)?"
-    r"(pg_type|pg_class|pg_namespace|pg_database|pg_range|pg_attribute"
-    r"|pg_tables)\b"
-)
 
 # (oid, typname, typlen): the types the wire layer speaks.
 _PG_TYPES = [
@@ -497,7 +289,7 @@ async def _run_query(
             c = catalog_conn(agent)
             try:
                 cur = c.execute(
-                    _sub_unquoted(sql, _CATALOG_PREFIX_STRIP),
+                    pgsql.strip_catalog_prefix(sql),
                     tuple(params or ()),
                 )
                 cols = (
@@ -511,17 +303,10 @@ async def _run_query(
     return await agent.pool.query(Statement(sql, params=params))
 
 
-_CATALOG_PREFIX_STRIP = [(re.compile(r"(?i)\bpg_catalog\."), "")]
-
-
-_PLACEHOLDER_SUB = [(re.compile(r"\$(\d+)"), r"?\1")]
-
-
 def translate_placeholders(sql: str) -> str:
-    """PG ``$N`` → SQLite ``?N``, outside string/identifier literals
-    (one quote scanner — ``_split_quoted`` — serves shims, catalog
-    routing, and placeholder translation alike)."""
-    return _sub_unquoted(sql, _PLACEHOLDER_SUB)
+    """PG ``$N`` → SQLite ``?N``, outside string/identifier literals and
+    comments (token-level, agent/pgsql.py)."""
+    return pgsql.translate_placeholders(sql)
 
 
 class _Prepared:
@@ -809,7 +594,7 @@ def _try_describe(agent: "Agent", stmt: _Prepared) -> list[str] | None:
             try:
                 cur = c.execute(
                     "SELECT * FROM ("
-                    + _sub_unquoted(stmt.translated, _CATALOG_PREFIX_STRIP)
+                    + pgsql.strip_catalog_prefix(stmt.translated)
                     + ") LIMIT 0",
                     tuple([None] * n_params),
                 )
@@ -854,26 +639,10 @@ async def _handshake(reader, writer) -> None:
 
 
 def _split_statements(sql: str) -> list[str]:
-    """Split on top-level semicolons only — ';' inside '…'/"…" string or
-    identifier literals (with doubled-quote escapes) must not split."""
-    parts: list[str] = []
-    cur: list[str] = []
-    quote: str | None = None
-    for ch in sql:
-        if quote is not None:
-            cur.append(ch)
-            if ch == quote:
-                quote = None  # doubled quotes re-enter on the next char
-        elif ch in ("'", '"'):
-            quote = ch
-            cur.append(ch)
-        elif ch == ";":
-            parts.append("".join(cur))
-            cur = []
-        else:
-            cur.append(ch)
-    parts.append("".join(cur))
-    return [p for p in (s.strip() for s in parts) if p]
+    """Split on top-level semicolons only — token-aware (';' inside
+    strings, quoted identifiers, comments, and dollar-quoted blocks never
+    splits)."""
+    return pgsql.split_statements(sql)
 
 
 async def _simple_query(agent: "Agent", writer, sql: str) -> None:

@@ -359,10 +359,11 @@ def test_pg_dialect_translation():
         translate_pg_sql("SELECT '5'::int4, 1.5::float8")
         == "SELECT CAST('5' AS INTEGER), CAST(1.5 AS REAL)"
     )
-    # Parenthesized expressions drop the cast (dynamic typing absorbs it).
+    # Parenthesized expressions keep the cast (the token-level pass wraps
+    # the whole parenthesized run; the old regex pass had to drop these).
     assert (
         translate_pg_sql("SELECT (id + 1)::bigint FROM t")
-        == "SELECT (id + 1) FROM t"
+        == "SELECT CAST((id + 1) AS INTEGER) FROM t"
     )
     # varchar(32)-style length qualifiers are consumed with the cast.
     assert (
