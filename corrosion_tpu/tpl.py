@@ -24,6 +24,7 @@ import asyncio
 import csv
 import io
 import json
+import logging
 import os
 import re
 import socket
@@ -95,35 +96,6 @@ def compile_template(text: str):
     return ns["__render__"]
 
 
-class _Null:
-    """Absorbing placeholder for the query-recording pass."""
-
-    def __getattr__(self, _):
-        return self
-
-    def __getitem__(self, _):
-        return self
-
-    def __call__(self, *a, **k):
-        return self
-
-    def __iter__(self):
-        return iter(())
-
-    def __len__(self):
-        return 0
-
-    def __str__(self):
-        return ""
-
-
-class _NullResponse(QueryResponse):
-    def __init__(self):
-        super().__init__([], [])
-        self.rows = _Null()
-        self.columns = _Null()
-
-
 class TemplateState:
     """One template file: render + the queries it used (TemplateState,
     corro-tpl lib.rs:361)."""
@@ -135,45 +107,34 @@ class TemplateState:
         self.queries: list[str] = []
 
     async def render_once(self) -> str:
+        """Single-pass direct execution, like Rhai's inline sql()
+        (corro-tpl/src/lib.rs:447-613): the template body runs ONCE on a
+        worker thread, and every sql() call bridges synchronously back to
+        the event loop for a live fetch — so a data-dependent nested query
+        (sql() inside a loop over another query's rows) sees real rows.
+        The queries actually used this render are recorded for watch mode,
+        including ones discovered mid-render."""
         with open(self.template_path) as f:
             text = f.read()
         fn = compile_template(text)
         chunks: list[str] = []
-        self.queries = []
-
-        pending: list[tuple[str, QueryResponse]] = []
+        used: list[str] = []
+        loop = asyncio.get_running_loop()
 
         async def fetch(q: str) -> QueryResponse:
             cols, rows = await self.client.query(q)
             return QueryResponse(cols, rows)
 
-        # sql() must be synchronous inside the template; pre-resolve by
-        # running the template twice: first pass records queries with empty
-        # results, second pass injects fetched data.
-        recorded: list[str] = []
+        def sql_sync(q: str) -> QueryResponse:
+            used.append(q)
+            return asyncio.run_coroutine_threadsafe(fetch(q), loop).result(
+                timeout=60.0
+            )
 
-        def sql_record(q: str) -> QueryResponse:
-            recorded.append(q)
-            return _NullResponse()
-
-        try:
-            fn(lambda s: None, sql_record, socket.gethostname, {})
-        except Exception:
-            # The recording pass runs on placeholder data; templates that
-            # compute on real rows may fail here — queries recorded so far
-            # are what matters.
-            pass
-        results = {}
-        for q in recorded:
-            results[q] = await fetch(q)
-        self.queries = list(dict.fromkeys(recorded))
-
-        def sql_real(q: str) -> QueryResponse:
-            # Explicit membership test: a zero-row QueryResponse is falsy
-            # but must keep its real column names.
-            return results[q] if q in results else QueryResponse([], [])
-
-        fn(chunks.append, sql_real, socket.gethostname, {})
+        await asyncio.to_thread(
+            fn, chunks.append, sql_sync, socket.gethostname, {}
+        )
+        self.queries = list(dict.fromkeys(used))
         return "".join(chunks)
 
     async def write(self) -> None:
@@ -196,17 +157,44 @@ async def run_templates(specs: list[str], cfg: Config, watch: bool = False) -> N
     if not watch:
         return
     # Re-render on subscription changes to any used query
-    # (corrosion/src/command/tpl.rs:29+).
+    # (corrosion/src/command/tpl.rs:29+). Data-dependent templates can
+    # discover NEW queries on a re-render (a row appearing makes the loop
+    # body fetch for it) — after every render the subscription set is
+    # reconciled so late-discovered queries get watched too.
     async def watch_one(st: TemplateState):
-        subs = []
-        for q in st.queries:
-            subs.append(await client.subscribe(q, skip_rows=True))
+        pumps: dict[str, asyncio.Task] = {}
 
-        async def pump(sub):
+        async def watch_query(q: str):
+            # Subscribe INSIDE the task: ensure_subs assigns pumps[q]
+            # synchronously before any await, so two concurrent renders
+            # can never double-subscribe one query.
+            sub = await client.subscribe(q, skip_rows=True)
             async for ev in sub:
                 if "change" in ev:
                     await st.write()
+                    ensure_subs()
 
-        await asyncio.gather(*(pump(s) for s in subs))
+        def ensure_subs() -> None:
+            for q in st.queries:
+                if q not in pumps:
+                    pumps[q] = asyncio.create_task(watch_query(q))
+
+        ensure_subs()
+        while pumps:
+            done, _ = await asyncio.wait(
+                set(pumps.values()), return_when=asyncio.FIRST_COMPLETED
+            )
+            for q, t in list(pumps.items()):
+                if t in done:
+                    del pumps[q]
+                    # A dead watch means that query's changes no longer
+                    # re-render — surface it instead of going silently
+                    # stale (exception retrieval also silences asyncio's
+                    # destroyed-task warning).
+                    if not t.cancelled() and t.exception() is not None:
+                        logging.getLogger(__name__).warning(
+                            "template watch for %r died", q,
+                            exc_info=t.exception(),
+                        )
 
     await asyncio.gather(*(watch_one(st) for st in states))

@@ -181,6 +181,94 @@ def test_template_render_and_watch(tmp_path):
             await st.write()
             out = (tmp_path / "out.conf").read_text()
             assert out.strip() == "id,text"
+
+            # Data-dependent NESTED sql(): the per-row query's text depends
+            # on the outer query's rows. Single-pass direct execution
+            # (corro-tpl lib.rs:447-613) fetches them live; the old
+            # record-then-render double pass silently rendered them empty.
+            tpl.write_text(
+                "<% for row in sql(\"SELECT id FROM tests ORDER BY id\"): %>"
+                "<%= sql(\"SELECT text FROM tests WHERE id = \""
+                " + str(row[0])).rows[0][0] %>\n"
+                "<% end %>"
+            )
+            await st.write()
+            out = (tmp_path / "out.conf").read_text()
+            assert out.splitlines() == ["svc-a", "svc-b"]
+            # All three query texts (outer + one per row) were recorded for
+            # watch mode.
+            assert len(st.queries) == 3
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_template_watch_resubscribes_late_queries(tmp_path):
+    """Watch mode must pick up queries DISCOVERED on a re-render: a new
+    row makes the nested loop issue a new per-row query; a later change
+    visible only through that query must still trigger a re-render."""
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        try:
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'one')"]]
+            )
+            tpl = tmp_path / "w.conf.tpl"
+            tpl.write_text(
+                "<% for row in sql(\"SELECT id FROM tests ORDER BY id\"): %>"
+                "<%= sql(\"SELECT text FROM tests2 WHERE id = \""
+                " + str(row[0])).to_json() %>\n"
+                "<% end %>"
+            )
+            from corrosion_tpu.client import CorrosionApiClient
+            from corrosion_tpu.tpl import TemplateState, run_templates
+            from corrosion_tpu.agent.config import Config
+
+            host, port = a.agent.api_addr
+            cfg = Config()
+            cfg.api.addr = f"{host}:{port}"
+            out_path = tmp_path / "w.conf"
+            task = asyncio.create_task(
+                run_templates(
+                    [f"{tpl}:{out_path}"], cfg, watch=True
+                )
+            )
+            try:
+                async def rendered():
+                    return out_path.exists()
+
+                await poll_until(rendered)
+                # New tests row -> re-render discovers the tests2 query for
+                # id 2 and subscribes to it.
+                await a.client.execute(
+                    [["INSERT INTO tests (id, text) VALUES (2, 'two')"]]
+                )
+
+                async def saw_empty_two():
+                    return (
+                        out_path.exists()
+                        and out_path.read_text().count("[]") >= 2
+                    )
+
+                await poll_until(saw_empty_two)
+                # A change visible ONLY via the late-discovered tests2
+                # query must still re-render.
+                await a.client.execute(
+                    [["INSERT INTO tests2 (id, text) VALUES (2, 'deep')"]]
+                )
+
+                async def saw_deep():
+                    return "deep" in out_path.read_text()
+
+                await poll_until(saw_deep)
+            finally:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
         finally:
             await a.stop()
 
