@@ -78,15 +78,15 @@ def segmented_prefix_and_rows(
     [N, K] inputs with segments confined to a row (axis 1, marked by
     seg_start): out[n, i] = AND of flags[n, j] from the segment's first
     element to i. cummax/cumsum formulation — a segmented associative_scan
-    would blow up XLA:TPU compile time at message-plane sizes."""
-    k = flags.shape[1]
-    idx = jnp.arange(k)[None, :]
-    start = jax.lax.cummax(jnp.where(seg_start, idx, 0), axis=1)
+    would blow up XLA:TPU compile time at message-plane sizes, and the
+    obvious take_along_axis(bad, segment_start) lowers as a serialized
+    per-element gather (2 x 167 ms at [100k, 144] on v5e, the broadcast
+    plane's single largest cost). Instead: ``g = bad-count strictly before
+    i`` is non-decreasing, so the segment-start value is a running max of
+    g captured at start positions — no gather at all."""
     bad = jnp.cumsum((~flags).astype(jnp.int32), axis=1)
-    take = jnp.take_along_axis
-    bad_before = take(bad, start, axis=1) - take(
-        (~flags).astype(jnp.int32), start, axis=1
-    )
+    g = bad - (~flags).astype(jnp.int32)  # bad count strictly before i
+    bad_before = jax.lax.cummax(jnp.where(seg_start, g, -1), axis=1)
     return (bad - bad_before) == 0
 
 
