@@ -121,7 +121,13 @@ def cluster_round(
     cfg: ClusterConfig,
     has_churn: bool,
 ) -> tuple[ClusterState, dict]:
-    k_churn, k_bcast, k_swim, k_sync = jax.random.split(rng, 4)
+    # The rejoin key exists only for churn configs, so churn-free runs
+    # keep bit-identical RNG streams with earlier measurements.
+    if has_churn:
+        k_churn, k_bcast, k_swim, k_sync, k_rejoin = jax.random.split(rng, 5)
+    else:
+        k_churn, k_bcast, k_swim, k_sync = jax.random.split(rng, 4)
+        k_rejoin = None
     swim_impl = swim_ops.impl(cfg.swim)
     sw = state.swim
     if has_churn:
@@ -137,6 +143,17 @@ def cluster_round(
     data, sstats = gossip_ops.sync_round(
         data, topo, alive, partition, state.round, k_sync, cfg.gossip
     )
+    if has_churn:
+        # Rejoining nodes pull immediately instead of waiting out their
+        # cohort slot (the reference syncs on rejoin).
+        data, rstats = gossip_ops.revive_sync(
+            data, topo, alive, partition, revive, k_rejoin, cfg.gossip
+        )
+        sstats = {
+            "applied_sync": sstats["applied_sync"] + rstats["applied_sync"],
+            "sessions": sstats["sessions"] + rstats["sessions"],
+            "cell_merges": sstats["cell_merges"] + rstats["cell_merges"],
+        }
 
     # Visibility tracking for sampled writes that have been committed.
     active = state.round >= sample_round  # [S]

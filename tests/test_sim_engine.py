@@ -27,6 +27,40 @@ def test_three_node_1k_inserts_converges():
     assert curves["mismatches"][-1] == 0
 
 
+def test_revive_syncs_immediately():
+    """A rejoining node pulls anti-entropy the SAME round it comes back
+    (the reference syncs on rejoin) instead of waiting out its sync-cohort
+    slot — heal latency is bounded by the session budget, not the cadence."""
+    from corrosion_tpu.ops.gossip import GossipConfig, make_topology
+    from corrosion_tpu.ops.swim import SwimConfig
+    from corrosion_tpu.sim.engine import ClusterConfig
+
+    n = 16
+    g = GossipConfig(
+        n_nodes=n, n_writers=1, sync_interval=12, sync_budget=256,
+        sync_chunk=256, fanout_near=2, fanout_far=1, max_transmissions=5,
+    )
+    cfg = ClusterConfig(
+        swim=SwimConfig(n_nodes=n, max_transmissions=5), gossip=g
+    )
+    topo = make_topology([n], [0], sync_interval=g.sync_interval)
+    rounds = 40
+    writes = np.zeros((rounds, 1), np.uint32)
+    writes[:20, 0] = 4  # 80 versions while node 9 is down
+    kill = np.zeros((rounds, n), bool)
+    revive = np.zeros((rounds, n), bool)
+    kill[0, 9] = True
+    revive[30, 9] = True
+    sched = Schedule(writes=writes, kill=kill, revive=revive).make_samples(8)
+    final, curves = simulate(cfg, topo, sched, seed=3)
+    # 80 versions committed; with sync_interval=12 and revival at round 30,
+    # a cohort-only node might not sync before round 40 at all. The
+    # rejoin session (budget 256 > 80) must have caught it up immediately.
+    contig = np.asarray(final.data.contig)
+    assert int(np.asarray(final.data.head)[0]) == 80
+    assert int(contig[9, 0]) == 80, "revived node must catch up on rejoin"
+
+
 def test_churn_32_detects_and_heals():
     cfg, topo, sched = models.churn_32(rounds=200, samples=32)
     final, curves = simulate(cfg, topo, sched, seed=1)
