@@ -85,71 +85,64 @@ def main() -> None:
     converged = bool((contig == heads[None, :]).all())
     cells_ok = bool(gossip_ops.cells_agree(final.data, cfg.gossip))
 
-    # Per-plane step-time breakdown on the run's FINAL state (fresh state
-    # would flatter sync — no deficits to score or grant), each measured
-    # as a jitted scan so remote-dispatch overhead doesn't pollute it.
+    # Per-plane attribution by CUMULATIVE PREFIX on the run's FINAL state
+    # (fresh state would flatter sync — no deficits to score or grant):
+    # time the composite with stages enabled one at a time in execution
+    # order; a stage's cost is the increment. Increments telescope to the
+    # full round exactly, so the printed residual is just the empty-scan
+    # overhead — nothing can hide in unattributed time. (Isolated plane
+    # timings under-counted in-context costs by ~35%; ablation timings
+    # over-counted overlap by ~20%.)
+    # NOTE: the big arrays ride the CARRY, never closures — a closed-over
+    # DataState would be embedded as compile-payload constants (hundreds
+    # of MB at 10k; the axon compile tunnel rejects it outright).
     data = final.data
     swim_impl = swim_ops.impl(cfg.swim)
-    sw = final.swim
-    alive = jnp.ones(cfg.n_nodes, bool)
     n_regions = int(np.asarray(topo.region).max()) + 1
     part = jnp.zeros((n_regions, n_regions), bool)
     writes = jnp.asarray(sched.writes[0], jnp.uint32)
     key = jax.random.PRNGKey(0)
-    bcast_ms = _time_plane(
-        lambda d, i: gossip_ops.broadcast_round(
-            d, topo, alive, part, writes, jax.random.fold_in(key, i),
-            cfg.gossip,
-        )[0],
-        data,
-    )
-    sync_ms = _time_plane(
-        lambda d, i: gossip_ops.sync_round(
-            d, topo, alive, part, i, jax.random.fold_in(key, i), cfg.gossip
-        )[0],
-        data,
-    )
-    swim_ms = _time_plane(
-        lambda s, i: swim_impl.swim_round(
-            s, jax.random.fold_in(key, i), i, cfg.swim
-        ),
-        sw,
-    )
-    # Fourth stage: per-round visibility tracking + metric reduces (the
-    # cluster_round tail after the three planes) — previously the
-    # unattributed ~35% of step time where regressions could hide.
     s_writer = jnp.asarray(sched.sample_writer)
     s_ver = jnp.asarray(sched.sample_ver)
     s_round = jnp.asarray(sched.sample_round)
+    stages = ("broadcast", "swim", "sync", "track")  # execution order
 
-    # NOTE: the big arrays ride the CARRY, never the closure — a closed-over
-    # DataState would be embedded as compile-payload constants (hundreds of
-    # MB at 10k; the axon compile tunnel rejects it outright).
-    def track_step(carry, i):
-        d, vis_round = carry
-        vis_now = gossip_ops.visibility(d, s_writer, s_ver)
-        active = i >= s_round
-        vr = jnp.where(
-            (vis_round < 0) & vis_now & active[:, None], i, vis_round
-        )
-        # Keep the need reduce live (it is part of every round's stats).
-        need = gossip_ops.total_need(d)
-        return d, vr + (need * jnp.uint32(0)).astype(vr.dtype)
+    def composite(enabled):
+        def step(carry, i):
+            d, sw, vr = carry
+            k = jax.random.fold_in(key, i)
+            k_b, k_sw, k_sy = jax.random.split(k, 3)
+            if "broadcast" in enabled:
+                d, _ = gossip_ops.broadcast_round(
+                    d, topo, sw.alive, part, writes, k_b, cfg.gossip
+                )
+            if "swim" in enabled:
+                sw = swim_impl.swim_round(sw, k_sw, i, cfg.swim)
+            if "sync" in enabled:
+                d, _ = gossip_ops.sync_round(
+                    d, topo, sw.alive, part, i, k_sy, cfg.gossip
+                )
+            if "track" in enabled:
+                vis_now = gossip_ops.visibility(d, s_writer, s_ver)
+                active = i >= s_round
+                vr = jnp.where(
+                    (vr < 0) & vis_now & active[:, None], i, vr
+                )
+                need = gossip_ops.total_need(d)
+                vr = vr + (need * jnp.uint32(0)).astype(vr.dtype)
+            return d, sw, vr
 
-    track_ms = _time_plane(track_step, (data, final.vis_round))
+        return step
 
-    # Whole cluster_round as one unit: the honest per-round device time the
-    # four stages must sum to (wall-clock step_ms additionally carries
-    # host-side chunk dispatch).
-    def full_step(st, i):
-        st2, _ = sim_engine.cluster_round(
-            st, topo, writes, part, jnp.zeros((1,), bool),
-            jnp.zeros((1,), bool), s_writer, s_ver, s_round,
-            jax.random.fold_in(key, i), cfg, False,
-        )
-        return st2
-
-    full_ms = _time_plane(full_step, final)
+    carry0 = (data, final.swim, final.vis_round)
+    cum = [_time_plane(composite(stages[:k]), carry0)
+           for k in range(len(stages) + 1)]
+    full_ms = cum[-1]
+    plane = {
+        s: max(cum[k + 1] - cum[k], 0.0) for k, s in enumerate(stages)
+    }
+    swim_ms, bcast_ms = plane["swim"], plane["broadcast"]
+    sync_ms, track_ms = plane["sync"], plane["track"]
 
     state_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(final.data)
@@ -184,9 +177,10 @@ def main() -> None:
                 "p50_s": round(lat["p50_s"], 2),
                 "throughput_changes_per_s": round(applied / wall, 1),
                 "step_ms": round(step_ms, 1),
-                # One fused cluster_round per device step; the four stages
-                # must sum to it (residual = fusion/measurement slack, kept
-                # visible so regressions can't hide in unattributed time).
+                # One fused composite round per device step; the four
+                # ablation-attributed stages must sum to it (residual =
+                # cross-stage fusion slack, kept visible so regressions
+                # can't hide in unattributed time).
                 "step_inner_ms": round(full_ms, 1),
                 "plane_ms": {
                     "swim": round(swim_ms, 1),
