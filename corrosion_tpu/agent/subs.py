@@ -93,8 +93,23 @@ def _membership_is_local(select_list: str, tail: str) -> bool:
 
 
 def normalize_sql(sql: str) -> str:
-    """Whitespace/case-insensitive reuse key (pubsub.rs normalize_sql:2089)."""
-    return " ".join(sql.strip().rstrip(";").split()).lower()
+    """Canonical reuse key (pubsub.rs normalize_sql:2089, which parses and
+    re-serializes via sqlparser). Token-level here: comments and
+    whitespace drop, unquoted identifiers/keywords lowercase, trailing
+    ';' strips — while string literals and quoted identifiers keep their
+    case (the old lowercase-everything key deduped `x='A'` with `x='a'`
+    onto ONE matcher, silently serving the second subscriber the wrong
+    rows)."""
+    from corrosion_tpu.agent import pgsql
+
+    out = []
+    for t in pgsql.tokenize(sql):
+        if t.kind in ("ws", "comment"):
+            continue
+        out.append(t.text.lower() if t.kind == "ident" else t.text)
+    while out and out[-1] == ";":
+        out.pop()
+    return " ".join(out)
 
 
 _WRITE_ACTIONS = {

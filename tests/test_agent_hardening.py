@@ -126,6 +126,26 @@ def test_fallback_full_diff_is_rate_limited(tmp_path):
         store.close()
 
 
+def test_normalize_sql_token_level():
+    """Reuse-key normalization (VERDICT r3 #4): spelling-insensitive for
+    SQL structure, but literal-preserving — two queries differing only in
+    literal case must get DISTINCT matchers."""
+    from corrosion_tpu.agent.subs import normalize_sql
+
+    assert normalize_sql("SELECT  id\nFROM Tests WHERE x = 1;") == (
+        normalize_sql("select id from tests where x=1")
+    )
+    # Same statement, different identifier case / whitespace / comments.
+    a = normalize_sql("SELECT id FROM tests -- c\n WHERE x = 'A'")
+    b = normalize_sql("select id\n from TESTS where x = 'A'")
+    assert a == b
+    # Different literal case: DIFFERENT keys.
+    c = normalize_sql("select id from tests where x = 'a'")
+    assert a != c
+    # Trailing semicolons and comments never affect the key.
+    assert normalize_sql("SELECT 1;") == normalize_sql("SELECT 1")
+
+
 def test_swim_and_sync_loops_warn_once_per_streak(tmp_path, caplog):
     async def main():
         a = await launch_test_agent(
