@@ -105,6 +105,7 @@ class TemplateState:
         self.out_path = out_path
         self.client = client
         self.queries: list[str] = []
+        self._watch_pumps: dict | None = None  # set by watch mode
 
     async def render_once(self) -> str:
         """Single-pass direct execution, like Rhai's inline sql()
@@ -163,23 +164,33 @@ async def run_templates(specs: list[str], cfg: Config, watch: bool = False) -> N
     # reconciled so late-discovered queries get watched too.
     async def watch_one(st: TemplateState):
         pumps: dict[str, asyncio.Task] = {}
+        st._watch_pumps = pumps  # observable for tests/diagnostics
+        log = logging.getLogger(__name__)
 
         async def watch_query(q: str):
-            # Subscribe INSIDE the task: ensure_subs assigns pumps[q]
+            # Subscribe INSIDE the task: reconcile assigns pumps[q]
             # synchronously before any await, so two concurrent renders
             # can never double-subscribe one query.
             sub = await client.subscribe(q, skip_rows=True)
             async for ev in sub:
                 if "change" in ev:
                     await st.write()
-                    ensure_subs()
+                    reconcile()
 
-        def ensure_subs() -> None:
-            for q in st.queries:
+        def reconcile() -> None:
+            """Match the pump set to the queries the LAST render used:
+            late-discovered queries get watched, queries that dropped out
+            (a deleted row's per-row fetch) get cancelled — the set tracks
+            the template, it never just grows."""
+            want = set(st.queries)
+            for q in list(pumps):
+                if q not in want:
+                    pumps.pop(q).cancel()
+            for q in want:
                 if q not in pumps:
                     pumps[q] = asyncio.create_task(watch_query(q))
 
-        ensure_subs()
+        reconcile()
         while pumps:
             done, _ = await asyncio.wait(
                 set(pumps.values()), return_when=asyncio.FIRST_COMPLETED
@@ -188,13 +199,26 @@ async def run_templates(specs: list[str], cfg: Config, watch: bool = False) -> N
                 if t in done:
                     del pumps[q]
                     # A dead watch means that query's changes no longer
-                    # re-render — surface it instead of going silently
-                    # stale (exception retrieval also silences asyncio's
-                    # destroyed-task warning).
-                    if not t.cancelled() and t.exception() is not None:
-                        logging.getLogger(__name__).warning(
-                            "template watch for %r died", q,
-                            exc_info=t.exception(),
+                    # re-render — surface it (exception retrieval also
+                    # silences asyncio's destroyed-task warning).
+                    if not t.cancelled():
+                        log.warning(
+                            "template watch for %r ended; resubscribing",
+                            q, exc_info=t.exception(),
                         )
+            # Still-wanted queries whose watch died get resubscribed after
+            # a re-render (which also catches anything missed while the
+            # watch was down) — one transient stream failure must not end
+            # watch mode.
+            if set(st.queries) - set(pumps):
+                await asyncio.sleep(2.0)
+                try:
+                    await st.write()
+                except Exception:
+                    log.debug(
+                        "template re-render failed; retrying",
+                        exc_info=True,
+                    )
+                reconcile()
 
     await asyncio.gather(*(watch_one(st) for st in states))

@@ -263,6 +263,18 @@ def test_template_watch_resubscribes_late_queries(tmp_path):
                     return "deep" in out_path.read_text()
 
                 await poll_until(saw_deep)
+                # Subscription set tracks the template: deleting row 2
+                # drops its per-row query on the next render (reconcile
+                # cancels the stale pump — the set never just grows), and
+                # the output shrinks back to one line.
+                await a.client.execute(
+                    [["DELETE FROM tests WHERE id = 2"]]
+                )
+
+                async def shrunk():
+                    return out_path.read_text().count("\n") == 1
+
+                await poll_until(shrunk)
             finally:
                 task.cancel()
                 try:
