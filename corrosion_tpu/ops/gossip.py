@@ -631,11 +631,18 @@ def broadcast_round(
                     # d2 <= lim excludes the clamped sentinel: its TRUE
                     # delta is unknown (> lim), so admitting it would set a
                     # bit for a version the node does not hold. Deltas are
-                    # window-relative above contig_pre + adv (adv gathered
-                    # per message's writer).
+                    # window-relative above contig_pre + adv; adv per
+                    # message comes from a segmented running max, not a
+                    # gather — applied entries are a sorted PREFIX of their
+                    # writer segment, so the running max of applied deltas
+                    # already equals the writer's advance at every later
+                    # position.
+                    adv_m = routing.segmented_running_max(
+                        jnp.where(applied, d2, 0), seg_start, lim + 2
+                    )
                     contig2, oo2, new_poss = _window_admit(
                         oo, contig_pre, adv,
-                        _onehot_rowgather(adv, w2),
+                        adv_m,
                         d2,
                         valid2 & first_copy & (d2 <= jnp.uint32(lim)),
                         wk,
@@ -733,10 +740,22 @@ def broadcast_round(
                 )
 
                 def _with_window(oo):
+                    # Per-message advance from a segmented running max
+                    # (applied entries are a sorted prefix of their writer
+                    # segment; see the fast path) — take_along_axis here
+                    # lowers as a serialized gather. v2 > base masks stale
+                    # retransmissions (possible under rebroadcast_stale):
+                    # their wrapped u32 delta must never enter the packing.
+                    d_m = jnp.where(valid2, v2 - base, 0)
+                    adv_m = routing.segmented_running_max(
+                        jnp.where(run & valid2 & (v2 > base), d_m, 0),
+                        seg_start,
+                        1 << 24,  # versions < 2^24 (CRDT pack domain)
+                    )
                     contig2, oo2, new_poss = _window_admit(
                         oo, contig_pre, adv,
-                        take(adv, w2c, axis=1),
-                        jnp.where(valid2, v2 - base, 0),
+                        adv_m,
+                        d_m,
                         valid2 & ~prev_same,
                         wk,
                         lambda word: take(word, w2c, axis=1),

@@ -90,6 +90,25 @@ def segmented_prefix_and_rows(
     return (bad - bad_before) == 0
 
 
+def segmented_running_max(
+    vals: jax.Array,  # u32[N, K] values (must be < band)
+    seg_start: jax.Array,  # bool[N, K] segment starts along axis 1
+    band: int,  # static bound: vals < band, and #segments * band < 2^32
+) -> jax.Array:
+    """Per-segment inclusive running max of ``vals`` along each row.
+
+    cummax over (segment_id * band + val): later segments' ids dominate,
+    so the extracted low part resets at every segment start. Gather-free —
+    the take_along_axis formulation lowers as a serialized per-element
+    gather on TPU (see segmented_prefix_and_rows)."""
+    k = vals.shape[1]
+    # ceil(k)+1 possible segment ids per row.
+    assert (k + 1) * band <= (1 << 32), "segment banding overflows u32"
+    seg_id = jnp.cumsum(seg_start.astype(jnp.uint32), axis=1)
+    packed = seg_id * jnp.uint32(band) + vals
+    return jax.lax.cummax(packed, axis=1) % jnp.uint32(band)
+
+
 def rebuild_bounded_queue(
     cand_valid: jax.Array,
     cand_prio: jax.Array,
