@@ -326,6 +326,74 @@ def test_lossy_run_exercises_window_and_converges():
     assert int(gossip.total_need(data)) == 0
 
 
+def test_fast_and_legacy_paths_agree_on_one_round():
+    """From an identical mid-run state and RNG, one broadcast_round via the
+    delta-packed one-hot path and via the sort+scatter path must produce
+    identical possession state (contig/seen/oo) and cells — the two
+    implementations encode ONE semantics."""
+    cfg = gossip.GossipConfig(
+        n_nodes=24, n_writers=6, queue=8, fanout_near=2, fanout_far=1,
+        max_transmissions=5, loss_prob=0.3, window_k=32, n_cells=32,
+        sync_interval=4,
+    )
+    topo = gossip.make_topology([12, 12], [0, 3, 7, 11, 15, 19])
+    data = gossip.init_data(cfg)
+    alive = jnp.ones(24, bool)
+    part = jnp.zeros((2, 2), bool)
+    key = jax.random.PRNGKey(4)
+    w = jnp.full((6,), 2, jnp.uint32)
+    # Build a messy mid-run state on the default (fast) path.
+    for r in range(12):
+        key, k1, k2 = jax.random.split(key, 3)
+        data, _ = gossip.broadcast_round(data, topo, alive, part, w, k1, cfg)
+        if r % 3 == 0:
+            data, _ = gossip.sync_round(
+                data, topo, alive, part, jnp.int32(r), k2, cfg
+            )
+    key, k1 = jax.random.split(key)
+    out_fast, _ = gossip.broadcast_round(data, topo, alive, part, w, k1, cfg)
+    old = gossip._FAST_MAX_WRITERS
+    gossip._FAST_MAX_WRITERS = 0
+    _clear_jit_caches()
+    try:
+        out_legacy, _ = gossip.broadcast_round(
+            data, topo, alive, part, w, k1, cfg
+        )
+    finally:
+        gossip._FAST_MAX_WRITERS = old
+        _clear_jit_caches()
+    for name in ("head", "contig", "seen", "oo"):
+        a = np.asarray(getattr(out_fast, name))
+        b = np.asarray(getattr(out_legacy, name))
+        assert (a == b).all(), f"{name} diverges between delivery paths"
+    for name in ("cl", "col_version", "value_rank"):
+        a = np.asarray(getattr(out_fast.cells, name))
+        b = np.asarray(getattr(out_legacy.cells, name))
+        assert (a == b).all(), f"cells.{name} diverges between paths"
+
+
+def test_lossy_engine_run_with_64bit_window():
+    """window_k=64 (two words): full engine round loop under loss converges
+    and drains the window — exercises the multi-word shift/absorb path at
+    engine level, not just the unit model."""
+    cfg, topo, data = _mini_cluster(window_k=64, loss=0.4)
+    alive = jnp.ones(16, bool)
+    part = jnp.zeros((1, 1), bool)
+    key = jax.random.PRNGKey(6)
+    for r in range(80):
+        key, k1, k2 = jax.random.split(key, 3)
+        writes = jnp.asarray([3 if r < 20 else 0], jnp.uint32)
+        data, _ = gossip.broadcast_round(
+            data, topo, alive, part, writes, k1, cfg
+        )
+        data, _ = gossip.sync_round(
+            data, topo, alive, part, jnp.int32(r), k2, cfg
+        )
+    assert bool((np.asarray(data.contig)[:, 0] == 60).all())
+    assert not bool(np.asarray(data.oo_any))
+    assert int(gossip.total_need(data)) == 0
+
+
 def test_window_off_matches_old_inorder_semantics():
     """window_k=0 keeps the strict in-order model: no oo state, converges
     the old way."""
