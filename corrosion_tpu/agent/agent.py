@@ -91,6 +91,10 @@ class AgentConfig:
     # scaled down to in-process test time.
     compact_interval: float = 5.0
     empties_flush_interval: float = 0.5
+    # Orphaned-partial reconcile cadence (clear_buffered_meta_loop runs
+    # every 300 s in the reference, agent.rs:2575-2619; scaled to
+    # in-process test time like compact_interval).
+    buffered_meta_interval: float = 10.0
     # Row-count sampling cadence (collect_metrics runs every 10 s in the
     # reference, agent.rs:1138-1187). Full COUNT(*) scans ride the read
     # pool, but at millions of log rows even pooled scans are not free —
@@ -287,6 +291,16 @@ class Agent:
             probe_interval=self.cfg.probe_interval,
             max_transmissions=self.cfg.max_transmissions,
         )
+        # Identity freshness across restarts (actor.rs:169-194's renew-on-
+        # rejoin): the own-incarnation row persisted at shutdown seeds the
+        # next life one higher, so ALIVE@n+1 beats any durable DOWN@n a
+        # graceful leave taught the cluster.
+        row = self.store.conn.execute(
+            "SELECT incarnation FROM __corro_members WHERE actor_id = ?",
+            (self.actor_id,),
+        ).fetchone()
+        if row is not None:
+            self.swim.incarnation = int(row[0]) + 1
         from corrosion_tpu.agent.api import serve_api
 
         self.api_addr = await serve_api(self)
@@ -312,6 +326,9 @@ class Agent:
             self._compact_loop(), name="clear_overwritten_versions"
         )
         self.tasks.spawn(self._empties_loop(), name="write_empties_loop")
+        self.tasks.spawn(
+            self._buffered_meta_loop(), name="clear_buffered_meta_loop"
+        )
         self.tasks.spawn(self._metrics_loop(), name="metrics_loop")
         self.tasks.spawn(
             self._runtime_metrics_loop(), name="runtime_metrics"
@@ -353,6 +370,14 @@ class Agent:
             await asyncio.sleep(next(backoff))
 
     async def stop(self) -> None:
+        # Graceful departure first, while the transport is still up
+        # (foca.leave_cluster, broadcast/mod.rs:306): peers learn DOWN now
+        # instead of after a probe-timeout + suspect window.
+        if self.swim is not None:
+            try:
+                await asyncio.wait_for(self.swim.leave_cluster(), 1.0)
+            except Exception:
+                pass
         self.tripwire.trip()
         await self.tasks.cancel_all()
         await self.tasks.wait_for_all_pending_handles(cap=5.0)
@@ -878,6 +903,67 @@ class Agent:
             except Exception:
                 streak.fail()
 
+    async def _buffered_meta_loop(self) -> None:
+        """Periodically drop buffered partial data for versions that were
+        CLEARED out-of-band (clear_buffered_meta_loop, agent.rs:2575-2619):
+        an empty changeset normally prunes its buffers inline, but a crash
+        between the bookkeeping write and the buffer prune — or a
+        compaction that raced a partial — leaves orphaned
+        __corro_buffered_changes/__corro_seq_bookkeeping rows that would
+        otherwise resurrect a dead partial at the next boot."""
+        streak = _StreakLogger("clear_buffered_meta failed")
+        while not self.tripwire.tripped:
+            await asyncio.sleep(self.cfg.buffered_meta_interval)
+            try:
+                await self._clear_buffered_meta_once()
+                streak.ok()
+            except Exception:
+                streak.fail()
+
+    async def _clear_buffered_meta_once(self) -> None:
+        # Work from what is actually BUFFERED (like agent.rs:2575-2619's
+        # SELECT over the buffer tables), not from the full cleared
+        # history: steady-state cost scales with outstanding orphans —
+        # normally zero rows — not with how much was ever compacted.
+        present = self.store.conn.execute(
+            "SELECT actor_id, version FROM __corro_seq_bookkeeping"
+            " UNION SELECT DISTINCT actor_id, version"
+            " FROM __corro_buffered_changes"
+        ).fetchall()
+        orphans: list[tuple[bytes, int]] = []
+        for site, version in present:
+            booked = self.bookie.get(site.hex())
+            if booked is not None and booked.cleared.contains(version):
+                orphans.append((site, version))
+                booked.partials.pop(version, None)
+        # In-memory partials whose version was cleared (no buffered rows
+        # left — e.g. restored state) reconcile too.
+        for actor, booked in list(self.bookie.items()):
+            for v in [
+                v for v in booked.partials if booked.cleared.contains(v)
+            ]:
+                booked.partials.pop(v, None)
+        if not orphans:
+            return
+
+        def db_work() -> None:
+            with self.store._wlock("clear_buffered_meta"):
+                self.store.conn.executemany(
+                    "DELETE FROM __corro_buffered_changes"
+                    " WHERE actor_id = ? AND version = ?",
+                    orphans,
+                )
+                self.store.conn.executemany(
+                    "DELETE FROM __corro_seq_bookkeeping"
+                    " WHERE actor_id = ? AND version = ?",
+                    orphans,
+                )
+
+        if self.pool is not None:
+            await self.pool.write_low(db_work)
+        else:
+            db_work()
+
     async def _compact_once(self) -> None:
         for actor, booked in list(self.bookie.items()):
             versions = booked.current_versions()  # db_version -> version
@@ -1029,6 +1115,17 @@ class Agent:
             aid: (f"{m.addr[0]}:{m.addr[1]}", m.state, m.incarnation)
             for aid, m in self.members.states.items()
         }
+        if self.swim is not None and self.gossip_addr is not None:
+            # Own-incarnation row: seeds identity freshness at the next
+            # boot (see start()); state ALIVE so the load-time DOWN purge
+            # never eats it.
+            from corrosion_tpu.agent.membership import ALIVE
+
+            current[self.actor_id] = (
+                f"{self.gossip_addr[0]}:{self.gossip_addr[1]}",
+                ALIVE,
+                self.swim.incarnation,
+            )
         changed = [
             (aid, v) for aid, v in current.items()
             if self._members_persisted.get(aid) != v

@@ -217,6 +217,35 @@ class Swim:
             if self.members.apply_update(aid, addr, u["state"], u["inc"]):
                 self.queue_rumor(aid, addr, u["state"], u["inc"])
 
+    async def leave_cluster(self) -> None:
+        """Graceful departure (foca.leave_cluster on shutdown,
+        broadcast/mod.rs:306): announce self DOWN at the CURRENT
+        incarnation directly to a handful of alive peers, so the cluster
+        learns immediately instead of paying a probe-timeout + suspect
+        window. Peers won't refute it (only the node itself refutes), and
+        a later restart re-announces alive at a higher incarnation."""
+        peers = [m for m in self.members.alive() if m.state == ALIVE]
+        random.shuffle(peers)
+        frame = {
+            "t": "swim",
+            "k": "leave",
+            "from": self.members.self_id,
+            "from_addr": list(self.self_addr),
+            "updates": [
+                {
+                    "id": self.members.self_id,
+                    "addr": list(self.self_addr),
+                    "state": DOWN,
+                    "inc": self.incarnation,
+                }
+            ],
+        }
+        for m in peers[: max(self.indirect_probes * 2, 4)]:
+            try:
+                await self.send(m.addr, frame)
+            except Exception:
+                continue
+
     # -- probe loop ----------------------------------------------------------
 
     async def probe_round(self) -> None:
@@ -352,11 +381,24 @@ class Swim:
             inc = msg.get("inc", 0)
             if self.members.apply_update(frm, addr, ALIVE, inc):
                 self.queue_rumor(frm, addr, ALIVE, inc)
-            # Reply with everything we know (bootstrap catch-up).
+            # Reply with everything we know (bootstrap catch-up) — and,
+            # crucially, our belief about the ANNOUNCER itself when it is
+            # not plain alive: a node that left gracefully and restarted
+            # must learn it is believed DOWN so it can refute with a
+            # higher incarnation (otherwise it stays invisible until the
+            # down-member GC).
             known = [
                 Rumor(m.actor_id, m.addr, m.state, m.incarnation, 1).wire()
                 for m in self.members.alive()
             ]
+            about_frm = self.members.states.get(frm)
+            if about_frm is not None and about_frm.state != ALIVE:
+                known.append(
+                    Rumor(
+                        frm, about_frm.addr, about_frm.state,
+                        about_frm.incarnation, 1,
+                    ).wire()
+                )
             known.append(
                 Rumor(
                     self.members.self_id, self.self_addr, ALIVE,

@@ -126,6 +126,97 @@ def test_fallback_full_diff_is_rate_limited(tmp_path):
         store.close()
 
 
+def test_graceful_leave_announces_down(tmp_path):
+    """Clean shutdown announces DOWN immediately (foca.leave_cluster,
+    broadcast/mod.rs:306): the survivor marks the peer down without
+    waiting out a probe-timeout + suspect window."""
+
+    async def main():
+        a = await launch_test_agent(
+            str(tmp_path / "a"), probe_interval=30.0
+        )
+        b = await launch_test_agent(
+            str(tmp_path / "b"), bootstrap=[a.gossip_addr],
+            probe_interval=30.0,
+        )
+        try:
+            async def joined():
+                return len(a.agent.members.alive()) == 1
+
+            await poll_until(joined)
+            b_id = b.agent.actor_id
+            await b.stop()
+            # Probes are effectively off (30 s interval): only the leave
+            # announcement can flip the state.
+            from corrosion_tpu.agent.membership import DOWN
+
+            async def b_down_on_a():
+                m = a.agent.members.states.get(b_id)
+                return m is not None and m.state == DOWN
+
+            await poll_until(b_down_on_a, timeout=5.0)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_restart_after_graceful_leave_rejoins_immediately(tmp_path):
+    """A clean leave makes DOWN durable on peers; the restarted node must
+    beat it — its persisted own-incarnation row seeds the next life one
+    higher, so ALIVE@n+1 wins even after the leave rumor's retransmission
+    budget is long spent."""
+
+    async def main():
+        from corrosion_tpu.agent.membership import ALIVE
+
+        b_dir = str(tmp_path / "b")
+        a = await launch_test_agent(
+            str(tmp_path / "a"), probe_interval=30.0
+        )
+        try:
+            b = await launch_test_agent(
+                b_dir, bootstrap=[a.gossip_addr], probe_interval=30.0
+            )
+            b_id = b.agent.actor_id
+
+            async def joined():
+                return len(a.agent.members.alive()) == 1
+
+            await poll_until(joined)
+            await b.stop()
+
+            async def b_down():
+                m = a.agent.members.states.get(b_id)
+                return m is not None and m.state != ALIVE
+
+            await poll_until(b_down, timeout=5.0)
+            # Model a LATE restart: the survivor's leave rumor budget is
+            # spent, so only the incarnation bump can win the rejoin.
+            a.agent.swim.rumors = []
+
+            b2 = await launch_test_agent(
+                b_dir, bootstrap=[a.gossip_addr], probe_interval=30.0
+            )
+            try:
+                assert b2.agent.actor_id == b_id
+                assert b2.agent.swim.incarnation >= 1, (
+                    "restart must seed a fresher incarnation"
+                )
+
+                async def b_alive_again():
+                    m = a.agent.members.states.get(b_id)
+                    return m is not None and m.state == ALIVE
+
+                await poll_until(b_alive_again, timeout=10.0)
+            finally:
+                await b2.stop()
+        finally:
+            await a.stop()
+
+    run(main())
+
+
 def test_normalize_sql_token_level():
     """Reuse-key normalization (VERDICT r3 #4): spelling-insensitive for
     SQL structure, but literal-preserving — two queries differing only in

@@ -358,3 +358,61 @@ def test_cleared_ranges_survive_restart(tmp_path):
             await a2.stop()
 
     run(main())
+
+
+def test_buffered_meta_reconcile_drops_orphaned_partials(tmp_path):
+    """clear_buffered_meta_loop analogue (agent.rs:2575-2619): buffered
+    partial data for a version cleared out-of-band (crash window between
+    the bookkeeping write and the inline prune) is reconciled away, and
+    the dead partial cannot resurrect at the next boot."""
+
+    async def main():
+        a = await launch_test_agent(
+            str(tmp_path / "a"), buffered_meta_interval=3600.0
+        )
+        try:
+            agent = a.agent
+            actor = "ab" * 16
+            site = bytes.fromhex(actor)
+            # Simulate the crash window: buffered rows + seq bookkeeping
+            # exist, and the bookie says the version is CLEARED (as a
+            # rehydrate after an out-of-band empty would produce).
+            with agent.store._wlock("test_seed"):
+                agent.store.conn.execute(
+                    "INSERT INTO __corro_buffered_changes VALUES"
+                    " (?, 3, 'tests', x'00', 'text', 'v', 1, 1, 0, ?, 1)",
+                    (site, site),
+                )
+                agent.store.conn.execute(
+                    "INSERT INTO __corro_seq_bookkeeping VALUES"
+                    " (?, 3, 0, 0, 5, 1)",
+                    (site,),
+                )
+            from corrosion_tpu.core.bookkeeping import CLEARED, Partial
+            from corrosion_tpu.core.intervals import RangeSet
+
+            booked = agent.bookie.for_actor(actor)
+            booked.partials[3] = Partial(
+                seqs=RangeSet([(0, 0)]), last_seq=5, ts=1
+            )
+            booked.insert_many(3, 3, CLEARED)
+            # insert_many(CLEARED) pops the partial itself; re-seed it to
+            # model a rehydrated process whose in-memory partial came from
+            # the orphaned seq rows.
+            booked.partials[3] = Partial(
+                seqs=RangeSet([(0, 0)]), last_seq=5, ts=1
+            )
+
+            await agent._clear_buffered_meta_once()
+
+            assert 3 not in booked.partials
+            assert agent.store.conn.execute(
+                "SELECT count(*) FROM __corro_buffered_changes"
+            ).fetchone()[0] == 0
+            assert agent.store.conn.execute(
+                "SELECT count(*) FROM __corro_seq_bookkeeping"
+            ).fetchone()[0] == 0
+        finally:
+            await a.stop()
+
+    run(main())
