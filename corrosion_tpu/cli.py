@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import logging
 import os
 import sys
 
@@ -191,6 +192,10 @@ async def _run_agent(cfg: Config) -> int:
 
     from corrosion_tpu.agent.agent import Agent, AgentConfig
     from corrosion_tpu.agent.subs import SubsManager
+    from corrosion_tpu.utils.logfmt import setup_logging
+
+    # Log format from config (LogFormat, config.rs:318-326).
+    setup_logging(fmt=cfg.log.format, colors=cfg.log.colors)
 
     gossip_host, gossip_port = parse_addr(cfg.gossip.addr)
     api_host, api_port = parse_addr(cfg.api.addr)
@@ -242,10 +247,11 @@ async def _run_agent(cfg: Config) -> int:
     from corrosion_tpu.utils.tripwire import Tripwire
 
     agent.tripwire = Tripwire.new_signals()
-    print(
-        f"agent {agent.actor_id} api={agent.api_addr} "
-        f"gossip={agent.gossip_addr}",
-        file=sys.stderr,
+    # Through the logging stack, not print: the startup banner must honor
+    # the configured log format (a JSON shipper chokes on bare text).
+    logging.getLogger("corrosion_tpu.cli").info(
+        "agent %s api=%s gossip=%s",
+        agent.actor_id, agent.api_addr, agent.gossip_addr,
     )
     await agent.tripwire.wait()
     await agent.stop()

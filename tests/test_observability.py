@@ -357,3 +357,32 @@ def test_runtime_metrics_exported(tmp_path):
             await a.stop()
 
     run(main())
+
+
+def test_log_format_selection(capsys):
+    """LogConfig.format drives the process formatter (config.rs:318-326):
+    json mode emits one JSON object per line; plaintext stays readable."""
+    import json
+    import logging
+
+    from corrosion_tpu.utils.logfmt import setup_logging
+
+    setup_logging(fmt="json")
+    try:
+        logging.getLogger("corro.test").warning("hello %s", "world")
+        err = capsys.readouterr().err.strip().splitlines()[-1]
+        obj = json.loads(err)
+        assert obj["level"] == "WARNING"
+        assert obj["msg"] == "hello world"
+        assert obj["target"] == "corro.test"
+
+        setup_logging(fmt="plaintext")
+        logging.getLogger("corro.test").warning("plain line")
+        err = capsys.readouterr().err.strip().splitlines()[-1]
+        assert "WARNING corro.test: plain line" in err
+    finally:
+        # Leave no custom handlers behind for other tests.
+        root = logging.getLogger()
+        for h in list(root.handlers):
+            if getattr(h, "_corro_log", False):
+                root.removeHandler(h)
