@@ -925,7 +925,10 @@ def sync_round(
             )
         cohort = jnp.mod(-round_idx, jnp.int32(cfg.sync_interval))
         rows = topo.sync_cohorts[cohort]  # i32[R], -1 padded
-        row_ok = (rows >= 0) & alive[jnp.maximum(rows, 0)]
+        # i32 gather (pred gathers serialize on TPU).
+        row_ok = (rows >= 0) & (
+            alive.astype(jnp.int32)[jnp.maximum(rows, 0)] > 0
+        )
         return _sync_rows(
             data, topo, alive, partition, jnp.maximum(rows, 0), row_ok,
             rng, cfg,
@@ -968,11 +971,14 @@ def _sync_rows(
     ) % jnp.maximum(topo.region_size[rows][:, None], 1)
     far = jax.random.randint(k_far, (r, c_far), 0, n)
     cand = jnp.concatenate([near, far], axis=1)  # i32[R, C]
+    # Gather i32, never bool (pred gathers serialize on TPU).
+    alive_i = alive.astype(jnp.int32)
+    part_i = partition.astype(jnp.int32)
     ok_c = (
         row_ok[:, None]
-        & alive[cand]
+        & (alive_i[cand] > 0)
         & (cand != rows[:, None])
-        & ~partition[region_r[:, None], topo.region[cand]]
+        & (part_i[region_r[:, None], topo.region[cand]] == 0)
     )
 
     # Candidate need scoring. Exact mode computes, per candidate, the count
@@ -1040,9 +1046,9 @@ def _sync_rows(
     origin_ok = (
         row_ok
         & (jnp.max(gap, axis=1) > 0)
-        & alive[origin]
+        & (alive_i[origin] > 0)
         & (origin != rows)
-        & ~partition[region_r, topo.region[origin]]
+        & (part_i[region_r, topo.region[origin]] == 0)
     )
     pulls = [(sel[:, s], sel_ok[:, s]) for s in range(cfg.sync_peers)]
     pulls.append((origin, origin_ok))

@@ -123,13 +123,22 @@ def rebuild_bounded_queue(
     int32-safe.
     """
     neg_inf = jnp.int32(-(2**31) + 1)
-    prio = jnp.where(cand_valid, cand_prio.astype(jnp.int32), neg_inf)
-    # One fused sort carrying mask + payloads (vs argsort + a gather per
-    # payload). Stable so over-capacity ties drop deterministically.
-    sorted_ops = jax.lax.sort(
-        (-prio, cand_valid, *payloads), dimension=1, num_keys=1,
-        is_stable=True,
+    # Clamp real priorities one above the invalid sentinel so a legal
+    # INT32_MIN+1 priority can never alias invalid (validity is inferred
+    # from the key below).
+    prio = jnp.where(
+        cand_valid,
+        jnp.maximum(cand_prio.astype(jnp.int32), neg_inf + 1),
+        neg_inf,
     )
-    mask = sorted_ops[1][:, :capacity]
-    outs = tuple(p[:, :capacity] for p in sorted_ops[2:])
+    # One fused sort carrying the payloads (vs argsort + a gather per
+    # payload). Stable so over-capacity ties drop deterministically.
+    # Validity rides the KEY (invalid = neg_inf sorts last), never as a
+    # bool operand — TPU serializes pred permutations (~50 ms for a
+    # [100k, 64] bool sort operand measured on v5e).
+    sorted_ops = jax.lax.sort(
+        (-prio, *payloads), dimension=1, num_keys=1, is_stable=True,
+    )
+    mask = sorted_ops[0][:, :capacity] < -neg_inf
+    outs = tuple(p[:, :capacity] for p in sorted_ops[1:])
     return mask, outs

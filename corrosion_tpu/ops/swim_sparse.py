@@ -466,8 +466,12 @@ def apply_churn(
     if rng is not None:
         cand = jax.random.randint(rng, (4, n), 0, n)
 
+        alive_i = alive.astype(jnp.int32)
+        revive_i = revive.astype(jnp.int32)
+
         def pick(carry, t):
-            ok = alive[t] & ~revive[t] & (carry < 0)
+            # i32 gathers (pred gathers serialize on TPU).
+            ok = (alive_i[t] > 0) & (revive_i[t] == 0) & (carry < 0)
             return jnp.where(ok, t, carry), None
 
         seed, _ = jax.lax.scan(pick, jnp.full((n,), -1, jnp.int32), cand)
@@ -522,7 +526,9 @@ def mismatches(state: SparseSwimState) -> jax.Array:
     )
     t = jnp.maximum(state.exc_tgt, 0)
     believed_up = packed_sev(state.exc_pkd) < SEV_DOWN
-    truth = alive[t]
+    # i32 gather: a pred gather here serialized at ~50 ms/round at 100k —
+    # the single most expensive op in the whole round, spent on a METRIC.
+    truth = alive.astype(jnp.int32)[t] > 0
     ent_mis = jnp.sum(ent_valid & (believed_up != truth))
     ent_default_mis = jnp.sum(ent_valid & ~truth)
     return default_mis + ent_mis - ent_default_mis
