@@ -64,7 +64,9 @@ def main() -> None:
         n, rounds = 512, 60
     cfg, topo, sched = models.merge_10k(n=n, rounds=rounds, samples=256)
 
-    chunk = 12  # bound single device executions (watchdog-safe)
+    chunk = 24  # bound single device executions (watchdog-safe:
+    # ~5 s per execution at current step times; dispatch to the remote
+    # device costs tens of ms per chunk, so fewer chunks = honest wall)
     t0 = time.perf_counter()
     final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=chunk)
     jax.block_until_ready(final.data.contig)

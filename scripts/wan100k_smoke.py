@@ -41,33 +41,36 @@ def main() -> None:
         rounds=rounds, samples=256, partition=not steady
     )
     if steptime:
-        # Warm-up one 8-round chunk (compile), then time the SAME compiled
-        # scan over the next chunks: per-round time without compile skew.
+        # Warm-up one full-size chunk (compile), then time the SAME
+        # compiled scan over the next chunks: per-round time without
+        # compile skew. The warm slice must match max_chunk, or the timed
+        # window compiles a different scan length.
         import dataclasses
 
+        ck = 16
         warm = dataclasses.replace(
-            sched, writes=sched.writes[:8],
-            partition=None if sched.partition is None else sched.partition[:8],
+            sched, writes=sched.writes[:ck],
+            partition=None if sched.partition is None else sched.partition[:ck],
         )
-        state, _ = simulate(cfg, topo, warm, seed=0, max_chunk=8)
+        state, _ = simulate(cfg, topo, warm, seed=0, max_chunk=ck)
         jax.block_until_ready(state.data.contig)
         rest = dataclasses.replace(
-            sched, writes=sched.writes[8:],
-            partition=None if sched.partition is None else sched.partition[8:],
+            sched, writes=sched.writes[ck:],
+            partition=None if sched.partition is None else sched.partition[ck:],
         )
         t0 = time.perf_counter()
-        state, _ = simulate(cfg, topo, rest, seed=0, state=state, max_chunk=8)
+        state, _ = simulate(cfg, topo, rest, seed=0, state=state, max_chunk=ck)
         jax.block_until_ready(state.data.contig)
         wall = time.perf_counter() - t0
         print(json.dumps({
             "platform": jax.devices()[0].platform,
             "mode": "steptime",
-            "rounds_timed": rounds - 8,
-            "step_ms": round(wall / max(rounds - 8, 1) * 1000.0, 1),
+            "rounds_timed": rounds - ck,
+            "step_ms": round(wall / max(rounds - ck, 1) * 1000.0, 1),
         }))
         return
     t0 = time.perf_counter()
-    final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=8)
+    final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=16)
     jax.block_until_ready(final.data.contig)
     wall = time.perf_counter() - t0
 
