@@ -28,6 +28,11 @@ from corrosion_tpu import models
 from corrosion_tpu.ops import swim_sparse
 from corrosion_tpu.sim import simulate, visibility_latencies
 
+# Device executions per dispatch (watchdog-safe at current step times;
+# the steptime warm slice must equal this so the timed window never
+# compiles).
+CHUNK = 16
+
 
 def main() -> None:
     from corrosion_tpu.utils.cache import enable_persistent_cache
@@ -47,7 +52,13 @@ def main() -> None:
         # window compiles a different scan length.
         import dataclasses
 
-        ck = 16
+        ck = CHUNK
+        if rounds - ck <= 0 or (rounds - ck) % ck != 0:
+            raise SystemExit(
+                f"--steptime needs rounds = warm({ck}) + k*{ck} timed "
+                f"(e.g. 48); got {rounds} — the timed window would "
+                f"compile a differently-sized scan and skew step_ms"
+            )
         warm = dataclasses.replace(
             sched, writes=sched.writes[:ck],
             partition=None if sched.partition is None else sched.partition[:ck],
@@ -70,7 +81,7 @@ def main() -> None:
         }))
         return
     t0 = time.perf_counter()
-    final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=16)
+    final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=CHUNK)
     jax.block_until_ready(final.data.contig)
     wall = time.perf_counter() - t0
 
