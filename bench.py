@@ -7,8 +7,10 @@ target / measured, so > 1.0 means the target is beaten.
 
 Extra fields document the run honestly: convergence flag, cluster-wide
 apply throughput, wall-clock per round after warm-up (the compile cache is
-hit because the jitted scan is hoisted), and a per-plane step-time
-breakdown (SWIM / broadcast / sync) from isolated timed executions.
+hit because the jitted scan is hoisted), and a per-stage step-time
+breakdown (broadcast / SWIM / sync / track) by cumulative-prefix
+attribution — stage increments telescope to the whole composite round, so
+the printed residual is the only unattributed time.
 
 Prints exactly one JSON line on stdout; diagnostics go to stderr.
 """

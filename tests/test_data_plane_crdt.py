@@ -109,3 +109,74 @@ def test_disabled_cell_plane_has_empty_state():
     w = jnp.zeros(6, jnp.uint32).at[0].set(1)
     data, merges = run(cfg, topo, data, 10, writes_fn=lambda r: w if r == 0 else jnp.zeros(6, jnp.uint32))
     assert merges == 0
+
+
+def test_block_enumeration_forced_at_small_scale_matches_flat():
+    """Force the block-decomposition enumeration at toy size (module
+    threshold override, like _FAST_MAX_WRITERS) and check the merged
+    cells equal a run through the flat path — the two implementations
+    encode ONE enumeration."""
+    def one_run():
+        cfg, topo, data = mk(
+            24, writers=list(range(24)), sync_interval=2, sync_budget=32,
+            sync_chunk=8, n_cells=32, fanout_near=2, fanout_far=1,
+        )
+        w = jnp.zeros(24, jnp.uint32).at[3].set(2).at[17].set(1)
+        data, _ = run(cfg, topo, data, 30,
+                      writes_fn=lambda r: w if r < 6 else jnp.zeros(24, jnp.uint32))
+        return cfg, data
+
+    cfg_a, flat = one_run()
+    old = gossip._BLOCK_ENUM_MIN_WRITERS
+    gossip._BLOCK_ENUM_MIN_WRITERS = 1
+    gossip.sync_round.clear_cache()
+    gossip.broadcast_round.clear_cache()
+    try:
+        cfg_b, block = one_run()
+    finally:
+        gossip._BLOCK_ENUM_MIN_WRITERS = old
+        gossip.sync_round.clear_cache()
+        gossip.broadcast_round.clear_cache()
+    for name in ("head", "contig", "seen"):
+        assert (np.asarray(getattr(flat, name))
+                == np.asarray(getattr(block, name))).all(), name
+    for name in ("cl", "col_version", "value_rank"):
+        assert (np.asarray(getattr(flat.cells, name))
+                == np.asarray(getattr(block.cells, name))).all(), name
+    assert_converged_to_serial_merge(block, cfg_b)
+
+
+def test_wide_writer_axis_sync_enumeration_matches_serial_merge():
+    """n_writers >= 2048 routes the sync grant enumeration through the
+    two-level block decomposition (MXU one-hot matmuls); the merged cell
+    state must still equal the order-independent serial merge — the same
+    ground truth the flat path is held to."""
+    n = 2048
+    cfg, topo, data = mk(
+        n,
+        writers=list(range(n)),
+        fanout_near=2,
+        fanout_far=2,
+        queue=8,
+        max_transmissions=5,
+        sync_interval=2,
+        sync_budget=128,
+        sync_chunk=8,
+        n_cells=64,
+    )
+    assert cfg.n_writers >= gossip._BLOCK_ENUM_MIN_WRITERS  # block path
+    rng = np.random.default_rng(9)
+    w_sched = (rng.random((6, n)) < 0.02).astype(np.uint32)
+
+    def writes_fn(r):
+        if r < 6:
+            return jnp.asarray(w_sched[r])
+        return jnp.zeros(n, jnp.uint32)
+
+    data, merges = run(cfg, topo, data, 40, writes_fn=writes_fn)
+    heads = np.asarray(data.head)
+    assert heads.sum() > 0
+    contig = np.asarray(data.contig)
+    assert (contig == heads[None, :]).all(), "watermarks must converge"
+    assert bool(gossip.cells_agree(data, cfg))
+    assert_converged_to_serial_merge(data, cfg)
