@@ -529,7 +529,6 @@ def broadcast_round(
             lost = jax.random.uniform(k_loss, (n, f, q_cap)) < cfg.loss_prob
             m_ok &= ~lost.reshape(n, kk)
         n_msgs = jnp.sum(m_ok)
-        take = jnp.take_along_axis
         k_in = cfg.rebroadcast_intake or cfg.fanout * 2
 
         # One-hot delivery is O(N·K·W) dense compute: a clear win while the
@@ -696,7 +695,12 @@ def broadcast_round(
             seg_start = jnp.concatenate(
                 [jnp.ones((n, 1), bool), w2[:, 1:] != w2[:, :-1]], axis=1
             )
-            base = take(contig, jnp.minimum(w2, w_count - 1), axis=1)
+            # MXU block gather — take_along_axis at [N, K]←[N, 10k] lowers
+            # as a serialized per-element gather (~17 ms + a 40 ms staging
+            # copy at the flagship shapes).
+            base = onehot.rowgather_wide(
+                contig, jnp.minimum(w2, w_count - 1)
+            )
             prev_v = jnp.concatenate(
                 [jnp.zeros((n, 1), v2.dtype), v2[:, :-1]], axis=1
             )
@@ -761,7 +765,7 @@ def broadcast_round(
                         d_m,
                         valid2 & ~prev_same,
                         wk,
-                        lambda word: take(word, w2c, axis=1),
+                        lambda word: onehot.rowgather_wide(word, w2c),
                         lambda contrib: (
                             jnp.zeros((n * w_count,), jnp.uint32)
                             .at[rw2.reshape(-1)]
@@ -1200,17 +1204,10 @@ def _sync_rows(
                     "reb,rbj->rej", onehot_b,
                     cum_p.reshape(-1, nb, blk).astype(jnp.float32),
                 ).astype(jnp.int32)  # [R, B, 128]
-                c0_t = c0_p.reshape(-1, nb, blk)
-                blk_c0 = (
-                    dotp(
-                        "reb,rbj->rej", onehot_b,
-                        (c0_t >> 16).astype(jnp.float32),
-                    ).astype(jnp.uint32)
-                    << 16
-                ) | dotp(
-                    "reb,rbj->rej", onehot_b,
-                    (c0_t & jnp.uint32(0xFFFF)).astype(jnp.float32),
-                ).astype(jnp.uint32)
+                # Shared exact-u32 block gather (u16 halves on the MXU).
+                blk_c0 = onehot.block_matmul_gather_u32(
+                    c0_p.reshape(-1, nb, blk), onehot_b
+                )
                 within = jnp.sum(
                     blk_cum <= e[None, :, None], axis=2, dtype=jnp.int32
                 )
