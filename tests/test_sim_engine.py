@@ -152,3 +152,41 @@ def test_checkpoint_resume_bit_identical(tmp_path):
         checkpoint.load_state(
             str(tmp_path / "ckpt.npz"), bad, len(sched.sample_writer)
         )
+
+
+def test_checkpoint_resume_with_live_window_bits(tmp_path):
+    """Checkpoint taken while the out-of-order window holds bits (lossy
+    run, mid-heal): resume must be bit-identical — the oo words and flag
+    are replication state, not scratch."""
+    import dataclasses
+
+    import jax
+
+    from corrosion_tpu.sim import checkpoint
+
+    cfg, topo, sched = models.merge_10k(n=128, rounds=48, samples=16)
+    cfg = dataclasses.replace(
+        cfg, gossip=dataclasses.replace(cfg.gossip, loss_prob=0.35)
+    )
+    full, _ = simulate(cfg, topo, sched, seed=4)
+
+    first = Schedule(
+        writes=sched.writes[:17], sample_writer=sched.sample_writer,
+        sample_ver=sched.sample_ver, sample_round=sched.sample_round,
+    )
+    second = Schedule(
+        writes=sched.writes[17:], sample_writer=sched.sample_writer,
+        sample_ver=sched.sample_ver, sample_round=sched.sample_round,
+    )
+    mid, _ = simulate(cfg, topo, first, seed=4)
+    assert np.asarray(mid.data.oo).sum() > 0, (
+        "checkpoint must be taken with live window bits (tune loss/cut "
+        "if this ever goes quiet)"
+    )
+    checkpoint.save_state(str(tmp_path / "w.npz"), mid)
+    restored = checkpoint.load_state(
+        str(tmp_path / "w.npz"), cfg, len(sched.sample_writer)
+    )
+    resumed, _ = simulate(cfg, topo, second, seed=4, state=restored)
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
