@@ -129,6 +129,43 @@ def test_prepared_param_casts_and_many_casts():
     assert out.count("CAST(") == 80
 
 
+def test_tokenize_render_roundtrip_property():
+    """The lexer is lossless: render(tokenize(s)) == s for ANY input —
+    SQL-shaped or adversarial garbage (unterminated strings, stray
+    dollar signs, partial comments). Translation safety rests on this."""
+    import random
+    import string
+
+    rng = random.Random(7)
+    pieces = [
+        "select", "'a''b'", '"Q q"', "$$x;y$$", "$1", "--c\n", "/*x*/",
+        "/* nested /* deep */ out */", "::", ";", " ", "\t\n", "1.5e3",
+        "1.5e", r"E'\n'", "e'unterminated", "$tag$z$tag$", "$bad$never",
+        "(", ")", ",", "ident_x", "'unterminated", '"open', "$", ".", "?3",
+    ]
+    for _ in range(300):
+        s = "".join(
+            rng.choice(pieces) for _ in range(rng.randint(0, 10))
+        )
+        assert pgsql.render(pgsql.tokenize(s)) == s, repr(s)
+    for _ in range(300):
+        s = "".join(
+            rng.choice(string.printable) for _ in range(rng.randint(0, 40))
+        )
+        assert pgsql.render(pgsql.tokenize(s)) == s, repr(s)
+
+
+def test_normalize_sql_idempotent():
+    from corrosion_tpu.agent.subs import normalize_sql
+
+    for s in (
+        "SELECT  id FROM Tests -- c\n WHERE x = 'A';",
+        "select 1", "", "  ;;  ",
+    ):
+        once = normalize_sql(s)
+        assert normalize_sql(once) == once
+
+
 def test_placeholders_and_catalog():
     assert pgsql.translate_placeholders("SELECT $1, '$2'") == (
         "SELECT ?1, '$2'"
