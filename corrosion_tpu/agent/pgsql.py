@@ -214,6 +214,7 @@ PG_TYPE_MAP = {
     "float4": "REAL", "float8": "REAL", "real": "REAL",
     "numeric": "REAL", "decimal": "REAL", "double": "REAL",
     "text": "TEXT", "varchar": "TEXT", "char": "TEXT", "bpchar": "TEXT",
+    "character": "TEXT",
     "name": "TEXT", "uuid": "TEXT", "json": "TEXT", "jsonb": "TEXT",
     "regclass": "TEXT", "regtype": "TEXT",
     "bytea": "BLOB",
@@ -344,6 +345,31 @@ def _value_span(toks: list[Tok], end: int) -> int:
         ):
             return p
         return j
+    if t.text == "]":
+        # Bracketed run: ARRAY[...] literal or a subscripted value x[i] —
+        # include the matching '[' and the value it subscripts (a bare ']'
+        # treated as a one-token value mangled ARRAY casts).
+        depth = 0
+        j = end
+        while j >= 0:
+            if toks[j].text == "]":
+                depth += 1
+            elif toks[j].text == "[":
+                depth -= 1
+                if depth == 0:
+                    break
+            j -= 1
+        if j < 0:
+            return end
+        p = _sig(toks, j, -1)
+        if p >= 0 and (
+            toks[p].text in (")", "]") or toks[p].kind in _VALUE_KINDS
+        ) and not (
+            toks[p].kind in ("ident", "qident")
+            and toks[p].text.lower() in _RESERVED
+        ):
+            return _value_span(toks, p)
+        return j
     if t.kind in _VALUE_KINDS:
         start = end
         while True:
@@ -378,16 +404,61 @@ def _pass_casts(toks: list[Tok]) -> list[Tok]:
             continue
         type_end = nxt
         typ = toks[nxt].text.lower()
-        # Optional length suffix: varchar(32).
+        # Multi-word type names: consume the suffix so it can never dangle
+        # after the rewrite (x::double precision must not leave a bare
+        # "precision" behind).
         j = _sig(toks, nxt, 1)
+        if j >= 0 and toks[j].kind == "ident":
+            suf = toks[j].text.lower()
+            if typ == "double" and suf == "precision":
+                type_end = j
+            elif suf == "varying" and typ in ("character", "bit"):
+                type_end = j
+                typ = "varchar" if typ == "character" else "bit varying"
+        # Optional length suffix: varchar(32), timestamp(3).
+        j = _sig(toks, type_end, 1)
         if j >= 0 and toks[j].text == "(":
             k = _sig(toks, j, 1)
             m = _sig(toks, k, 1) if k >= 0 else -1
             if k >= 0 and toks[k].kind == "num" and m >= 0 and toks[m].text == ")":
                 type_end = m
+        # with/without time zone AFTER any length paren ("timestamp(3)
+        # with time zone" is the common PG spelling).
+        j = _sig(toks, type_end, 1)
+        if (
+            j >= 0 and toks[j].kind == "ident"
+            and toks[j].text.lower() in ("with", "without")
+            and typ in ("timestamp", "time")
+        ):
+            k = _sig(toks, j, 1)
+            m = _sig(toks, k, 1) if k >= 0 else -1
+            if (
+                k >= 0 and toks[k].text.lower() == "time"
+                and m >= 0 and toks[m].text.lower() == "zone"
+            ):
+                type_end = m
+        # Array type suffix: type[] / type[n] / type[2][3] has no SQLite
+        # affinity — consume ALL bracket groups and drop the cast (keep
+        # the value).
+        is_array_type = False
+        while True:
+            j = _sig(toks, type_end, 1)
+            if j < 0 or toks[j].text != "[":
+                break
+            k = _sig(toks, j, 1)
+            if k >= 0 and toks[k].text == "]":
+                type_end, is_array_type = k, True
+            elif k >= 0 and toks[k].kind == "num":
+                m = _sig(toks, k, 1)
+                if m >= 0 and toks[m].text == "]":
+                    type_end, is_array_type = m, True
+                else:
+                    break
+            else:
+                break
         start = _value_span(toks, prev)
         value = toks[start:prev + 1]
-        target = PG_TYPE_MAP.get(typ)
+        target = None if is_array_type else PG_TYPE_MAP.get(typ)
         if target is None:
             repl = value
         else:

@@ -321,6 +321,14 @@ class MatcherHandle:
         if self._flush_handle is not None:
             self._flush_handle.cancel()
             self._flush_handle = None
+        if self._dirty:
+            # A deferred re-snapshot must not die with the handle: the last
+            # change batch before shutdown would stay unreported (and the
+            # durable log would replay stale rows after restore).
+            try:
+                self.process(None)
+            except Exception:
+                pass
         if self._db is not None:
             try:
                 self._db.close()

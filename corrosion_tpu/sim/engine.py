@@ -149,11 +149,7 @@ def cluster_round(
         data, rstats = gossip_ops.revive_sync(
             data, topo, alive, partition, revive, k_rejoin, cfg.gossip
         )
-        sstats = {
-            "applied_sync": sstats["applied_sync"] + rstats["applied_sync"],
-            "sessions": sstats["sessions"] + rstats["sessions"],
-            "cell_merges": sstats["cell_merges"] + rstats["cell_merges"],
-        }
+        sstats = {k: sstats[k] + rstats[k] for k in sstats}
 
     # Visibility tracking for sampled writes that have been committed.
     active = state.round >= sample_round  # [S]
@@ -172,6 +168,8 @@ def cluster_round(
         "msgs": bstats["msgs"],
         "sessions": sstats["sessions"],
         "cell_merges": bstats["cell_merges"] + sstats["cell_merges"],
+        "window_degraded": bstats["window_degraded"],
+        "sync_regrant": sstats["sync_regrant"],
     }
     return (
         ClusterState(

@@ -437,3 +437,60 @@ def test_config_validates_window():
     with pytest.raises(ValueError):
         gossip.GossipConfig(n_nodes=4, n_writers=1, window_k=31)
     gossip.GossipConfig(n_nodes=4, n_writers=1, window_k=64)
+
+
+# -- window saturation instrumentation ----------------------------------------
+#
+# VERDICT r4 weak #4: a long outage accumulates far more versions than the
+# window holds; affected nodes degrade to seen-only pessimism. The
+# `window_degraded` counter makes that visible; `sync_regrant` measures the
+# budget spent re-granting window-possessed versions (ADVICE r4 #2).
+
+
+def _partition_cluster(rounds=40, cut=20, n=8):
+    from corrosion_tpu.ops.swim import SwimConfig
+    from corrosion_tpu.sim.engine import ClusterConfig, Schedule
+
+    g = gossip.GossipConfig(
+        n_nodes=n, n_writers=1, sync_interval=4, sync_budget=64,
+        sync_chunk=64, window_k=32, queue=8, fanout_near=2, fanout_far=1,
+        max_transmissions=4,
+    )
+    s = SwimConfig(
+        n_nodes=n, max_transmissions=4, suspect_rounds=3, gossip_fanout=3
+    )
+    topo = gossip.make_topology(
+        [n // 2, n - n // 2], [0], sync_interval=g.sync_interval
+    )
+    writes = np.zeros((rounds, 1), np.uint32)
+    writes[: cut + 4, 0] = 4  # ~96 versions: far beyond the 32-bit window
+    part = None
+    if cut:
+        part = np.zeros((rounds, 2, 2), bool)
+        part[:cut, 0, 1] = True
+        part[:cut, 1, 0] = True
+    sched = Schedule(writes=writes, partition=part).make_samples(32)
+    return ClusterConfig(swim=s, gossip=g), topo, sched
+
+
+def test_degraded_counter_fires_after_partition_heal():
+    from corrosion_tpu.sim.engine import simulate
+
+    cfg, topo, sched = _partition_cluster(rounds=48, cut=20)
+    final, curves = simulate(cfg, topo, sched, seed=0)
+    # Post-heal, region-1 nodes see arrivals ~90 versions beyond their
+    # watermark — far past window_k=32 — and must degrade.
+    assert int(curves["window_degraded"][20:].sum()) > 0
+    # The cluster still converges (sync heals the degraded tail).
+    heads = np.asarray(final.data.head)
+    assert (np.asarray(final.data.contig) == heads[None, :]).all()
+
+
+def test_degraded_counter_zero_in_steady_state():
+    from corrosion_tpu.sim.engine import simulate
+
+    cfg, topo, sched = _partition_cluster(rounds=48, cut=0)
+    final, curves = simulate(cfg, topo, sched, seed=0)
+    assert int(curves["window_degraded"].sum()) == 0
+    heads = np.asarray(final.data.head)
+    assert (np.asarray(final.data.contig) == heads[None, :]).all()

@@ -47,6 +47,51 @@ def test_nested_casts_compose():
     )
 
 
+def test_multiword_type_casts():
+    # Multi-word type names must be consumed whole — the second word used
+    # to dangle after the rewrite (x::double precision ->
+    # "CAST(x AS REAL) precision").
+    assert pgsql.translate("SELECT x::double precision FROM t") == (
+        "SELECT CAST(x AS REAL) FROM t"
+    )
+    assert pgsql.translate("SELECT x::character varying(12) FROM t") == (
+        "SELECT CAST(x AS TEXT) FROM t"
+    )
+    # timestamp has no SQLite affinity: cast dropped, value kept, and the
+    # with/without time zone suffix consumed (not left dangling).
+    assert pgsql.translate(
+        "SELECT x::timestamp with time zone FROM t"
+    ) == "SELECT x FROM t"
+    assert pgsql.translate(
+        "SELECT x::time without time zone, y FROM t"
+    ) == "SELECT x, y FROM t"
+    # bit varying: unknown type, suffix still consumed.
+    assert pgsql.translate("SELECT x::bit varying FROM t") == (
+        "SELECT x FROM t"
+    )
+
+
+def test_array_casts():
+    # A ']'-terminated value is the whole bracketed run plus what it
+    # subscripts, not a one-token ']'.
+    assert pgsql.translate("SELECT ARRAY[1,2]::text") == (
+        "SELECT CAST(ARRAY[1,2] AS TEXT)"
+    )
+    assert pgsql.translate("SELECT a.b[1]::int8 FROM t") == (
+        "SELECT CAST(a.b[1] AS INTEGER) FROM t"
+    )
+    assert pgsql.translate("SELECT f(x)[2]::text") == (
+        "SELECT CAST(f(x)[2] AS TEXT)"
+    )
+    # Array TYPES have no SQLite affinity: brackets consumed, cast
+    # dropped, value kept.
+    assert pgsql.translate("SELECT x::text[] FROM t") == "SELECT x FROM t"
+    assert pgsql.translate("SELECT x::int[3] FROM t") == "SELECT x FROM t"
+    assert pgsql.translate("SELECT ARRAY[1,2]::int[] FROM t") == (
+        "SELECT ARRAY[1,2] FROM t"
+    )
+
+
 def _norm(s):
     return " ".join(s.split())
 

@@ -104,7 +104,8 @@ def test_metrics_curves_shape():
     cfg, topo, sched = models.three_node(n_inserts=48, samples=16)
     final, curves = simulate(cfg, topo, sched)
     for k in ("mismatches", "need", "applied_broadcast", "applied_sync",
-              "msgs", "sessions", "cell_merges"):
+              "msgs", "sessions", "cell_merges", "window_degraded",
+              "sync_regrant"):
         assert curves[k].shape == (sched.rounds,), k
 
 
