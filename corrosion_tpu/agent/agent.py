@@ -191,6 +191,14 @@ class Agent:
         self.metrics = MetricsRegistry()
         # Aggregate transport metrics (Transport::emit_metrics parity).
         self.transport.bind_metrics(self.metrics)
+        _added = self.metrics.counter(
+            "corro_gossip_member_added", "members learned (first sighting)"
+        )
+        _removed = self.metrics.counter(
+            "corro_gossip_member_removed", "members forgotten (down GC)"
+        )
+        self.members.on_added = lambda _aid: _added.inc()
+        self.members.on_removed = lambda _aid: _removed.inc()
         self.tracer = Tracer(
             service=f"corrosion-{self.actor_id[:8]}",
             export_path=cfg.trace_export_path or None,
@@ -230,6 +238,32 @@ class Agent:
         self._m_cleared = self.metrics.counter(
             "corro_versions_cleared",
             "versions compacted to Cleared (clear_overwritten_versions)",
+        )
+        self._m_bcast_recv = self.metrics.counter(
+            "corro_broadcast_recv_count",
+            "broadcast changeset frames received",
+        )
+        self._m_committed = self.metrics.counter(
+            "corro_changes_committed",
+            "local write transactions committed",
+        )
+        # Sync-plane series pre-registered so an idle agent still exposes
+        # them at 0 (doc/telemetry/prometheus.md parity).
+        self._m_sync_sent = self.metrics.counter(
+            "corro_sync_changes_sent", "changes served through sync"
+        )
+        self._m_sync_sent_bytes = self.metrics.counter(
+            "corro_sync_chunk_sent_bytes",
+            "wire bytes of sync change chunks served",
+        )
+        self.metrics.counter(
+            "corro_sync_attempts_count", "sync sessions attempted"
+        )
+        self.metrics.counter(
+            "corro_sync_client_member", "sync sessions established, by peer"
+        )
+        self.metrics.counter(
+            "corro_sync_changes_recv", "changes received through sync"
         )
         self._ingest: asyncio.Queue = asyncio.Queue(maxsize=4096)
         self._addr_of: dict[str, tuple[str, int]] = {}
@@ -276,6 +310,7 @@ class Agent:
         from corrosion_tpu.agent.pool import SplitPool
 
         self.pool = SplitPool(self.store)
+        self.pool.metrics = self.metrics
         self.pool.start()
         self.gossip_addr = await self.transport.serve(
             self.cfg.gossip_host, self.cfg.gossip_port, self._on_gossip
@@ -346,6 +381,22 @@ class Agent:
             self._prom_server, self.prometheus_addr = await serve_prometheus(
                 self.metrics, host, port
             )
+        # Static config/build series (doc/telemetry/prometheus.md).
+        self.metrics.gauge(
+            "corro_build_info", "build identity"
+        ).set(1, version="corrosion-tpu")
+        self.metrics.gauge(
+            "corro_gossip_config_max_transmissions",
+            "configured broadcast retransmission budget",
+        ).set(self.cfg.max_transmissions)
+        self.metrics.gauge(
+            "corro_gossip_config_num_indirect_probes",
+            "configured indirect probe count",
+        ).set(self.swim.indirect_probes)
+        self.metrics.gauge(
+            "corro_broadcast_buffer_capacity",
+            "pending-broadcast buffer byte budget",
+        ).set(self.cfg.broadcast_buffer_bytes)
         for addr in self.cfg.bootstrap:
             await self.swim.announce(tuple(addr))
         if self.cfg.bootstrap_raw:
@@ -467,6 +518,7 @@ class Agent:
             booked.insert(
                 version, Current(db_version=dbv, last_seq=last_seq, ts=ts)
             )
+            self._m_committed.inc()
             if self.on_local_write is not None:
                 # Trace hook: real write traffic recorded for kernel replay
                 # (sim/trace.py; SURVEY §7 step 7's dispatch-seam bridge).
@@ -634,6 +686,7 @@ class Agent:
                 self._addr_of[frm] = tuple(msg["from_addr"])
             await self.swim.on_message(msg)
         elif kind == "bcast":
+            self._m_bcast_recv.inc()
             try:
                 self._ingest.put_nowait((msg, "broadcast"))
             except asyncio.QueueFull:
@@ -1047,9 +1100,31 @@ class Agent:
         queue_g = self.metrics.gauge(
             "corro_sqlite_write_queue", "queued writer jobs per priority"
         )
+        cluster_g = self.metrics.gauge(
+            "corro_gossip_cluster_size", "known live members incl. self"
+        )
+        backlog_g = self.metrics.gauge(
+            "corro_gossip_updates_backlog", "membership rumors awaiting send"
+        )
+        buffered_g = self.metrics.gauge(
+            "corro_db_buffered_changes_rows_total",
+            "rows in __corro_buffered_changes (partial versions)",
+        )
+        read_conns_g = self.metrics.gauge(
+            "corro_sqlite_pool_read_connections", "read pool size"
+        )
+        read_idle_g = self.metrics.gauge(
+            "corro_sqlite_pool_read_connections_idle", "idle read conns"
+        )
+        write_conns_g = self.metrics.gauge(
+            "corro_sqlite_pool_write_connections", "writer connections"
+        )
         interval = self.cfg.metrics_interval
         while not self.tripwire.tripped:
             await asyncio.sleep(interval)
+            cluster_g.set(len(self.members.alive()) + 1)
+            if self.swim is not None:
+                backlog_g.set(len(self.swim.rumors))
             if self.pool is None:
                 continue  # pool-less agent: nothing to sample
             try:
@@ -1065,8 +1140,17 @@ class Agent:
                     Statement("SELECT count(*) FROM __crdt_changes")
                 )
                 log_g.set(rows[0][0])
+                _, rows = await self.pool.query(
+                    Statement(
+                        "SELECT count(*) FROM __corro_buffered_changes"
+                    )
+                )
+                buffered_g.set(rows[0][0])
                 for label, depth in self.pool.queue_depths().items():
                     queue_g.set(depth, priority=label)
+                read_conns_g.set(self.pool._n_read)
+                read_idle_g.set(len(self.pool._read_pool))
+                write_conns_g.set(1)  # single-writer discipline
             except Exception:
                 # Keep sampling; stale gauges with no signal would hide
                 # the failure entirely.
@@ -1337,6 +1421,25 @@ class Agent:
         sess_hist = self.metrics.histogram(
             "corro_sync_client_seconds", "client-side sync session duration"
         )
+        attempts_ctr = self.metrics.counter(
+            "corro_sync_attempts_count", "sync sessions attempted"
+        )
+        member_ctr = self.metrics.counter(
+            "corro_sync_client_member", "sync sessions established, by peer"
+        )
+        head_gauge = self.metrics.gauge(
+            "corro_sync_client_head",
+            "peer-advertised head per actor at session start",
+        )
+        need_hist = self.metrics.histogram(
+            "corro_sync_client_request_operations_need_count",
+            "need blocks per sync request wave",
+            buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000),
+        )
+        recv_ctr = self.metrics.counter(
+            "corro_sync_changes_recv", "changes received through sync"
+        )
+        attempts_ctr.inc()
         # Cross-node trace propagation: the session span's traceparent
         # travels in the wire protocol (SyncTraceContextV1, sync.rs:32-67
         # injected peer.rs:941-944).
@@ -1357,8 +1460,20 @@ class Agent:
             reply = await session.recv(timeout=5.0)
             if not reply or reply.get("t") != "sync_state":
                 return
+            member_ctr.inc(peer=m.actor_id[:8])
             self.hlc.update_with_timestamp(reply.get("clock", 0))
             server_state = _state_from_wire(reply["state"])
+            # The peer's OWN advertised head only, and a hard series cap:
+            # one gauge series per actor in heads would grow with cluster
+            # size and never shrink (label cardinality explosion at the
+            # 100k target; scrapes render every series).
+            peer_head = server_state.heads.get(server_state.actor_id)
+            if peer_head is not None:
+                lbl = (("actor", server_state.actor_id[:8]),)
+                if lbl in head_gauge._values or len(head_gauge._values) < 128:
+                    head_gauge.set(
+                        peer_head, actor=server_state.actor_id[:8]
+                    )
             while not self.tripwire.tripped:
                 # Regenerate per wave: blocks ingested from concurrent
                 # sessions (and this one's earlier waves) shrink the next
@@ -1372,6 +1487,7 @@ class Agent:
                 claimed.extend(keys)
                 if not wave:
                     break
+                need_hist.observe(sum(len(v) for v in wave.values()))
                 await session.send(
                     {"t": "sync_request", "needs": _needs_to_wire(wave)}
                 )
@@ -1385,6 +1501,7 @@ class Agent:
                     if t == "sync_wave_done":
                         break
                     if t == "sync_changes":
+                        recv_ctr.inc(len(frame.get("changes", ())))
                         inner = dict(frame)
                         inner["t"] = "bcast"
                         try:
@@ -1474,11 +1591,14 @@ class Agent:
     async def _timed_send(self, session, frame, chunker) -> None:
         """Send with the stall abort + chunk-size feedback loop."""
         t0 = time.monotonic()
-        await asyncio.wait_for(
+        nbytes = await asyncio.wait_for(
             session.send(frame), self.cfg.sync_stall_timeout
         )
         if chunker is not None:
             chunker.record(time.monotonic() - t0)
+        if frame.get("t") == "sync_changes":
+            self._m_sync_sent_bytes.inc(nbytes or 0)
+            self._m_sync_sent.inc(len(frame.get("changes", ())))
 
     async def _serve_need(
         self, session, actor, booked, need, chunker=None, budget=None

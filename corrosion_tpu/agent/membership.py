@@ -56,6 +56,10 @@ class Members:
     def __init__(self, self_id: str) -> None:
         self.self_id = self_id
         self.states: dict[str, MemberState] = {}
+        # Optional event hooks (the agent wires these to the
+        # corro_gossip_member_added/_removed counters).
+        self.on_added = None
+        self.on_removed = None
 
     def alive(self) -> list[MemberState]:
         return [m for m in self.states.values() if m.state != DOWN]
@@ -82,6 +86,8 @@ class Members:
             self.states[actor_id] = MemberState(
                 actor_id=actor_id, addr=addr, state=state, incarnation=inc
             )
+            if self.on_added is not None:
+                self.on_added(actor_id)
             return True
         # foca precedence: higher incarnation wins; same incarnation,
         # down > suspect > alive.
@@ -111,6 +117,8 @@ class Members:
         ]
         for aid in gone:
             del self.states[aid]
+            if self.on_removed is not None:
+                self.on_removed(aid)
         return gone
 
 

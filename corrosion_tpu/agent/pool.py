@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import sqlite3
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -44,6 +45,9 @@ class SplitPool:
 
     def __init__(self, store: Store, read_conns: int = 20) -> None:
         self.store = store
+        self.metrics = None  # optional MetricsRegistry (agent wires it)
+        self._exec_hist = None  # resolved lazily from the registry
+        self._queue_hist = None
         self._queues = {
             p: asyncio.Queue(maxsize=d) for p, d in QUEUE_DEPTHS.items()
         }
@@ -108,13 +112,29 @@ class SplitPool:
             raise RuntimeError("pool closed")
         loop = asyncio.get_running_loop()
         job = _Job(fn=fn, future=loop.create_future())
+        t0 = time.perf_counter()
         await self._queues[priority].put(job)  # bounded: backpressure
         if self._closed and not job.future.done():
             # close() drained the queues while we were blocked in put():
             # nothing will ever run this job — fail it, don't hang.
             job.future.set_exception(RuntimeError("pool closed"))
         self._kick.set()
-        return await job.future
+        try:
+            return await job.future
+        finally:
+            if self.metrics is not None:
+                # Queue-to-done wall time (the reference splits queue vs
+                # execution; the writer runs one job at a time, so queue
+                # wait dominates the difference). Histogram handle cached:
+                # this is the ingest hot path and a registry lookup takes
+                # the registry lock per call.
+                h = self._exec_hist
+                if h is None:
+                    h = self._exec_hist = self.metrics.histogram(
+                        "corro_sqlite_pool_execution_seconds",
+                        "writer job wall time incl. queue wait",
+                    )
+                h.observe(time.perf_counter() - t0)
 
     async def write_priority(self, fn: Callable[[], Any]) -> Any:
         return await self.write(fn, HIGH)
@@ -169,7 +189,16 @@ class SplitPool:
 
     async def query(self, stmt: Statement) -> tuple[list[str], list[tuple]]:
         """Pooled snapshot read (the 20-conn read pool role)."""
+        t0 = time.perf_counter()
         async with self._read_sem:
+            if self.metrics is not None:
+                h = self._queue_hist
+                if h is None:
+                    h = self._queue_hist = self.metrics.histogram(
+                        "corro_sqlite_pool_queue_seconds",
+                        "wait for a read-pool slot",
+                    )
+                h.observe(time.perf_counter() - t0)
             return await asyncio.to_thread(self._query_sync, stmt)
 
     def _query_sync(self, stmt: Statement) -> tuple[list[str], list[tuple]]:

@@ -539,12 +539,13 @@ class Session:
         self.writer = writer
         self._count = counter or (lambda key, n=1: None)
 
-    async def send(self, msg: dict) -> None:
+    async def send(self, msg: dict) -> int:
         frame = encode_frame(msg)
         self.writer.write(frame)
         await self.writer.drain()
         self._count("frames_sent")
         self._count("bytes_sent", len(frame))
+        return len(frame)
 
     async def recv(self, timeout: float = 30.0) -> dict | None:
         try:

@@ -10,11 +10,14 @@ the driver-set scenarios (BASELINE.md "Targets"):
    on the seq-chunk plane, ops/chunks.py.)
 4. `merge_10k`    — 10k-node concurrent-writer CRDT merge.
 5. `wan_100k`     — 100k-node partitioned WAN topology (region-aware fanout).
+   (`anywrite_sparse` — 5s: any-node-writes at 100k over the rotating-slot
+   sparse writer plane, ops/sparse_writers.py.)
 """
 
 from corrosion_tpu.models.baselines import (  # noqa: F401
     anti_entropy_1k,
     anti_entropy_chunks,
+    anywrite_sparse,
     churn_32,
     merge_10k,
     three_node,

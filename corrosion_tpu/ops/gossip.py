@@ -96,6 +96,11 @@ class GossipConfig:
     # in-any-order + gap-range bookkeeping (agent.rs:1809-2060) within a
     # bounded tensor; see the module docstring.
     window_k: int = 32
+    # Writer columns are rotating SLOTS (ops/sparse_writers.py): queue
+    # entries carry the writer's GLOBAL id alongside the slot so CRDT cell
+    # derivation keys on identity, not slot index — slot reuse across
+    # epochs must never collide cell keys. Requires topo.writer_ids.
+    track_writer_ids: bool = False
 
     def __post_init__(self):
         if self.window_k < 0 or self.window_k % 32 != 0:
@@ -146,6 +151,11 @@ class Topology(NamedTuple):
     # phase class c, padded with -1. With cohorts the whole sync round runs
     # on cohort-sized tensors — a sync_interval× cut in per-round work.
     sync_cohorts: jax.Array | None = None
+    # Global identity per writer column (u32[W]); None = columns ARE the
+    # identity (the dense model). With rotating slots
+    # (cfg.track_writer_ids) this maps slot -> writing node id and is
+    # swapped at epoch boundaries by ops/sparse_writers.rotate.
+    writer_ids: jax.Array | None = None
 
 
 def make_topology(
@@ -226,6 +236,7 @@ class DataState(NamedTuple):
     q_writer: jax.Array  # i32[N, Q] (-1 = empty)
     q_ver: jax.Array  # u32[N, Q]
     q_tx: jax.Array  # i32[N, Q] transmissions left
+    q_gw: jax.Array  # u32[N, Q] global writer id (Q=0 unless track_writer_ids)
     cells: crdt.CellState  # u32[N * K] x3 per-node registers (K=0: disabled)
 
 
@@ -240,6 +251,7 @@ def init_data(cfg: GossipConfig) -> DataState:
         q_writer=jnp.full((n, q), -1, jnp.int32),
         q_ver=jnp.zeros((n, q), jnp.uint32),
         q_tx=jnp.zeros((n, q), jnp.int32),
+        q_gw=jnp.zeros((n, q if cfg.track_writer_ids else 0), jnp.uint32),
         cells=crdt.make_cells(n * cfg.n_cells),
     )
 
@@ -474,6 +486,16 @@ def broadcast_round(
     new_ver = head_old_n[:, None] + 1 + jnp.arange(mw, dtype=jnp.uint32)[None, :]
     new_valid = (jnp.arange(mw)[None, :] < nw[:, None]) & alive[:, None]
     new_writer = jnp.broadcast_to(topo.writer_of_node[:, None], (n, mw))
+    track = cfg.track_writer_ids
+    if track and topo.writer_ids is None:
+        raise ValueError("track_writer_ids requires topo.writer_ids")
+    # Under rotating slots a node's global writer identity IS its node id
+    # (writer_ids[slot_of_node] == node), so the writer's own enqueue
+    # needs no table lookup.
+    new_gw = (
+        jnp.broadcast_to(nodes[:, None].astype(jnp.uint32), (n, mw))
+        if track else None
+    )
 
     cells = data.cells
     n_merges = jnp.uint32(0)
@@ -481,7 +503,9 @@ def broadcast_round(
         # The writer materializes its own commit (the local-write txn path,
         # public/mod.rs:60-123).
         cells, m = _merge_versions_dense(
-            cells, None, jnp.maximum(new_writer, 0), new_ver, new_valid,
+            cells, None,
+            new_gw if track else jnp.maximum(new_writer, 0),
+            new_ver, new_valid,
             None, n, cfg,
         )
         n_merges += m
@@ -521,6 +545,7 @@ def broadcast_round(
         m_w = data.q_writer[src].reshape(n, kk)
         m_v = data.q_ver[src].reshape(n, kk)
         m_tx = data.q_tx[src].reshape(n, kk)
+        m_gw = data.q_gw[src].reshape(n, kk) if track else None
         m_ok = (
             jnp.repeat(link_ok[:, :, None], q_cap, axis=2).reshape(n, kk)
             & (m_w >= 0)
@@ -583,9 +608,16 @@ def broadcast_round(
             # versions) sort by version within the sentinel run — adjacency
             # dedup for the degraded counter needs it; for unclamped
             # entries (w, d) determines v, so ordering is unchanged.
-            skey, v2 = jax.lax.sort(
-                (pkd, m_v), dimension=1, num_keys=2, is_stable=False
-            )
+            if track:
+                skey, v2, gw2 = jax.lax.sort(
+                    (pkd, m_v, m_gw), dimension=1, num_keys=2,
+                    is_stable=False,
+                )
+            else:
+                skey, v2 = jax.lax.sort(
+                    (pkd, m_v), dimension=1, num_keys=2, is_stable=False
+                )
+                gw2 = None
             valid2 = skey < sent_key
             w2 = jnp.minimum((skey // k2).astype(jnp.int32), w_count - 1)
             d2 = (skey % k2).astype(jnp.uint32)
@@ -703,16 +735,19 @@ def broadcast_round(
                 n_degraded = jnp.sum(valid2 & ~applied, dtype=jnp.uint32)
             if cfg.n_cells > 0:
                 cells, m = _merge_versions_dense(
-                    cells, None, w2, v2, fresh, None, n, cfg
+                    cells, None, gw2 if track else w2, v2, fresh, None, n,
+                    cfg,
                 )
                 n_merges += m
 
-            in_mask, (in_w, in_v) = routing.rebuild_bounded_queue(
+            in_mask, in_payloads = routing.rebuild_bounded_queue(
                 fresh,
                 -v2.astype(jnp.int32),  # oldest versions first
-                (w2, v2),
+                (w2, v2, gw2) if track else (w2, v2),
                 k_in,
             )
+            in_w, in_v = in_payloads[0], in_payloads[1]
+            in_gw = in_payloads[2] if track else None
             in_tx = jnp.full(in_w.shape, cfg.max_transmissions, jnp.int32)
             in_w = jnp.where(in_mask, in_w, -1)
         else:
@@ -722,9 +757,17 @@ def broadcast_round(
             # (-tx orders duplicate copies highest-budget-first so the dedup
             # keeps the strongest requeue).
             wkey = jnp.where(m_ok, m_w, w_count)  # invalid → sentinel
-            w2, v2, neg_tx = jax.lax.sort(
-                (wkey, m_v, -m_tx), dimension=1, num_keys=3, is_stable=False
-            )
+            if track:
+                w2, v2, neg_tx, gw2 = jax.lax.sort(
+                    (wkey, m_v, -m_tx, m_gw), dimension=1, num_keys=3,
+                    is_stable=False,
+                )
+            else:
+                w2, v2, neg_tx = jax.lax.sort(
+                    (wkey, m_v, -m_tx), dimension=1, num_keys=3,
+                    is_stable=False,
+                )
+                gw2 = None
             tx2 = -neg_tx
             valid2 = w2 < w_count
 
@@ -840,7 +883,7 @@ def broadcast_round(
                 # plus window-possessed arrivals. Row-dense merge (the
                 # cell-key axis is always narrow).
                 cells, m = _merge_versions_dense(
-                    cells, None, w2c, v2,
+                    cells, None, gw2 if track else w2c, v2,
                     (run & valid2) | extra_poss, None, n, cfg,
                 )
                 n_merges += m
@@ -865,12 +908,14 @@ def broadcast_round(
             else:
                 intake_ok = fresh & (tx2 > 1)
                 in_budget = tx2 - 1
-            in_mask, (in_w, in_v, in_tx) = routing.rebuild_bounded_queue(
+            in_mask, in_payloads = routing.rebuild_bounded_queue(
                 intake_ok,
                 -v2.astype(jnp.int32),  # oldest versions first, like the queue
-                (w2c, v2, in_budget),
+                (w2c, v2, in_budget, gw2) if track else (w2c, v2, in_budget),
                 k_in,
             )
+            in_w, in_v, in_tx = in_payloads[:3]
+            in_gw = in_payloads[3] if track else None
             in_w = jnp.where(in_mask, in_w, -1)
         # A source's budgets burn when at least one receiver pulled it.
         pulled = (
@@ -886,6 +931,7 @@ def broadcast_round(
         in_w = jnp.zeros((n, 0), jnp.int32)
         in_v = jnp.zeros((n, 0), jnp.uint32)
         in_tx = jnp.zeros((n, 0), jnp.int32)
+        in_gw = jnp.zeros((n, 0), jnp.uint32) if track else None
         sent_any = jnp.zeros((n,), dtype=bool)
         oo_new, oo_any_new = data.oo, data.oo_any
         n_degraded = jnp.uint32(0)
@@ -923,9 +969,16 @@ def broadcast_round(
         prio = cand_tx
     else:
         prio = -cand_v.astype(jnp.int32)
-    keep, (q_writer, q_ver, q_tx) = routing.rebuild_bounded_queue(
-        cand_ok, prio, (cand_w, cand_v, cand_tx), q_cap
-    )
+    if track:
+        cand_gw = jnp.concatenate([data.q_gw, new_gw, in_gw], axis=1)
+        keep, (q_writer, q_ver, q_tx, q_gw) = routing.rebuild_bounded_queue(
+            cand_ok, prio, (cand_w, cand_v, cand_tx, cand_gw), q_cap
+        )
+    else:
+        keep, (q_writer, q_ver, q_tx) = routing.rebuild_bounded_queue(
+            cand_ok, prio, (cand_w, cand_v, cand_tx), q_cap
+        )
+        q_gw = data.q_gw
     q_writer = jnp.where(keep, q_writer, -1)
 
     stats = {
@@ -950,6 +1003,7 @@ def broadcast_round(
             q_writer=q_writer,
             q_ver=q_ver,
             q_tx=q_tx,
+            q_gw=q_gw,
             cells=cells,
         ),
         stats,
@@ -1319,10 +1373,16 @@ def _sync_rows(
                     + (e[None, :] - prev).astype(jnp.uint32)
                 )
             mask = e[None, :] < total_g[:, None]  # [R, B]
+            if cfg.track_writer_ids:
+                # Slot -> global id via the shared-table one-hot gather
+                # (a flat [R, B] fancy-index gather serializes on TPU).
+                w_merge = onehot.table_gather_u32(topo.writer_ids, w_idx)
+            else:
+                w_merge = w_idx
             # Row-dense merge (cohort rows only): gathers the cohort's cell
             # rows, runs the one-hot merge passes, scatters rows back.
             return _merge_versions_dense(
-                cells, rows, w_idx, ver, mask, row_ok, cfg.n_nodes, cfg
+                cells, rows, w_merge, ver, mask, row_ok, cfg.n_nodes, cfg
             )
 
         cells, n_merges = jax.lax.cond(

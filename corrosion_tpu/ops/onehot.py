@@ -164,15 +164,27 @@ def rowgather_wide(table: jax.Array, idx: jax.Array, blk: int = 128) -> jax.Arra
     return jnp.max(jnp.where(hit, word, 0), axis=2)
 
 
+def exact_u32_apply(dot, t: jax.Array) -> jax.Array:
+    """Apply a one-hot f32 contraction ``dot`` (f32 array -> f32 array,
+    at most one nonzero selector per output element) to a u32 array
+    EXACTLY: the value travels as u16 halves (< 2^24, f32-exact at
+    HIGHEST precision) and recombines by shift-OR. The exactness-critical
+    idiom lives ONLY here — every one-hot-matmul gather/scatter of u32
+    data routes through it."""
+    t = t.astype(jnp.uint32)
+    return (
+        dot((t >> 16).astype(jnp.float32)).astype(jnp.uint32) << 16
+    ) | dot((t & jnp.uint32(0xFFFF)).astype(jnp.float32)).astype(
+        jnp.uint32
+    )
+
+
 def block_matmul_gather_u32(
     tab: jax.Array,  # u32[R, NB, blk] block-reshaped table
     onehot_b: jax.Array,  # f32[R, M, NB] one-hot block selector
 ) -> jax.Array:
-    """Select each row's chosen 128-wide block with one-hot f32 matmuls on
-    the MXU, exactly for ALL of u32: the value travels as u16 halves
-    (< 2^24, f32-exact at HIGHEST precision) and recombines by shift-OR.
-    The exactness-critical idiom lives ONLY here — callers that already
-    hold a block one-hot (e.g. the sync grant enumeration) reuse it."""
+    """Select each row's chosen 128-wide block with one-hot f32 matmuls
+    on the MXU (exact_u32_apply carries the u16-halves exactness)."""
 
     def dot(x):
         return jnp.einsum(
@@ -180,11 +192,42 @@ def block_matmul_gather_u32(
             precision=jax.lax.Precision.HIGHEST,
         )
 
-    return (
-        dot((tab >> 16).astype(jnp.float32)).astype(jnp.uint32) << 16
-    ) | dot((tab & jnp.uint32(0xFFFF)).astype(jnp.float32)).astype(
-        jnp.uint32
-    )
+    return exact_u32_apply(dot, tab)
+
+
+def table_gather_u32(
+    table: jax.Array,  # u32[W] SHARED 1-D table (same for every row)
+    idx: jax.Array,  # i32[...] indices in [0, W)
+    blk: int = 128,
+) -> jax.Array:
+    """out[...] = table[idx[...]] without a serialized per-element gather:
+    one-hot f32 matmuls select each index's 128-wide block (u16 halves keep
+    all of u32 exact), then a compare+reduce picks within the block. Unlike
+    rowgather_wide the table is NOT per-row, so the block matmul contracts
+    a [..., NB] one-hot against the shared [NB, blk] table — no broadcast
+    materialization."""
+    w = table.shape[0]
+    nb = -(-w // blk)
+    wp = nb * blk
+    tp = table.astype(jnp.uint32)
+    if wp != w:
+        tp = jnp.pad(tp, (0, wp - w))
+    tp = tp.reshape(nb, blk)
+    idx = idx.astype(jnp.int32)
+    b_idx = jnp.minimum(idx // blk, nb - 1)
+    onehot_b = (
+        b_idx[..., None] == jnp.arange(nb)[None, :]
+    ).astype(jnp.float32)
+
+    def dot(x):
+        return jnp.einsum(
+            "...b,bj->...j", onehot_b, x,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    word = exact_u32_apply(dot, tp)
+    hit = (idx % blk)[..., None] == jnp.arange(blk)[None, :]
+    return jnp.max(jnp.where(hit, word, 0), axis=-1)
 
 
 # -- rowsum -------------------------------------------------------------------

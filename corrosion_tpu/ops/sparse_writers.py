@@ -1,0 +1,515 @@
+"""Sparse writer axis: rotating hot slots + per-node deviation tables.
+
+Any of N nodes may write, but the dense ``[N, W]`` version-vector tensors
+of ops/gossip.py make writer columns a scarce resource: at 100k nodes a
+dense any-node-writes plane would need 40 GB for one u32 table. The
+reference keeps per-actor bookkeeping in hash maps, naturally sparse
+(corro-types/src/agent.rs:945-1052), and writes originate anywhere
+(doc/crdts.md:25-28). The TPU-shaped equivalent exploits TEMPORAL
+sparsity: at any moment only writers with *recent* activity have
+cluster-visible lag; a quiescent writer's stream is fully replicated
+everywhere, so its row of every node's version vector compresses to "==
+head".
+
+Design:
+
+- ``w_hot`` rotating SLOTS carry the dense plane for currently-active
+  writers. Every gossip kernel runs unchanged over the slot axis; queue
+  entries additionally carry the writer's GLOBAL id
+  (GossipConfig.track_writer_ids) so CRDT cell derivation keys on
+  identity and slot reuse across epochs can never collide cell keys.
+- COLD writers (demoted slots) satisfy the invariant "every node holds
+  versions 1..head_full[w]" EXCEPT where a bounded per-node deviation
+  table records (writer, contig) lag.
+- Demotion is gated, two ways:
+  * zero-lag: a quiescent slot whose stream every node has fully applied
+    demotes for free (no deviation entries anywhere) — the common case;
+  * forced: under slot pressure a quiescent slot may demote while
+    laggards remain, inserting deviation entries — but only while every
+    node's table has headroom (``demote_report`` proves it first).
+    A deviation entry is NEVER silently dropped: dropping one would
+    over-claim possession (the node would assert versions it does not
+    hold). The failure mode under extreme pressure is backpressure on
+    slot turnover — never forgotten lag.
+- ``cold_sync`` heals deviation entries by pulling from the stream's
+  origin node (the canonical holder, like the reference's by-actor sync
+  peer choice, agent.rs:2383-2423), budgeted per session, CRDT cells
+  merged for every granted version.
+
+Rotation happens at EPOCH boundaries between scan chunks (the engine
+already chunks device executions), host-planned and device-checked.
+Out-of-order window bits above a demoted slot's contig are dropped
+(possession under-claim — always safe; sync re-grants the content).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.ops import crdt, onehot
+from corrosion_tpu.ops.gossip import (
+    DataState,
+    GossipConfig,
+    _merge_versions_dense,
+)
+
+
+@dataclass(frozen=True)
+class SparseConfig:
+    """Knobs for the rotating-slot writer plane."""
+
+    epoch_rounds: int = 16  # rotation cadence (aligned with scan chunks)
+    k_dev: int = 64  # deviation-table capacity per node
+    d_max: int = 256  # max slot retirements per epoch (static pad)
+    p_max: int = 256  # max promotions per epoch (static pad)
+    demote_after: int = 1  # quiescent epochs before a slot may demote
+    cold_budget: int = 64  # versions healed per node per cold_sync session
+    cold_chunk: int = 32  # versions per deviation entry per session
+
+
+class SparseState(NamedTuple):
+    data: DataState  # the hot plane ([N, w_hot] slot tensors)
+    head_full: jax.Array  # u32[N] committed head per NODE (global writers)
+    slot_writer: jax.Array  # i32[w_hot] node id per slot, -1 empty
+    dev_writer: jax.Array  # i32[N, k_dev] global writer id, -1 empty
+    dev_contig: jax.Array  # u32[N, k_dev] lagging watermark
+    dev_any: jax.Array  # bool[] any deviation entry exists (lax.cond gate)
+
+
+def init_sparse(cfg: GossipConfig, sp: SparseConfig) -> SparseState:
+    from corrosion_tpu.ops.gossip import init_data
+
+    n = cfg.n_nodes
+    return SparseState(
+        data=init_data(cfg),
+        head_full=jnp.zeros((n,), jnp.uint32),
+        slot_writer=jnp.full((cfg.n_writers,), -1, jnp.int32),
+        dev_writer=jnp.full((n, sp.k_dev), -1, jnp.int32),
+        dev_contig=jnp.zeros((n, sp.k_dev), jnp.uint32),
+        dev_any=jnp.array(False),
+    )
+
+
+def _col_gather(table: jax.Array, slots: jax.Array) -> jax.Array:
+    """[N, D] = table[:, slots] for SHARED column indices: one exact
+    one-hot matmul (u16 halves ride the MXU; all of u32 exact at HIGHEST
+    precision). A per-row block gather here materialized [N, D, 128] —
+    59 GB at the 100k rotation shapes — and a strided column gather
+    serializes."""
+    w = table.shape[1]
+    sel = (
+        slots[:, None] == jnp.arange(w, dtype=slots.dtype)[None, :]
+    ).astype(jnp.float32)  # [D, W]
+
+    def dot(x):
+        return jnp.einsum(
+            "nw,dw->nd", x, sel, precision=jax.lax.Precision.HIGHEST
+        )
+
+    return onehot.exact_u32_apply(dot, table)
+
+
+@partial(jax.jit, static_argnames=())
+def demote_report(
+    state: SparseState,
+    cand_slots: jax.Array,  # i32[D] candidate slots (clipped, padded)
+    cand_ok: jax.Array,  # bool[D]
+) -> tuple[jax.Array, jax.Array]:
+    """Device-side feasibility for a host-proposed retirement list.
+
+    Returns (caught_up[D], maxload[D]):
+    - caught_up[d]: every node's hot contig equals the slot head (zero-lag
+      demotion is free);
+    - maxload[d]: max over nodes of (deviation-table occupancy + new
+      entries if candidates 0..d were ALL force-demoted) — the host
+      force-demotes the longest prefix with maxload <= k_dev.
+    """
+    data = state.data
+    cs = jnp.maximum(cand_slots, 0)
+    contig_c = _col_gather(data.contig, cs)  # u32[N, D]
+    head_c = data.head[cs]  # [D] (tiny gather)
+    lag = (head_c[None, :] - contig_c).astype(jnp.uint32) * cand_ok[None, :]
+    caught_up = jnp.sum(lag > 0, axis=0, dtype=jnp.int32) == 0
+    occ = jnp.sum(state.dev_writer >= 0, axis=1, dtype=jnp.int32)  # [N]
+    adds = jnp.cumsum((lag > 0).astype(jnp.int32), axis=1)  # [N, D]
+    maxload = jnp.max(occ[:, None] + adds, axis=0)  # [D]
+    return caught_up, maxload
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def rotate(
+    state: SparseState,
+    retire_slots: jax.Array,  # i32[D] slots to retire (padded)
+    retire_ok: jax.Array,  # bool[D]
+    promote_slots: jax.Array,  # i32[P] target slots (padded)
+    promote_writers: jax.Array,  # i32[P] node ids taking the slots
+    promote_ok: jax.Array,  # bool[P]
+    cfg: GossipConfig,
+) -> tuple[SparseState, dict]:
+    """Epoch transition: retire slots (inserting deviation entries for
+    laggards), then promote new writers into free slots (consuming any
+    deviation entries for them). The host guarantees feasibility via
+    demote_report; ``dev_dropped`` in the returned stats must stay 0 (a
+    nonzero value means an over-claim and is asserted on by the engine).
+    """
+    from corrosion_tpu.ops import routing
+
+    data = state.data
+    n, w_hot = cfg.n_nodes, cfg.n_writers
+    d = retire_slots.shape[0]
+    p = promote_slots.shape[0]
+    rs = jnp.maximum(retire_slots, 0)
+    ps = jnp.maximum(promote_slots, 0)
+
+    # ---- retire: write heads back, insert deviation entries ----------------
+    writer_ret = jnp.where(
+        retire_ok, state.slot_writer[rs], -1
+    )  # i32[D] global ids
+    head_ret = data.head[rs]  # u32[D]
+    head_full = state.head_full.at[
+        jnp.where(retire_ok & (writer_ret >= 0), writer_ret, n)
+    ].set(head_ret, mode="drop")
+
+    contig_ret = _col_gather(data.contig, rs)  # u32[N, D]
+    lag_mask = (
+        (contig_ret < head_ret[None, :])
+        & retire_ok[None, :]
+        & (writer_ret[None, :] >= 0)
+    )
+    cand_w = jnp.concatenate(
+        [
+            state.dev_writer,
+            jnp.where(lag_mask, writer_ret[None, :], -1),
+        ],
+        axis=1,
+    )
+    cand_c = jnp.concatenate([state.dev_contig, contig_ret], axis=1)
+    cand_valid = cand_w >= 0
+    keep, (dev_writer, dev_contig) = routing.rebuild_bounded_queue(
+        cand_valid, cand_valid.astype(jnp.int32), (cand_w, cand_c),
+        state.dev_writer.shape[1],
+    )
+    dev_writer = jnp.where(keep, dev_writer, -1)
+    dev_dropped = jnp.sum(cand_valid, dtype=jnp.int32) - jnp.sum(
+        keep, dtype=jnp.int32
+    )
+
+    retired_col = (
+        jnp.zeros((w_hot,), bool)
+        .at[jnp.where(retire_ok, rs, w_hot)]
+        .set(True, mode="drop")
+    )
+    slot_writer = jnp.where(retired_col, -1, state.slot_writer)
+
+    # ---- promote: init columns from head_full, refined by dev entries ------
+    pw = jnp.maximum(promote_writers, 0)
+    # head_full AFTER the retire writeback (a writer promoted this epoch
+    # cannot also be retiring this epoch — host invariant — so this only
+    # matters for writers retired in earlier epochs).
+    claim_default = jnp.broadcast_to(head_full[pw][None, :], (n, p))
+
+    # Writer-id -> promotion index lookup table (P is a sentinel).
+    promo_idx = (
+        jnp.full((n + 1,), p, jnp.int32)
+        .at[jnp.where(promote_ok, pw, n)]
+        .set(jnp.arange(p, dtype=jnp.int32), mode="drop")
+    )
+
+    def _refine(args):
+        claims, dev_w, dev_c = args
+        # Per deviation entry: is its writer being promoted this epoch?
+        # Flat [N, K] gathers/scatters serialize on TPU but run at epoch
+        # cadence and only while entries exist (this cond); the dense
+        # [N, K, P] compare would materialize gigabytes at 100k.
+        k_dev = dev_w.shape[1]
+        idx = promo_idx[jnp.maximum(dev_w, 0)]  # [N, K]
+        hit = (idx < p) & (dev_w >= 0)
+        # A node has at most one entry per writer, so a plain scatter of
+        # entry claims into the [N, P] claim matrix is collision-free.
+        rowi = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k_dev))
+        pos = jnp.where(hit, rowi * p + idx, n * p)
+        claims = (
+            claims.reshape(-1)
+            .at[pos.reshape(-1)]
+            .set(dev_c.reshape(-1), mode="drop")
+            .reshape(n, p)
+        )
+        dev_w = jnp.where(hit, -1, dev_w)
+        return claims, dev_w, dev_c
+
+    claims, dev_writer, dev_contig = jax.lax.cond(
+        state.dev_any,
+        _refine,
+        lambda args: args,
+        (claim_default, dev_writer, dev_contig),
+    )
+
+    promoted_col = (
+        jnp.zeros((w_hot,), bool)
+        .at[jnp.where(promote_ok, ps, w_hot)]
+        .set(True, mode="drop")
+    )
+    # Scatter claims into the promoted columns with an exact one-hot
+    # matmul (u16 halves; a [N, P]→[N, W] column scatter serializes).
+    sel = (
+        ps[:, None] == jnp.arange(w_hot)[None, :]
+    ).astype(jnp.float32) * promote_ok[:, None]  # [P, W]
+
+    def _cols(vals):  # u32[N, P] -> u32[N, W] (zeros off promoted cols)
+        def dot(x):
+            return jnp.einsum(
+                "np,pw->nw", x, sel,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+
+        return onehot.exact_u32_apply(dot, vals)
+
+    claim_cols = _cols(claims)
+    contig = jnp.where(
+        promoted_col[None, :],
+        claim_cols,
+        jnp.where(retired_col[None, :], 0, data.contig),
+    )
+    seen = jnp.where(
+        promoted_col[None, :],
+        claim_cols,
+        jnp.where(retired_col[None, :], 0, data.seen),
+    )
+    # Window bits for retired/promoted columns drop (possession
+    # under-claim — safe; content re-granted by sync if ever needed).
+    col_reset = retired_col | promoted_col
+    oo = jnp.where(col_reset[None, None, :], jnp.uint32(0), data.oo)
+    head = jnp.where(
+        promoted_col,
+        (
+            jnp.zeros((w_hot,), jnp.uint32)
+            .at[jnp.where(promote_ok, ps, w_hot)]
+            .set(head_full[pw], mode="drop")
+        ),
+        jnp.where(retired_col, 0, data.head),
+    )
+    slot_writer = jnp.where(
+        promoted_col,
+        (
+            jnp.full((w_hot,), -1, jnp.int32)
+            .at[jnp.where(promote_ok, ps, w_hot)]
+            .set(promote_writers, mode="drop")
+        ),
+        slot_writer,
+    )
+
+    # Queue entries referencing reset slots die (their content is already
+    # applied at its holders; receivers that never got it lag on the
+    # retired writer and heal through deviations/cold_sync). q_writer
+    # holds slot ids; map through the [W] reset mask with the shared-table
+    # block gather (a direct [N, Q, D+P] compare materializes gigabytes).
+    q_dead = onehot.table_gather_u32(
+        col_reset.astype(jnp.uint32), jnp.maximum(data.q_writer, 0)
+    )
+    q_writer = jnp.where(
+        (q_dead > 0) & (data.q_writer >= 0), -1, data.q_writer
+    )
+
+    dev_any = jnp.any(dev_writer >= 0)
+    stats = {
+        "retired": jnp.sum(retire_ok & (writer_ret >= 0), dtype=jnp.int32),
+        "promoted": jnp.sum(promote_ok, dtype=jnp.int32),
+        "dev_entries": jnp.sum(dev_writer >= 0, dtype=jnp.int32),
+        "dev_dropped": dev_dropped,
+    }
+    return (
+        SparseState(
+            data=data._replace(
+                contig=contig,
+                seen=seen,
+                oo=oo,
+                oo_any=jnp.any(oo) if cfg.window_k else data.oo_any,
+                head=head,
+                q_writer=q_writer,
+            ),
+            head_full=head_full,
+            slot_writer=slot_writer,
+            dev_writer=dev_writer,
+            dev_contig=dev_contig,
+            dev_any=dev_any,
+        ),
+        stats,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "sp"))
+def cold_sync(
+    state: SparseState,
+    region: jax.Array,  # i32[N] region per node
+    alive: jax.Array,  # bool[N]
+    partition: jax.Array,  # bool[R, R]
+    cfg: GossipConfig,
+    sp: SparseConfig,
+) -> tuple[SparseState, dict]:
+    """Heal deviation entries by pulling from each stream's origin node
+    (the canonical holder — it committed the versions). Budgeted per node
+    per session; granted versions merge their CRDT cells exactly like the
+    hot sync grant replay. Gated on dev_any: epochs with empty tables pay
+    one predicate."""
+
+    def _go(state):
+        n = cfg.n_nodes
+        dev_w = state.dev_writer
+        dev_c = state.dev_contig
+        k_dev = dev_w.shape[1]
+        wsafe = jnp.maximum(dev_w, 0)
+        # Reachability of the origin: alive and not partitioned from us.
+        # ([N, K] fancy gathers from 1-D tables — serialized on TPU, but
+        # only paid while deviation entries exist.)
+        alive_i = alive.astype(jnp.int32)[wsafe] > 0
+        reg_w = region[wsafe]
+        part_i = partition.astype(jnp.int32)
+        ok = (
+            (dev_w >= 0)
+            & alive_i
+            & (part_i[region[:, None], reg_w] == 0)
+        )
+        target = state.head_full[wsafe]  # u32[N, K]
+        deficit = jnp.where(ok, target - jnp.minimum(target, dev_c), 0)
+        per_e = jnp.minimum(deficit, jnp.uint32(sp.cold_chunk)).astype(
+            jnp.int32
+        )
+        cum = jnp.cumsum(per_e, axis=1)
+        grant = jnp.clip(
+            jnp.int32(sp.cold_budget) - (cum - per_e), 0, per_e
+        ).astype(jnp.uint32)
+        new_c = dev_c + grant
+        healed = jnp.sum(grant, dtype=jnp.uint32)
+
+        cells = state.data.cells
+        n_merges = jnp.uint32(0)
+        if cfg.n_cells > 0:
+            # Enumerate granted (writer, version) pairs into [N, B] and
+            # merge their cells (the replay of peer.rs:610-666 for the
+            # cold plane). k_dev is narrow: dense one-hot ops suffice.
+            b = sp.cold_budget
+            e = jnp.arange(b, dtype=jnp.int32)
+            gcum = jnp.cumsum(grant.astype(jnp.int32), axis=1)
+            e_idx = jnp.sum(
+                gcum[:, None, :] <= e[None, :, None], axis=2,
+                dtype=jnp.int32,
+            )  # [N, B] entry owning unit e
+            e_idx = jnp.minimum(e_idx, k_dev - 1)
+            prev = jnp.where(
+                e_idx > 0,
+                onehot.rowgather(
+                    gcum.astype(jnp.uint32), jnp.maximum(e_idx - 1, 0)
+                ).astype(jnp.int32),
+                0,
+            )
+            ver = (
+                onehot.rowgather(dev_c, e_idx)
+                + 1
+                + (e[None, :] - prev).astype(jnp.uint32)
+            )
+            gw = onehot.rowgather(wsafe.astype(jnp.uint32), e_idx)
+            mask = e[None, :] < gcum[:, -1][:, None]
+            cells, n_merges = _merge_versions_dense(
+                cells, None, gw, ver, mask, None, n, cfg
+            )
+
+        # Entries that reached the cold head clear.
+        done = ok & (new_c >= target)
+        dev_w2 = jnp.where(done, -1, dev_w)
+        return (
+            state._replace(
+                data=state.data._replace(cells=cells),
+                dev_writer=dev_w2,
+                dev_contig=new_c,
+                dev_any=jnp.any(dev_w2 >= 0),
+            ),
+            {"cold_healed": healed, "cold_merges": n_merges},
+        )
+
+    def _skip(state):
+        return state, {
+            "cold_healed": jnp.uint32(0),
+            "cold_merges": jnp.uint32(0),
+        }
+
+    return jax.lax.cond(state.dev_any, _go, _skip, state)
+
+
+def cold_visibility(
+    state: SparseState,
+    sample_writer: jax.Array,  # i32[S] global writer (node) ids
+    sample_ver: jax.Array,  # u32[S]
+) -> jax.Array:
+    """bool[S, N] visibility of sampled writes against the COLD plane:
+    a cold write is held everywhere except at nodes with a deviation
+    entry below it. (Samples whose writer is currently hot are answered
+    by gossip.visibility on the slot plane instead.)"""
+
+    def _go(_):
+        # Per-sample map bounds the [chunk, N, K] compare transient: the
+        # flat [S, N, K] form materializes gigabytes at (256, 100k, 256).
+        def one(args):
+            w, v = args
+            lag = (state.dev_writer == w) & (state.dev_contig < v)
+            return ~jnp.any(lag, axis=1)  # [N]
+
+        return jax.lax.map(
+            one, (sample_writer, sample_ver), batch_size=16
+        )
+
+    return jax.lax.cond(
+        state.dev_any,
+        _go,
+        lambda _: jnp.ones(
+            (sample_writer.shape[0], state.dev_writer.shape[0]), bool
+        ),
+        None,
+    )
+
+
+def cold_need(state: SparseState) -> jax.Array:
+    """Σ outstanding deviation lag (the cold component of total_need)."""
+    target = state.head_full[jnp.maximum(state.dev_writer, 0)]
+    lag = jnp.where(
+        state.dev_writer >= 0,
+        target - jnp.minimum(target, state.dev_contig),
+        0,
+    )
+    return jnp.sum(lag, dtype=jnp.uint32)
+
+
+def serial_merge_reference_sparse(
+    head_full, cfg: GossipConfig
+) -> crdt.CellState:
+    """Ground truth for any-node-writes runs: merge every committed
+    version (w = NODE id, v <= head_full[w]) into one fresh cell state."""
+    import numpy as np
+
+    head_full = np.asarray(head_full)
+    state = crdt.make_cells(cfg.n_cells)
+    ws, vs = [], []
+    for w in np.nonzero(head_full)[0]:
+        for v in range(1, int(head_full[w]) + 1):
+            ws.append(w)
+            vs.append(v)
+    if not ws:
+        return state
+    ws = jnp.asarray(np.array(ws, np.uint32))
+    vs = jnp.asarray(np.array(vs, np.uint32))
+    mask = jnp.ones(ws.shape, bool)
+    for j in range(cfg.cells_per_write):
+        key, cl, cv, vr = crdt.derive_change(
+            ws, vs, jnp.uint32(j), cfg.n_cells
+        )
+        state = crdt.apply_changes(
+            state,
+            crdt.ChangeBatch(
+                key=key, cl=cl, col_version=cv, value_rank=vr, mask=mask
+            ),
+        )
+    return state

@@ -81,6 +81,10 @@ def shard_topology(topo: Topology, mesh: Mesh, axis=None) -> Topology:
             None if topo.sync_cohorts is None
             else _put(topo.sync_cohorts, mesh, r)
         ),
+        writer_ids=(
+            None if topo.writer_ids is None
+            else _put(topo.writer_ids, mesh, r)
+        ),
     )
 
 
@@ -108,6 +112,7 @@ def shard_cluster_state(
         q_writer=_put(d.q_writer, mesh, row),
         q_ver=_put(d.q_ver, mesh, row),
         q_tx=_put(d.q_tx, mesh, row),
+        q_gw=_put(d.q_gw, mesh, row),
         # Cell plane is node-major flat [N * K]: sharding the single axis
         # splits it on node boundaries (K divides each shard when N does).
         cells=jax.tree.map(lambda a: _put(a, mesh, vec), d.cells),

@@ -1,0 +1,444 @@
+"""Any-node-writes cluster simulation over the rotating-slot writer plane.
+
+The dense engine (sim/engine.py) models W writer streams as fixed tensor
+columns; this engine lets ALL N nodes write (the reference's model —
+writes originate anywhere, doc/crdts.md:25-28) by multiplexing active
+writers onto ``w_hot`` rotating slots (ops/sparse_writers.py):
+
+- The run is split into EPOCHS of ``sparse.epoch_rounds`` rounds. At each
+  boundary a host planner retires quiescent slots and promotes newly
+  active writers; the device checks feasibility first (zero-lag demotion,
+  deviation-table headroom) so bookkeeping is never silently dropped.
+- Inside an epoch the unchanged gossip kernels run over the slot axis
+  (broadcast + SWIM + anti-entropy sync), plus a gated ``cold_sync`` that
+  heals deviation entries left by forced demotions.
+- Visibility sampling: samples of currently-hot writers are tracked per
+  round on the slot plane; samples of demoted writers resolve at epoch
+  granularity against the deviation tables (zero-lag demotion implies
+  they were already visible everywhere while hot, so the coarser
+  resolution only applies after forced demotions).
+
+Slot exhaustion (more new writers than free + demotable slots) raises —
+it would otherwise silently defer commits and corrupt the sampled-write
+bookkeeping. Size w_hot to the workload's concurrent-writer envelope; the
+failure mode is explicit backpressure, mirroring the admission control a
+live agent would apply.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu.ops import gossip as gossip_ops
+from corrosion_tpu.ops import sparse_writers as sw_ops
+from corrosion_tpu.ops import swim as swim_ops
+from corrosion_tpu.ops.gossip import GossipConfig, Topology
+from corrosion_tpu.ops.sparse_writers import SparseConfig, SparseState
+from corrosion_tpu.ops.swim import SwimConfig
+from corrosion_tpu.sim.engine import Schedule
+
+
+@dataclass(frozen=True)
+class SparseClusterConfig:
+    swim: SwimConfig
+    gossip: GossipConfig  # n_writers == w_hot slots; track_writer_ids=True
+    sparse: SparseConfig
+    round_ms: float = 500.0
+
+    def __post_init__(self):
+        if not self.gossip.track_writer_ids:
+            raise ValueError(
+                "sparse engine requires gossip.track_writer_ids=True "
+                "(cell keys must follow global writer identity)"
+            )
+
+    @property
+    def n_nodes(self) -> int:
+        return self.gossip.n_nodes
+
+    @property
+    def w_hot(self) -> int:
+        return self.gossip.n_writers
+
+
+class _Planner:
+    """Host-side slot allocator. Device state is consulted through
+    demote_report before any forced retirement is committed."""
+
+    def __init__(self, n: int, w_hot: int, sp: SparseConfig):
+        self.n = n
+        self.w_hot = w_hot
+        self.sp = sp
+        self.slot_of = np.full(n, -1, np.int32)  # writer node -> slot
+        self.writer_of = np.full(w_hot, -1, np.int32)  # slot -> writer
+        self.last_active = np.full(w_hot, -(10**9), np.int64)
+        self.free: list[int] = list(range(w_hot))
+
+    def plan(self, epoch: int, writes_ep: np.ndarray, check):
+        """writes_ep: [E, N]. ``check(cand_slots, cand_ok)`` runs
+        demote_report on device. Returns (retire, promote) host arrays
+        (padded to d_max/p_max) for sw_ops.rotate."""
+        sp = self.sp
+        active = np.nonzero(writes_ep.sum(axis=0))[0]
+        new = [int(w) for w in active if self.slot_of[w] < 0]
+        active_set = set(int(w) for w in active)
+
+        # Retirement candidates: occupied, writer quiescent long enough,
+        # not active this epoch. Most-quiescent first.
+        occ = np.nonzero(self.writer_of >= 0)[0]
+        cands = [
+            int(s)
+            for s in occ
+            if int(self.writer_of[s]) not in active_set
+            and self.last_active[s] <= epoch - sp.demote_after
+        ]
+        cands.sort(key=lambda s: self.last_active[s])
+        cands = cands[: sp.d_max]
+        retire: list[int] = []
+        diag = {"cands": len(cands), "zero_lag": 0, "forced_pool": 0,
+                "take": 0, "f_load_head": []}
+        if cands:
+            cand_arr = np.full(sp.d_max, 0, np.int32)
+            cand_ok = np.zeros(sp.d_max, bool)
+            cand_arr[: len(cands)] = cands
+            cand_ok[: len(cands)] = True
+            caught_up, maxload = check(cand_arr, cand_ok)
+            caught_up = np.asarray(caught_up)[: len(cands)]
+            # Zero-lag retirements are free — take them all.
+            retire = [s for s, c in zip(cands, caught_up) if c]
+            diag["zero_lag"] = len(retire)
+            shortage = len(new) - (len(self.free) + len(retire))
+            if shortage > 0:
+                # Forced demotions, only as many as needed and only while
+                # every node's deviation table provably has headroom.
+                forced_pool = [
+                    s for s, c in zip(cands, caught_up) if not c
+                ]
+                diag["forced_pool"] = len(forced_pool)
+                if forced_pool:
+                    f_arr = np.full(sp.d_max, 0, np.int32)
+                    f_ok = np.zeros(sp.d_max, bool)
+                    f_arr[: len(forced_pool)] = forced_pool
+                    f_ok[: len(forced_pool)] = True
+                    _, f_load = check(f_arr, f_ok)
+                    f_load = np.asarray(f_load)[: len(forced_pool)]
+                    take = 0
+                    while (
+                        take < len(forced_pool)
+                        and take < shortage
+                        and f_load[take] <= sp.k_dev
+                    ):
+                        take += 1
+                    retire += forced_pool[:take]
+                    diag["take"] = take
+                    diag["f_load_head"] = f_load[:8].tolist()
+
+        free_after = len(self.free) + len(retire)
+        if len(new) > free_after:
+            raise RuntimeError(
+                f"slot exhaustion at epoch {epoch}: {len(new)} new "
+                f"writers, {free_after} slots available (w_hot="
+                f"{self.w_hot}); size w_hot to the workload's "
+                f"concurrent-writer envelope [diag: {diag}]"
+            )
+        if len(new) > sp.p_max or len(retire) > sp.d_max:
+            raise RuntimeError(
+                f"epoch {epoch} churn exceeds static pads: "
+                f"{len(new)} promotions (p_max={sp.p_max}), "
+                f"{len(retire)} retirements (d_max={sp.d_max})"
+            )
+
+        # Commit host bookkeeping.
+        slots_avail = list(retire) + self.free
+        promote_slots, promote_writers = [], []
+        for s in retire:
+            w_old = int(self.writer_of[s])
+            self.slot_of[w_old] = -1
+            self.writer_of[s] = -1
+        for w in new:
+            s = slots_avail.pop(0)
+            promote_slots.append(s)
+            promote_writers.append(w)
+            self.slot_of[w] = s
+            self.writer_of[s] = w
+        self.free = slots_avail
+        for w in active:
+            s = self.slot_of[w]
+            self.last_active[s] = epoch
+
+        def pad(vals, size, fill=0):
+            out = np.full(size, fill, np.int32)
+            out[: len(vals)] = vals
+            return out
+
+        r = (
+            pad(retire, sp.d_max),
+            np.arange(sp.d_max) < len(retire),
+            pad(promote_slots, sp.p_max),
+            pad(promote_writers, sp.p_max),
+            np.arange(sp.p_max) < len(promote_slots),
+        )
+        return r
+
+    def writes_to_slots(self, writes_ep: np.ndarray) -> np.ndarray:
+        """[E, N] -> [E, w_hot] via the current slot map."""
+        out = np.zeros((writes_ep.shape[0], self.w_hot), writes_ep.dtype)
+        occ = np.nonzero(self.writer_of >= 0)[0]
+        out[:, occ] = writes_ep[:, self.writer_of[occ]]
+        return out
+
+    def topology_arrays(self):
+        """(writer_nodes, writer_of_node, writer_ids) for this epoch."""
+        wn = np.maximum(self.writer_of, 0).astype(np.int32)
+        won = self.slot_of.copy()
+        wid = np.maximum(self.writer_of, 0).astype(np.uint32)
+        return wn, won, wid
+
+
+@partial(jax.jit, static_argnames=("cfg", "sp", "has_churn"))
+def _epoch_scan(
+    sstate: SparseState,
+    swim_state,
+    vis_round: jax.Array,  # i32[S, N]
+    topo: Topology,
+    xs,  # (writes_slots [E, W], kill [E, ?], revive [E, ?], round_idx [E])
+    partition: jax.Array,  # bool[E, R, R]
+    s_slot: jax.Array,  # i32[S] sample slot this epoch (-1 = cold)
+    s_ver: jax.Array,  # u32[S]
+    s_round: jax.Array,  # i32[S]
+    base_key: jax.Array,
+    cfg: SparseClusterConfig,
+    sp: SparseConfig,
+    has_churn: bool,
+):
+    swim_impl = swim_ops.impl(cfg.swim)
+    region = topo.region
+
+    def body(carry, x):
+        st, sw, vr = carry
+        w_slots, part, kl, rv, r = x
+        key = jax.random.fold_in(base_key, r)
+        if has_churn:
+            k_churn, k_b, k_sw, k_sy, k_rejoin = jax.random.split(key, 5)
+            sw = swim_impl.apply_churn(
+                sw, kl, rv, k_churn, cfg.swim.max_transmissions
+            )
+        else:
+            k_b, k_sw, k_sy = jax.random.split(key, 3)
+        alive = sw.alive
+
+        data, bstats = gossip_ops.broadcast_round(
+            st.data, topo, alive, part, w_slots, k_b, cfg.gossip
+        )
+        sw = swim_impl.swim_round(sw, k_sw, r, cfg.swim)
+        data, ssta = gossip_ops.sync_round(
+            data, topo, alive, part, r, k_sy, cfg.gossip
+        )
+        if has_churn:
+            data, rsta = gossip_ops.revive_sync(
+                data, topo, alive, part, rv, k_rejoin, cfg.gossip
+            )
+            ssta = {k: ssta[k] + rsta[k] for k in ssta}
+        st = st._replace(data=data)
+        st, csta = sw_ops.cold_sync(
+            st, region, alive, part, cfg.gossip, sp
+        )
+
+        # Hot-plane visibility for samples whose writer holds a slot.
+        hot = s_slot >= 0
+        vis_now = gossip_ops.visibility(
+            st.data, jnp.maximum(s_slot, 0), s_ver
+        )
+        active_s = r >= s_round
+        vr = jnp.where(
+            (vr < 0) & vis_now & (hot & active_s)[:, None], r, vr
+        )
+
+        stats = {
+            "mismatches": swim_impl.mismatches(sw),
+            "need": gossip_ops.total_need(st.data) + sw_ops.cold_need(st),
+            "applied_broadcast": bstats["applied_broadcast"],
+            "applied_sync": ssta["applied_sync"],
+            "msgs": bstats["msgs"],
+            "sessions": ssta["sessions"],
+            "cell_merges": (
+                bstats["cell_merges"]
+                + ssta["cell_merges"]
+                + csta["cold_merges"]
+            ),
+            "window_degraded": bstats["window_degraded"],
+            "sync_regrant": ssta["sync_regrant"],
+            "cold_healed": csta["cold_healed"],
+        }
+        return (st, sw, vr), stats
+
+    (sstate, swim_state, vis_round), curves = jax.lax.scan(
+        body,
+        (sstate, swim_state, vis_round),
+        (xs[0], partition, xs[1], xs[2], xs[3]),
+    )
+    return sstate, swim_state, vis_round, curves
+
+
+@jax.jit
+def _cold_vis_update(
+    sstate: SparseState,
+    vis_round: jax.Array,  # i32[S, N]
+    s_writer: jax.Array,  # i32[S] global writer ids
+    s_ver: jax.Array,
+    s_cold: jax.Array,  # bool[S] writer demoted AND sample committed
+    round_now: jax.Array,  # i32
+):
+    vis = sw_ops.cold_visibility(sstate, s_writer, s_ver)
+    return jnp.where(
+        (vis_round < 0) & vis & s_cold[:, None], round_now, vis_round
+    )
+
+
+def simulate_sparse(
+    cfg: SparseClusterConfig,
+    topo_base: Topology,
+    schedule: Schedule,  # writes [rounds, N] — every node may write
+    seed: int = 0,
+):
+    """Run the epoch-rotated any-node-writes simulation. Returns
+    (final_sparse_state, swim_state, vis_round, curves, info)."""
+    sp = cfg.sparse
+    n = cfg.n_nodes
+    rounds = schedule.rounds
+    e_len = sp.epoch_rounds
+    if schedule.writes.shape[1] != n:
+        raise ValueError(
+            f"sparse schedule writes must be [rounds, n_nodes], got "
+            f"{schedule.writes.shape}"
+        )
+    has_churn = schedule.kill is not None or schedule.revive is not None
+    n_regions = int(np.asarray(topo_base.region).max()) + 1
+
+    planner = _Planner(n, cfg.w_hot, sp)
+    sstate = sw_ops.init_sparse(cfg.gossip, sp)
+    swim_state = swim_ops.impl(cfg.swim).init_state(cfg.swim)
+    n_samples = len(schedule.sample_writer)
+    vis_round = jnp.full((n_samples, n), -1, jnp.int32)
+    s_writer = jnp.asarray(schedule.sample_writer)
+    s_ver = jnp.asarray(schedule.sample_ver)
+    s_round_np = schedule.sample_round
+    s_round = jnp.asarray(s_round_np)
+    base_key = jax.random.PRNGKey(seed)
+
+    def check(cand, ok):
+        cu, ml = sw_ops.demote_report(
+            sstate, jnp.asarray(cand), jnp.asarray(ok)
+        )
+        return np.asarray(cu), np.asarray(ml)
+
+    curve_parts = []
+    info = {"epochs": 0, "retired": 0, "promoted": 0, "dev_dropped": 0,
+            "max_dev_entries": 0}
+    for e0 in range(0, rounds, e_len):
+        e1 = min(e0 + e_len, rounds)
+        epoch = e0 // e_len
+        w_ep = schedule.writes[e0:e1]
+        plan = planner.plan(epoch, w_ep, check)
+        sstate, rstats = sw_ops.rotate(
+            sstate,
+            jnp.asarray(plan[0]), jnp.asarray(plan[1]),
+            jnp.asarray(plan[2]), jnp.asarray(plan[3]),
+            jnp.asarray(plan[4]),
+            cfg.gossip,
+        )
+        dropped = int(rstats["dev_dropped"])
+        if dropped:
+            raise RuntimeError(
+                f"rotate dropped {dropped} deviation entries at epoch "
+                f"{epoch} — demote_report feasibility was violated"
+            )
+        info["epochs"] += 1
+        info["retired"] += int(rstats["retired"])
+        info["promoted"] += int(rstats["promoted"])
+        info["max_dev_entries"] = max(
+            info["max_dev_entries"], int(rstats["dev_entries"])
+        )
+
+        wn, won, wid = planner.topology_arrays()
+        topo = topo_base._replace(
+            writer_nodes=jnp.asarray(wn),
+            writer_of_node=jnp.asarray(won),
+            writer_ids=jnp.asarray(wid),
+        )
+        writes_slots = jnp.asarray(
+            planner.writes_to_slots(w_ep), dtype=jnp.uint32
+        )
+        el = e1 - e0
+        if has_churn:
+            zeros_n = np.zeros((el, n), bool)
+            kill = jnp.asarray(
+                schedule.kill[e0:e1] if schedule.kill is not None else zeros_n
+            )
+            revive = jnp.asarray(
+                schedule.revive[e0:e1]
+                if schedule.revive is not None else zeros_n
+            )
+        else:
+            kill = revive = jnp.zeros((el, 1), bool)
+        if schedule.partition is not None:
+            part = jnp.asarray(schedule.partition[e0:e1])
+        else:
+            part = jnp.zeros((el, n_regions, n_regions), bool)
+        s_slot = jnp.asarray(
+            planner.slot_of[np.asarray(schedule.sample_writer)]
+            if n_samples else np.zeros(0, np.int32)
+        )
+        ridx = jnp.arange(e0, e1, dtype=jnp.int32)
+
+        sstate, swim_state, vis_round, curves = _epoch_scan(
+            sstate, swim_state, vis_round, topo,
+            (writes_slots, kill, revive, ridx), part,
+            s_slot, s_ver, s_round, base_key, cfg, sp, has_churn,
+        )
+        curve_parts.append({k: np.asarray(v) for k, v in curves.items()})
+
+        # Epoch-end cold visibility at epoch granularity (exact for
+        # zero-lag demotions: those were visible everywhere while hot).
+        if n_samples:
+            s_cold = jnp.asarray(
+                (planner.slot_of[np.asarray(schedule.sample_writer)] < 0)
+                & (s_round_np <= e1 - 1)
+            )
+            vis_round = _cold_vis_update(
+                sstate, vis_round, s_writer, s_ver, s_cold,
+                jnp.int32(e1 - 1),
+            )
+
+    merged = {
+        k: np.concatenate([p[k] for p in curve_parts])
+        for k in curve_parts[0]
+    }
+    return sstate, swim_state, vis_round, merged, info
+
+
+def final_head_full(sstate: SparseState) -> np.ndarray:
+    """head_full with the still-hot slots written back — the global
+    committed head per node at end of run."""
+    hf = np.asarray(sstate.head_full).copy()
+    slot_writer = np.asarray(sstate.slot_writer)
+    head = np.asarray(sstate.data.head)
+    occ = slot_writer >= 0
+    hf[slot_writer[occ]] = head[occ]
+    return hf
+
+
+def converged_sparse(sstate: SparseState) -> bool:
+    """Hot slots at head everywhere + no deviation entries."""
+    slot_writer = np.asarray(sstate.slot_writer)
+    occ = slot_writer >= 0
+    contig = np.asarray(sstate.data.contig)[:, occ]
+    head = np.asarray(sstate.data.head)[occ]
+    hot_ok = bool((contig == head[None, :]).all())
+    dev_ok = not bool(np.asarray(sstate.dev_any))
+    return hot_ok and dev_ok

@@ -1,0 +1,100 @@
+"""On-chip validation of the any-node-writes sparse writer plane at 100k.
+
+BASELINE-5 variant (VERDICT r4 missing #1 / next-round #2): every node is
+write-eligible; cohorts of fresh writers rotate through w_hot hot slots
+each epoch (ops/sparse_writers.py). Reports the north-star visibility
+metric, convergence over watermarks AND CRDT cells vs the serial-merge
+ground truth, per-node state bytes, and rotation stats.
+
+Usage: python scripts/sparse100k_smoke.py [rounds] [--cells-check]
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from corrosion_tpu import models
+from corrosion_tpu.sim import sparse_engine
+
+
+def main() -> None:
+    from corrosion_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    nums = [a for a in sys.argv[1:] if not a.startswith("-")]
+    rounds = int(nums[0]) if nums else 240
+    cells_check = "--cells-check" in sys.argv
+    on_accel = jax.devices()[0].platform not in ("cpu",)
+    if on_accel:
+        cfg, topo, sched = models.anywrite_sparse(rounds=rounds)
+    else:
+        cfg, topo, sched = models.anywrite_sparse(
+            n=512, w_hot=64, n_regions=4, rounds=min(rounds, 96),
+            cohort=24, k_dev=16, samples=128,
+        )
+
+    t0 = time.perf_counter()
+    sstate, swim_state, vis_round, curves, info = (
+        sparse_engine.simulate_sparse(cfg, topo, sched, seed=0)
+    )
+    jax.block_until_ready(sstate.data.contig)
+    wall = time.perf_counter() - t0
+
+    lat_rounds = np.asarray(vis_round) - sched.sample_round[:, None]
+    seen = np.asarray(vis_round) >= 0
+    lat_s = lat_rounds[seen].astype(np.float64) * (cfg.round_ms / 1000.0)
+    state_bytes = sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves((sstate, swim_state))
+    )
+    distinct_writers = int((sched.writes.sum(axis=0) > 0).sum())
+    out = {
+        "platform": jax.devices()[0].platform,
+        "nodes": cfg.n_nodes,
+        "w_hot": cfg.w_hot,
+        "distinct_writers": distinct_writers,
+        "rounds": rounds,
+        "epochs": info["epochs"],
+        "retired": info["retired"],
+        "promoted": info["promoted"],
+        "max_dev_entries": info["max_dev_entries"],
+        "wall_s": round(wall, 2),
+        "step_ms": round(wall / rounds * 1000.0, 1),
+        "state_mib": round(state_bytes / 2**20, 1),
+        "state_bytes_per_node": int(state_bytes / cfg.n_nodes),
+        "applied": int(
+            curves["applied_broadcast"].sum() + curves["applied_sync"].sum()
+        ),
+        "cold_healed": int(curves["cold_healed"].sum()),
+        "window_degraded": int(curves["window_degraded"].sum()),
+        "converged": sparse_engine.converged_sparse(sstate),
+        "vis_p50_s": round(float(np.percentile(lat_s, 50)), 2),
+        "vis_p99_s": round(float(np.percentile(lat_s, 99)), 2),
+        "unseen_pairs": int((~seen).sum()),
+    }
+    if cells_check:
+        from corrosion_tpu.ops import gossip as gossip_ops
+        from corrosion_tpu.ops import sparse_writers as sw_ops
+        import jax.numpy as jnp
+
+        hf = sparse_engine.final_head_full(sstate)
+        ref = sw_ops.serial_merge_reference_sparse(hf, cfg.gossip)
+        pc = gossip_ops.node_cells(sstate.data, cfg.gossip)
+        out["cells_converged"] = bool(
+            jnp.all(pc.cl == ref.cl[None, :])
+            & jnp.all(pc.col_version == ref.col_version[None, :])
+            & jnp.all(pc.value_rank == ref.value_rank[None, :])
+        )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -258,11 +258,36 @@ def test_agent_prometheus_endpoint(tmp_path):
                 return (
                     'corro_db_table_rows{table="tests"} 1' in body
                     and "corro_sqlite_write_queue" in body
+                    and "corro_gossip_cluster_size 1" in body
+                    and "corro_db_buffered_changes_rows_total 0" in body
                 )
 
             from corrosion_tpu.agent.testing import poll_until
 
             await poll_until(sampled, timeout=10.0)
+
+            # Observability-parity series (doc/telemetry/prometheus.md →
+            # docs/OBSERVABILITY.md audit): config/build gauges are set at
+            # start; the commit counter moved with the INSERT above; the
+            # pool histograms observed the write and the sampled reads.
+            body = await fetch()
+            for series in (
+                "corro_build_info",
+                "corro_gossip_config_max_transmissions",
+                "corro_gossip_config_num_indirect_probes",
+                "corro_broadcast_buffer_capacity",
+                "corro_gossip_updates_backlog",
+                "corro_changes_committed 1",
+                "corro_sqlite_pool_read_connections 20",
+                "corro_sqlite_pool_write_connections 1",
+                "corro_sqlite_pool_execution_seconds_count",
+                "corro_sqlite_pool_queue_seconds_count",
+                "corro_gossip_member_added",
+                "corro_gossip_member_removed",
+                "corro_broadcast_recv_count",
+                "corro_sync_attempts_count",
+            ):
+                assert series in body, f"missing series: {series}"
         finally:
             await a.stop()
 
