@@ -132,12 +132,19 @@ class Store:
         self.native_crdt = native.load_crdt_extension(self.conn)
         # Dedicated read connection (the read pool's role): WAL snapshot
         # isolation from in-flight write transactions.
-        self.read_conn = sqlite3.connect(self.path, check_same_thread=False)
-        self.read_conn.isolation_level = None
-        self.read_conn.create_function(
+        self.read_conn = self.open_read_connection()
+
+    def open_read_connection(self) -> sqlite3.Connection:
+        """A fresh snapshot-read connection with the store's SQL surface
+        (corro_pack + native CRDT helpers) registered — for worker threads
+        that must not share the event loop's connections."""
+        conn = sqlite3.connect(self.path, check_same_thread=False)
+        conn.isolation_level = None
+        conn.create_function(
             "corro_pack", -1, _sql_pack, deterministic=True
         )
-        native.load_crdt_extension(self.read_conn)
+        native.load_crdt_extension(conn)
+        return conn
 
     def _adopt_persisted_site_id(self) -> None:
         (db_site,) = self.conn.execute(

@@ -192,6 +192,10 @@ class MatcherHandle:
         self._full_expensive = False
         self._dirty = False
         self._flush_handle: asyncio.TimerHandle | None = None
+        # In-flight off-loop re-snapshot (expensive shapes only) + the
+        # snapshot-mutation generation that invalidates a stale scan.
+        self._bg_task: asyncio.Task | None = None
+        self._mutation_gen = 0
         self._db: sqlite3.Connection | None = None
         restored = False
         if db_dir is not None:
@@ -321,12 +325,18 @@ class MatcherHandle:
         if self._flush_handle is not None:
             self._flush_handle.cancel()
             self._flush_handle = None
+        if self._bg_task is not None:
+            self._bg_task.cancel()
+            self._bg_task = None
+            self._dirty = True
         if self._dirty:
             # A deferred re-snapshot must not die with the handle: the last
             # change batch before shutdown would stay unreported (and the
-            # durable log would replay stale rows after restore).
+            # durable log would replay stale rows after restore). Direct
+            # sync pass — the off-loop path must not re-arm during close.
             try:
-                self.process(None)
+                self._touched = []
+                self._publish(self._full_pass())
             except Exception:
                 pass
         if self._db is not None:
@@ -556,6 +566,10 @@ class MatcherHandle:
         )
         candidates = None if overdue else self._candidate_keys(changes)
         if candidates is None:
+            if self._bg_task is not None:
+                # A background re-snapshot is already scanning: coalesce.
+                self._dirty = True
+                return []
             if (
                 not overdue
                 and changes is not None
@@ -566,9 +580,20 @@ class MatcherHandle:
                 self._dirty = True
                 self._schedule_flush()
                 return []
+            if self._full_expensive and self._start_bg_full():
+                # Expensive shapes re-snapshot OFF the event loop (a
+                # worker thread on its own read connection): one
+                # aggregate sub over a huge table must not stall the
+                # match loop for its scan (pubsub.rs's candidate path
+                # never full-scans; this bounds ours per batch).
+                return []
             events = self._full_pass()
         else:
             events = self._diff_candidates(candidates)
+        self._publish(events)
+        return events
+
+    def _publish(self, events: list[QueryEventChange]) -> None:
         # The deque stays populated either way: a bounded in-memory cache
         # for live introspection; durable handles additionally append to
         # the sub-db log that backs ?from= replay.
@@ -581,7 +606,83 @@ class MatcherHandle:
                     q.put_nowait(ev)
                 except asyncio.QueueFull:
                     pass
-        return events
+
+    def _start_bg_full(self) -> bool:
+        """Launch the full re-evaluation on a worker thread with a fresh
+        read connection; the diff and emission land back on the event
+        loop. Returns False when no loop is running or the store has no
+        on-disk path (unit-test contexts fall back to the sync path)."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return False
+        path = getattr(self.store, "path", None)
+        if not path or path == ":memory:":
+            return False
+        self._dirty = False
+        sql = self._exec_sql
+        pk_prefix = self._pk_prefix
+        gen_at_start = self._mutation_gen
+
+        def scan():
+            # The store's SQL surface (corro_pack, CRDT helpers) must be
+            # registered on the scan connection too — the sub's SQL may
+            # call them.
+            conn = self.store.open_read_connection()
+            try:
+                conn.execute("PRAGMA query_only=1")
+                t0 = time.monotonic()
+                cur = conn.execute(sql)
+                cols = [d[0] for d in cur.description][pk_prefix:]
+                out: dict[tuple, tuple] = {}
+                for row in cur.fetchall():
+                    if pk_prefix:
+                        out[tuple(row[:pk_prefix])] = tuple(row[pk_prefix:])
+                    else:
+                        out[tuple(row)] = tuple(row)
+                return cols, out, time.monotonic() - t0
+            finally:
+                conn.close()
+
+        async def run():
+            try:
+                cols, new_rows, cost = await asyncio.to_thread(scan)
+                if self._mutation_gen != gen_at_start:
+                    # Candidate diffs advanced the snapshot while the
+                    # scan ran; applying the stale scan would regress
+                    # rows. Drop it and go again.
+                    self._dirty = True
+                    return
+                self.columns = cols
+                self._touched = []
+                events = self._diff_full(new_rows)
+                self._last_full = time.monotonic()
+                self._full_expensive = (
+                    len(new_rows) > self.MAX_FALLBACK_ROWS
+                    or cost > self.FALLBACK_EVAL_BUDGET
+                )
+                self._publish(events)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                import logging
+
+                logging.getLogger("corrosion.subs").warning(
+                    "background re-snapshot failed for sub %s",
+                    self.id, exc_info=True,
+                )
+                # Rate-limit retries: without advancing the stamp the
+                # rescheduled flush fires immediately and a persistent
+                # failure becomes a hot spin.
+                self._last_full = time.monotonic()
+                self._dirty = True
+            finally:
+                self._bg_task = None
+                if self._dirty:
+                    self._schedule_flush()
+
+        self._bg_task = loop.create_task(run())
+        return True
 
     def _full_pass(self) -> list[QueryEventChange]:
         """Full re-evaluation + snapshot diff, tracking its own cost."""
@@ -659,6 +760,9 @@ class MatcherHandle:
         return list(keys)
 
     def _diff_candidates(self, keys) -> list[QueryEventChange]:
+        # Any candidate-path snapshot mutation invalidates an in-flight
+        # background re-snapshot (its scan predates this change).
+        self._mutation_gen += 1
         if isinstance(keys, tuple) and keys[0] == "join":
             return self._diff_join(keys[1])
         if not keys:

@@ -346,6 +346,80 @@ def anywrite_sparse(
     return cfg, topo, sched
 
 
+def mixed_storm(
+    n: int = 1000, streams: int = 16, last_seq: int = 2047,
+    rounds: int = 200, samples: int = 256, seed: int = 13,
+):
+    """Config 3c: MIXED workload — ``streams`` large multi-chunk
+    transactions disseminating seq-granularly WHILE a background
+    version-granular write storm flows through the same cluster round
+    (the reference's ingest handles both inline, agent.rs:2063-2151;
+    VERDICT r4 missing #2). 64 writers; the first ``streams`` of them
+    each commit one large transaction mid-run, interleaved with their
+    own and everyone else's small writes.
+
+    Returns (ClusterConfig, ChunkConfig, Topology, Schedule, StreamSpec).
+    """
+    from corrosion_tpu.ops.chunks import ChunkConfig
+    from corrosion_tpu.sim.mixed_engine import StreamSpec
+
+    writers = list(range(64))
+    cfg, topo = _cfg(
+        n,
+        writers=writers,
+        regions=[n // 4] * 4,
+        sync_interval=8,
+        sync_budget=512,
+        sync_chunk=128,
+        queue=16,
+        n_cells=512,
+    )
+    rng = np.random.default_rng(seed)
+    # Background storm: every writer commits small writes at ~4%/round.
+    writes = (rng.random((rounds, len(writers))) < 0.04).astype(np.uint32)
+    drain = min(60, max(rounds // 3, 1))
+    writes[rounds - drain :, :] = 0
+    # Big transactions: stream s = writer s, committed mid-run. Its
+    # version number is the writer's NEXT version at the commit round
+    # (small writes before it + 1); the engine bumps head past it, so
+    # later small writes number after it.
+    commit_round = np.sort(
+        rng.integers(rounds // 8, rounds // 2, streams)
+    ).astype(np.int32)
+    version = np.zeros(streams, np.uint32)
+    for s in range(streams):
+        version[s] = writes[: commit_round[s], s].sum() + 1
+    # Shift the writer's small-write versions after the big one: the
+    # engine does this implicitly (head bump at commit), but the SAMPLE
+    # bookkeeping below must account for it, so make_samples runs on the
+    # small-write schedule only and big versions are tracked separately.
+    spec = StreamSpec(
+        writer=np.arange(streams, dtype=np.int32),
+        version=version,
+        commit_round=commit_round,
+        last_seq=np.full(streams, last_seq, np.int32),
+    )
+    ccfg = ChunkConfig(
+        n_nodes=n,
+        n_streams=streams,
+        cap=16,
+        chunk_len=256,
+        fanout=3,
+        k_in=6,
+        sync_interval=5,
+        gap_requests=4,
+        sync_seq_budget=4096,
+    )
+    sched = Schedule(writes=writes).make_samples(samples)
+    # Sample versions at/after each big version shift up by one (the big
+    # version occupies the slot the naive per-column count would give).
+    for i in range(len(sched.sample_writer)):
+        w = sched.sample_writer[i]
+        if w < streams and sched.sample_ver[i] >= version[w]:
+            sched.sample_ver[i] += 1
+    return cfg, ccfg, topo, sched, spec
+
+
 def anti_entropy_chunks(
     n: int = 1000, streams: int = 16, last_seq: int = 8191,
     rounds: int = 240,

@@ -1,0 +1,334 @@
+"""Mixed workload engine: multi-chunk transactions + version-granular
+writes coexisting in ONE cluster round.
+
+VERDICT r4 missing #2 / next-round #4. The reference's ingest pipeline
+handles multi-chunk partial versions inline with normal traffic
+(corro-agent/src/agent.rs:2063-2151, 1667-1806): a large transaction's
+chunks buffer with gap tracking while smaller writes keep flowing, and a
+version applies (watermark advance) only once gap-free. Here the two
+kernel planes compose the same way:
+
+- ``S`` large streams, each one (writer, version) pair whose CONTENT
+  disseminates seq-granularly on the chunk plane (ops/chunks.py: chunk
+  gossip + SyncNeedV1::Partial sync). The version number occupies a slot
+  in the writer's ordinary version sequence but is never enqueued on the
+  version-plane broadcast queues — its payload is far beyond the
+  datagram budget; the chunk plane IS its broadcast.
+- The version plane (ops/gossip.py) carries everything else. A node's
+  watermark crosses the big version only when either
+  (a) the chunk plane reports it fully reassembled there — the
+      process_fully_buffered_changes trigger (agent.rs:1667-1806) — and
+      the round's admission step then promotes contig / sets the
+      possession window bit and merges the version's CRDT cells; or
+  (b) anti-entropy granted it whole (the reference's sync serves
+      buffered partials too, sync.rs:248-266) — the crossing is detected
+      after sync_round and the node's chunk coverage is back-filled to
+      complete.
+
+Both planes advance in the same composite round, so a background write
+storm and 16 large transactions genuinely share queues, sync budgets,
+and convergence checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu.ops import chunks as chunk_ops
+from corrosion_tpu.ops import gossip as gossip_ops
+from corrosion_tpu.ops import intervals, swim as swim_ops
+from corrosion_tpu.ops.chunks import ChunkConfig, ChunkState
+from corrosion_tpu.ops.gossip import DataState, Topology
+from corrosion_tpu.sim.engine import ClusterConfig, Schedule
+
+
+@dataclass(frozen=True)
+class StreamSpec:
+    """The large transactions: stream s is version ``version[s]`` of
+    writer ``writer[s]``, committed at ``commit_round[s]`` with
+    ``last_seq[s]+1`` seqs of content."""
+
+    writer: np.ndarray  # i32[S] writer column
+    version: np.ndarray  # u32[S]
+    commit_round: np.ndarray  # i32[S]
+    last_seq: np.ndarray  # i32[S]
+
+
+class MixedState(NamedTuple):
+    data: DataState
+    swim: NamedTuple
+    chunks: ChunkState
+    applied_before: jax.Array  # bool[N, S] chunk-complete as of last round
+    round: jax.Array
+    vis_round: jax.Array  # i32[Samples, N]
+
+
+def _admit_big(
+    data: DataState,
+    newly: jax.Array,  # bool[N, S] completed this round (chunk plane)
+    s_writer: jax.Array,  # i32[S]
+    s_version: jax.Array,  # u32[S]
+    cfg,
+) -> DataState:
+    """Version-plane admission of newly reassembled big versions: per
+    stream, rows whose watermark sits just below promote (plus window
+    coalesce); rows further back set the possession window bit; rows
+    beyond the window stay seen-only (sync heals them later — safe
+    under-claim). Cells merge for every newly possessing row."""
+    contig, oo, seen = data.contig, data.oo, data.seen
+    n = contig.shape[0]
+    wk = cfg.window_k
+    s_count = s_writer.shape[0]
+    cells = data.cells
+    n_merges = jnp.uint32(0)
+    for s in range(s_count):
+        w = s_writer[s]
+        v = s_version[s]
+        col = contig[:, w]  # u32[N]
+        new_s = newly[:, s]
+        adv = (new_s & (col + 1 == v)).astype(jnp.int32)  # direct promote
+        d_rel = v - col - 1  # window bit position (wraps when col >= v)
+        in_win = new_s & (col + 1 < v) & (v <= col + jnp.uint32(wk) + 1)
+        if wk:
+            oo_col = oo[:, :, w]  # u32[B, N]
+            bits = []
+            for b in range(oo.shape[0]):
+                sh = jnp.minimum(
+                    d_rel - jnp.uint32(32 * b), jnp.uint32(31)
+                )
+                inb = in_win & (d_rel >= 32 * b) & (d_rel < 32 * (b + 1))
+                bits.append(
+                    jnp.where(inb, jnp.uint32(1) << sh, jnp.uint32(0))
+                )
+            col2, oo2 = gossip_ops.window_absorb(
+                col, oo_col, adv, jnp.stack(bits)
+            )
+            oo = oo.at[:, :, w].set(oo2)
+        else:
+            col2 = col + adv.astype(jnp.uint32)
+        contig = contig.at[:, w].set(col2)
+        seen = seen.at[:, w].max(jnp.where(new_s, v, 0))
+        if cfg.n_cells > 0:
+            cells, m = gossip_ops._merge_versions_dense(
+                cells, None,
+                jnp.broadcast_to(w, (n, 1)),
+                jnp.broadcast_to(v, (n, 1)),
+                new_s[:, None], None, n, cfg,
+            )
+            n_merges += m
+    oo_any = (data.oo_any | jnp.any(oo)) if wk else data.oo_any
+    return (
+        data._replace(
+            contig=contig, seen=seen, oo=oo, oo_any=oo_any, cells=cells
+        ),
+        n_merges,
+    )
+
+
+def _backfill_coverage(
+    chunks: ChunkState,
+    crossed: jax.Array,  # bool[N, S] version-plane crossed the big version
+    s_last: jax.Array,  # i32[S]
+    cfg: ChunkConfig,
+) -> ChunkState:
+    """Anti-entropy granted the whole version: the node now holds all its
+    content, so its seq coverage becomes [0, last_seq]."""
+    rows = cfg.rows
+    row_stream = jnp.arange(rows) % cfg.n_streams
+    mask = crossed.reshape(rows)
+    starts = jnp.where(
+        mask[:, None],
+        jnp.where(
+            jnp.arange(cfg.cap)[None, :] == 0, 0, intervals.EMPTY
+        ),
+        chunks.have.starts,
+    )
+    ends = jnp.where(
+        mask[:, None],
+        jnp.where(
+            jnp.arange(cfg.cap)[None, :] == 0,
+            s_last[row_stream][:, None],
+            intervals.EMPTY - 1,
+        ),
+        chunks.have.ends,
+    )
+    return ChunkState(
+        have=intervals.IntervalSet(starts=starts, ends=ends)
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "ccfg"))
+def mixed_round(
+    state: MixedState,
+    topo: Topology,
+    writes: jax.Array,  # u32[W] SMALL writes per writer this round
+    big_commit: jax.Array,  # bool[S] streams committing this round
+    s_writer: jax.Array,  # i32[S]
+    s_version: jax.Array,  # u32[S]
+    s_last: jax.Array,  # i32[S]
+    sample_writer: jax.Array,
+    sample_ver: jax.Array,
+    sample_round: jax.Array,
+    rng: jax.Array,
+    cfg: ClusterConfig,
+    ccfg: ChunkConfig,
+) -> tuple[MixedState, dict]:
+    k_b, k_sw, k_sy, k_ck = jax.random.split(rng, 4)
+    swim_impl = swim_ops.impl(cfg.swim)
+    sw = state.swim
+    alive = sw.alive
+    n_regions = topo.region_rtt.shape[0]
+    part = jnp.zeros((n_regions, n_regions), bool)
+    data = state.data
+
+    # Big-version commit: head/contig/seen bump at the writer WITHOUT a
+    # broadcast-queue entry (the chunk plane carries the content; the
+    # writer's own coverage starts full via init_chunks). Writer-side
+    # cells merge here (the local txn materialization).
+    # The writer's own cells for the big version merge through the
+    # admission path: its chunk coverage is full from commit, so `newly`
+    # includes the writer row on commit round.
+    def commit_one(data, s):
+        w = s_writer[s]
+        wnode = topo.writer_nodes[w]
+        v = s_version[s]
+        on = big_commit[s]
+        head = data.head.at[w].max(jnp.where(on, v, 0))
+        contig = data.contig.at[wnode, w].max(jnp.where(on, v, 0))
+        seen = data.seen.at[wnode, w].max(jnp.where(on, v, 0))
+        return data._replace(head=head, contig=contig, seen=seen)
+
+    for s in range(s_writer.shape[0]):
+        data = commit_one(data, s)
+
+    # Chunk plane round (content dissemination + partial-need sync).
+    chunks, cstats = chunk_ops.chunk_round(
+        state.chunks, s_last, alive, state.round, k_ck, ccfg
+    )
+    applied_now = chunk_ops.applied_mask(chunks, s_last, ccfg)  # [N, S]
+    committed = big_commit | (
+        data.head[jnp.maximum(s_writer, 0)] >= s_version
+    )
+    applied_now = applied_now & committed[None, :]
+    newly = applied_now & ~state.applied_before
+
+    # Version-plane admission of freshly reassembled big versions.
+    data, admit_merges = _admit_big(
+        data, newly, s_writer, s_version, cfg.gossip
+    )
+
+    # Ordinary broadcast + SWIM + sync.
+    data, bstats = gossip_ops.broadcast_round(
+        data, topo, alive, part, writes, k_b, cfg.gossip
+    )
+    sw = swim_impl.swim_round(sw, k_sw, state.round, cfg.swim)
+    contig_pre = data.contig
+    data, sstats = gossip_ops.sync_round(
+        data, topo, alive, part, state.round, k_sy, cfg.gossip
+    )
+    # Sync crossings: nodes granted the whole big version back-fill their
+    # chunk coverage (the content came through the sync stream).
+    crossed = (
+        (contig_pre[:, jnp.maximum(s_writer, 0)] < s_version[None, :])
+        & (data.contig[:, jnp.maximum(s_writer, 0)] >= s_version[None, :])
+    )
+    chunks = _backfill_coverage(chunks, crossed, s_last, ccfg)
+    applied_after = (
+        chunk_ops.applied_mask(chunks, s_last, ccfg) & committed[None, :]
+    )
+
+    # Visibility over sampled SMALL writes and big versions alike rides
+    # the version plane (possession = watermark or window).
+    vis_now = gossip_ops.visibility(data, sample_writer, sample_ver)
+    active = state.round >= sample_round
+    vis_round = jnp.where(
+        (state.vis_round < 0) & vis_now & active[:, None],
+        state.round,
+        state.vis_round,
+    )
+
+    stats = {
+        "applied_broadcast": bstats["applied_broadcast"],
+        "applied_sync": sstats["applied_sync"],
+        "cell_merges": (
+            bstats["cell_merges"] + sstats["cell_merges"] + admit_merges
+        ),
+        "chunks_sent": cstats["chunks_sent"],
+        "seqs_granted": cstats["seqs_granted"],
+        "big_applied_nodes": jnp.sum(applied_after, dtype=jnp.uint32),
+        "need": gossip_ops.total_need(data),
+        "window_degraded": bstats["window_degraded"],
+    }
+    return (
+        MixedState(
+            data=data, swim=sw, chunks=chunks,
+            applied_before=applied_after,
+            round=state.round + 1, vis_round=vis_round,
+        ),
+        stats,
+    )
+
+
+def simulate_mixed(
+    cfg: ClusterConfig,
+    ccfg: ChunkConfig,
+    topo: Topology,
+    schedule: Schedule,  # SMALL writes only
+    streams: StreamSpec,
+    seed: int = 0,
+):
+    """Scan mixed_round over the schedule. Returns (final, curves)."""
+    n = cfg.n_nodes
+    s_writer = jnp.asarray(streams.writer, jnp.int32)
+    s_version = jnp.asarray(streams.version, jnp.uint32)
+    s_last = jnp.asarray(streams.last_seq, jnp.int32)
+    origin_nodes = np.asarray(topo.writer_nodes)[
+        np.asarray(streams.writer)
+    ]
+    state = MixedState(
+        data=gossip_ops.init_data(cfg.gossip),
+        swim=swim_ops.impl(cfg.swim).init_state(cfg.swim),
+        chunks=chunk_ops.init_chunks(
+            ccfg, jnp.asarray(origin_nodes, jnp.int32), s_last
+        ),
+        applied_before=jnp.zeros((n, len(streams.writer)), bool),
+        round=jnp.int32(0),
+        vis_round=jnp.full(
+            (len(schedule.sample_writer), n), -1, jnp.int32
+        ),
+    )
+    rounds = schedule.rounds
+    writes = jnp.asarray(schedule.writes, jnp.uint32)
+    commit = np.zeros((rounds, len(streams.writer)), bool)
+    for s, r in enumerate(streams.commit_round):
+        if 0 <= r < rounds:
+            commit[r, s] = True
+    commit = jnp.asarray(commit)
+    s_w = jnp.asarray(schedule.sample_writer)
+    s_v = jnp.asarray(schedule.sample_ver)
+    s_r = jnp.asarray(schedule.sample_round)
+    base_key = jax.random.PRNGKey(seed)
+
+    @partial(jax.jit, static_argnames=())
+    def scan(state):
+        def body(carry, x):
+            w, c, r = x
+            key = jax.random.fold_in(base_key, r)
+            return mixed_round(
+                carry, topo, w, c, s_writer, s_version, s_last,
+                s_w, s_v, s_r, key, cfg, ccfg,
+            )
+
+        return jax.lax.scan(
+            body, state,
+            (writes, commit, jnp.arange(rounds, dtype=jnp.int32)),
+        )
+
+    final, curves = scan(state)
+    return final, {k: np.asarray(v) for k, v in curves.items()}

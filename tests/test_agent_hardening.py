@@ -126,6 +126,70 @@ def test_fallback_full_diff_is_rate_limited(tmp_path):
         store.close()
 
 
+def test_fallback_scan_runs_off_the_event_loop(tmp_path):
+    """VERDICT r4 weak #6: when the rate-limited re-snapshot of an
+    expensive (aggregate) sub DOES fire inside a running event loop, the
+    table scan must not stall the match loop — it runs on a worker
+    thread with its own read connection, and process() stays fast. The
+    final stream is still correct once the background pass lands."""
+    import time as _time
+
+    from corrosion_tpu.agent.store import Store
+    from corrosion_tpu.agent.subs import MatcherHandle
+    from corrosion_tpu.core.values import Change, pack_columns
+
+    store = Store(str(tmp_path / "big.db"), bytes(range(16)))
+    store.apply_schema(
+        "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY,"
+        " text TEXT NOT NULL DEFAULT '')"
+    )
+    store.conn.executemany(
+        "INSERT INTO tests (id, text) VALUES (?, ?)",
+        [(i, f"r{i}") for i in range(100_000)],
+    )
+    store.conn.commit()
+
+    async def main():
+        h = MatcherHandle(store, "SELECT count(*), sum(id) FROM tests")
+        try:
+            h.FALLBACK_EVAL_BUDGET = 0.0  # everything counts as expensive
+            h.FALLBACK_MIN_INTERVAL = 0.05
+            ch = Change(
+                table="tests", pk=pack_columns((1,)), cid="text", val="x",
+                col_version=2, db_version=1, seq=0, site_id=bytes(16),
+                cl=1,
+            )
+            h.process([ch])  # initial sync pass flags the sub expensive
+            assert h._full_expensive
+            store.conn.execute("DELETE FROM tests WHERE id >= 50000")
+            store.conn.commit()
+            h.process([ch])  # within interval: defers
+            await asyncio.sleep(0.06)
+            # Overdue now: this call must hand off to the background scan
+            # and return immediately — bounded loop time even though the
+            # full evaluation scans 100k rows.
+            t0 = _time.monotonic()
+            out = h.process([ch])
+            took = _time.monotonic() - t0
+            assert out == [] and took < 0.05, (
+                f"process() stalled the loop for {took:.3f}s"
+            )
+            assert h._bg_task is not None
+
+            async def landed():
+                return any(
+                    ev.cells == [50000, 1249975000]
+                    for ev in list(h.history)
+                )
+
+            await poll_until(landed, timeout=10.0)
+        finally:
+            h.close()
+
+    run(main())
+    store.close()
+
+
 def test_graceful_leave_announces_down(tmp_path):
     """Clean shutdown announces DOWN immediately (foca.leave_cluster,
     broadcast/mod.rs:306): the survivor marks the peer down without
