@@ -171,14 +171,90 @@ def _command_complete(tag: str) -> bytes:
     return _msg(b"C", _cstr(tag))
 
 
-def _ready() -> bytes:
-    return _msg(b"Z", b"I")
+def _ready(status: bytes = b"I") -> bytes:
+    return _msg(b"Z", status)
+
+
+def _contains_write_tokens(sql: str) -> bool:
+    """Any write keyword as a real token (not inside strings/comments) —
+    the shape check for CTEs feeding writes (WITH ... INSERT ...), which
+    a head-word test misroutes to the read pool, bypassing version
+    assignment."""
+    return any(
+        t.kind == "ident"
+        and t.text.lower() in ("insert", "update", "delete", "replace")
+        for t in pgsql.tokenize(sql)
+    )
 
 
 def _is_query(sql: str) -> bool:
     head = sql.lstrip().split(None, 1)
     word = head[0].upper() if head else ""
-    return word in ("SELECT", "WITH", "EXPLAIN", "PRAGMA", "VALUES", "SHOW")
+    if word == "WITH":
+        return not _contains_write_tokens(sql)
+    return word in ("SELECT", "EXPLAIN", "PRAGMA", "VALUES", "SHOW")
+
+
+# Explicit-transaction control + features the server deliberately does
+# not speak (corro-pg supports txns, lib.rs:518-720; COPY/LISTEN have no
+# analogue here and must fail with a clean SQLSTATE instead of a parse
+# error deep in SQLite).
+_TXN_BEGIN = ("BEGIN", "START")
+_TXN_COMMIT = ("COMMIT", "END")
+_TXN_ROLLBACK = ("ROLLBACK", "ABORT")
+_UNSUPPORTED_WORDS = {
+    "COPY": "COPY is not supported",
+    "LISTEN": "LISTEN/NOTIFY is not supported",
+    "UNLISTEN": "LISTEN/NOTIFY is not supported",
+    "NOTIFY": "LISTEN/NOTIFY is not supported",
+    "DECLARE": "server-side cursors are not supported",
+    "FETCH": "server-side cursors are not supported",
+    "MOVE": "server-side cursors are not supported",
+}
+
+
+class _Txn:
+    """Per-connection explicit-transaction state.
+
+    Statements inside BEGIN..COMMIT queue up (validated with EXPLAIN at
+    queue time) and apply ATOMICALLY through one agent batch at COMMIT —
+    the agent's multi-statement execute is transactional end-to-end.
+    Divergences from a held server-side txn, documented: reads inside
+    the block see the pre-transaction snapshot (not own writes), and
+    runtime constraint violations surface at COMMIT rather than at the
+    offending statement. After any in-block error the connection enters
+    the failed state: every statement until ROLLBACK/COMMIT gets
+    SQLSTATE 25P02, and COMMIT of a failed block reports ROLLBACK —
+    exactly libpq's recovery flow."""
+
+    def __init__(self) -> None:
+        self.mode = "idle"  # idle | txn | failed
+        self.queue: list[Statement] = []
+        self.has_ddl = False  # queued DDL: later EXPLAIN probes can't see it
+
+    @property
+    def status(self) -> bytes:
+        return {"idle": b"I", "txn": b"T", "failed": b"E"}[self.mode]
+
+    def begin(self) -> None:
+        self.mode = "txn"
+        self.queue = []
+        self.has_ddl = False
+
+    def reset(self) -> None:
+        self.mode = "idle"
+        self.queue = []
+        self.has_ddl = False
+
+    def fail(self) -> None:
+        if self.mode == "txn":
+            self.mode = "failed"
+
+
+_ABORTED_MSG = (
+    "current transaction is aborted, commands ignored until end of "
+    "transaction block"
+)
 
 
 def translate_pg_sql(sql: str) -> str:
@@ -346,6 +422,7 @@ async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
     async def on_conn(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         prepared: dict[str, _Prepared] = {}
         portals: dict[str, _Portal] = {}
+        txn = _Txn()
         in_error = False  # extended-protocol error state: skip until Sync
         try:
             await _handshake(reader, writer)
@@ -367,11 +444,13 @@ async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
                     break
                 if tag == b"Q":
                     in_error = False
-                    await _simple_query(agent, writer, payload[:-1].decode())
+                    await _simple_query(
+                        agent, writer, payload[:-1].decode(), txn
+                    )
                 elif tag == b"S":  # Sync: end of extended batch
                     in_error = False
                     portals.clear()
-                    writer.write(_ready())
+                    writer.write(_ready(txn.status))
                 elif tag == b"H":  # Flush
                     pass
                 elif in_error:
@@ -379,19 +458,22 @@ async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
                 elif tag in (b"P", b"B", b"D", b"E", b"C"):
                     try:
                         await _extended(
-                            agent, writer, tag, payload, prepared, portals
+                            agent, writer, tag, payload, prepared,
+                            portals, txn,
                         )
                     except _PgError as e:
+                        txn.fail()
                         writer.write(_error(str(e), e.code))
                         in_error = True
                     except Exception as e:
+                        txn.fail()
                         writer.write(_error(str(e), sqlstate_for(str(e))))
                         in_error = True
                 else:
                     writer.write(
                         _error(f"unsupported message {tag!r}", "0A000")
                     )
-                    writer.write(_ready())
+                    writer.write(_ready(txn.status))
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
@@ -411,9 +493,15 @@ def _read_cstr(buf: bytes, off: int) -> tuple[str, int]:
 async def _extended(
     agent: "Agent", writer, tag: bytes, payload: bytes,
     prepared: dict[str, _Prepared], portals: dict[str, _Portal],
+    txn: _Txn,
 ) -> None:
     """One extended-protocol message (the pgwire flows of corro-pg's
     on_query/on_describe handlers, lib.rs:474-1769)."""
+    if txn.mode == "failed" and tag in (b"P", b"B", b"D"):
+        # An aborted transaction refuses Parse/Bind/Describe outright
+        # (real PostgreSQL performs no query work in this state;
+        # Describe(portal) here would otherwise execute the query).
+        raise _PgError(_ABORTED_MSG, "25P02")
     if tag == b"P":  # Parse: name, query, param oids
         name, off = _read_cstr(payload, 0)
         query, off = _read_cstr(payload, off)
@@ -505,9 +593,24 @@ async def _extended(
         portal = portals.get(name)
         if portal is None:
             raise _PgError(f"unknown portal {name!r}", "34000")
+        raw_word = _head_word(portal.prepared.raw)
+        if txn.mode == "failed" and raw_word not in (
+            *_TXN_COMMIT, *_TXN_ROLLBACK
+        ):
+            raise _PgError(_ABORTED_MSG, "25P02")
+        if raw_word in _UNSUPPORTED_WORDS:
+            raise _PgError(_UNSUPPORTED_WORDS[raw_word], "0A000")
+        if raw_word in (*_TXN_BEGIN, *_TXN_COMMIT, *_TXN_ROLLBACK):
+            await _txn_control(agent, writer, raw_word, txn)
+            return
         sql = portal.prepared.translated
         if not sql:
             writer.write(_command_complete("SET"))
+            return
+        if txn.mode == "txn" and not _is_query(sql):
+            writer.write(_command_complete(
+                _queue_deferred_write(agent, txn, sql, portal.params)
+            ))
             return
         if _is_query(sql):
             if portal.described is not None:
@@ -527,9 +630,9 @@ async def _extended(
             if bad:
                 raise _PgError(bad[0].error, sqlstate_for(bad[0].error))
             n = sum(r.rows_affected or 0 for r in resp.results)
-            word = sql.split(None, 1)[0].upper()
-            tag_word = f"INSERT 0 {n}" if word == "INSERT" else f"{word} {n}"
-            writer.write(_command_complete(tag_word))
+            writer.write(
+                _command_complete(_command_tag(_dml_word(sql), n, sql))
+            )
         return
 
     if tag == b"C":  # Close statement/portal
@@ -605,8 +708,11 @@ def _try_describe(agent: "Agent", stmt: _Prepared) -> list[str] | None:
             finally:
                 c.close()
         # Fresh connection: this probe runs in a to_thread worker, and the
-        # store's shared read_conn belongs to the event loop.
+        # store's shared read_conn belongs to the event loop. query_only
+        # makes the probe structurally incapable of executing a write
+        # smuggled through a shape the lexer missed.
         c = sqlite3.connect(agent.store.path)
+        c.execute("PRAGMA query_only=1")
         try:
             cur = c.execute(
                 f"SELECT * FROM ({stmt.translated}) LIMIT 0",
@@ -645,34 +751,170 @@ def _split_statements(sql: str) -> list[str]:
     return pgsql.split_statements(sql)
 
 
-async def _simple_query(agent: "Agent", writer, sql: str) -> None:
+def _head_word(sql: str) -> str:
+    head = sql.lstrip().split(None, 1)
+    return head[0].upper().rstrip(";") if head else ""
+
+
+def _nominal_insert_count(sql: str) -> int:
+    """Rows a queued `INSERT ... VALUES (...), (...)` will insert — the
+    CommandComplete tag for deferred in-transaction writes. Shapes whose
+    count depends on data (INSERT .. SELECT) report 0 ("unknown") rather
+    than asserting a false exact count."""
+    toks = pgsql.tokenize(sql)
+    depth = 0
+    groups = 0
+    seen_values = False
+    for t in toks:
+        if t.kind == "ident" and t.text.lower() == "values" and depth == 0:
+            seen_values = True
+        elif t.text == "(":
+            if depth == 0 and seen_values:
+                groups += 1
+            depth += 1
+        elif t.text == ")":
+            depth -= 1
+    return groups
+
+
+def _dml_word(sql: str) -> str:
+    """The top-level DML verb for the CommandComplete tag: a WITH-headed
+    write reports its underlying INSERT/UPDATE/DELETE like PostgreSQL."""
+    word = sql.split(None, 1)[0].upper() if sql.split(None, 1) else ""
+    if word != "WITH":
+        return word
+    for t in pgsql.tokenize(sql):
+        if t.kind == "ident" and t.text.lower() in (
+            "insert", "update", "delete", "replace"
+        ):
+            return t.text.upper()
+    return word
+
+
+def _command_tag(word: str, n: int, sql: str = "") -> str:
+    if word in ("CREATE", "DROP", "ALTER"):
+        # DDL tags carry the object kind, never a count ("CREATE TABLE").
+        parts = sql.split(None, 2)
+        kind = parts[1].upper() if len(parts) > 1 else "TABLE"
+        return f"{word} {kind}"
+    return f"INSERT 0 {n}" if word == "INSERT" else f"{word} {n}"
+
+
+def _queue_deferred_write(
+    agent: "Agent", txn: _Txn, sql: str, params=None
+) -> str:
+    """Validate (when the schema is still probeable) + queue a write for
+    the COMMIT batch; returns the CommandComplete tag."""
+    word = _dml_word(sql)
+    head = sql.split(None, 1)[0].upper() if sql.split(None, 1) else ""
+    is_ddl = head in ("CREATE", "ALTER", "DROP")
+    if not txn.has_ddl:
+        # EXPLAIN sees the pre-transaction schema: once the block queued
+        # DDL, later statements may legitimately reference it — defer
+        # ALL their errors to COMMIT instead of spuriously failing the
+        # standard migration pattern (CREATE TABLE; INSERT INTO it).
+        _validate_statement(agent, sql)
+    if is_ddl:
+        txn.has_ddl = True
+    txn.queue.append(Statement(sql, params=params))
+    n = _nominal_insert_count(sql) if word == "INSERT" else 0
+    return _command_tag(word, n, sql)
+
+
+async def _txn_control(
+    agent: "Agent", writer, word: str, txn: _Txn
+) -> None:
+    if word in _TXN_BEGIN:
+        if txn.mode == "idle":
+            txn.begin()
+        writer.write(_command_complete("BEGIN"))
+        return
+    if word in _TXN_ROLLBACK:
+        txn.reset()
+        writer.write(_command_complete("ROLLBACK"))
+        return
+    # COMMIT/END: a failed block rolls back (libpq's recovery flow).
+    if txn.mode == "failed":
+        txn.reset()
+        writer.write(_command_complete("ROLLBACK"))
+        return
+    queued, txn.queue = txn.queue, []
+    txn.mode = "idle"
+    if queued:
+        resp = await agent.execute_async(queued)
+        err = next((r.error for r in resp.results if r.error), None)
+        if err:
+            raise _PgError(err, sqlstate_for(err))
+    writer.write(_command_complete("COMMIT"))
+
+
+async def _one_statement(
+    agent: "Agent", writer, part: str, txn: _Txn
+) -> None:
+    """Execute one statement under the connection's transaction state.
+    Raises _PgError on failure (caller marks the txn failed)."""
+    word = _head_word(part)
+    if txn.mode == "failed" and word not in (
+        *_TXN_COMMIT, *_TXN_ROLLBACK
+    ):
+        raise _PgError(_ABORTED_MSG, "25P02")
+    if word in _UNSUPPORTED_WORDS:
+        raise _PgError(_UNSUPPORTED_WORDS[word], "0A000")
+    if word in (*_TXN_BEGIN, *_TXN_COMMIT, *_TXN_ROLLBACK):
+        await _txn_control(agent, writer, word, txn)
+        return
+    translated = translate_pg_sql(part)
+    if not translated:
+        writer.write(_command_complete("SET"))
+        return
+    if _is_query(translated):
+        cols, rows = await _run_query(agent, translated)
+        writer.write(_row_description(cols, _infer_oids(rows, len(cols))))
+        for row in rows:
+            writer.write(_data_row(row))
+        writer.write(_command_complete(f"SELECT {len(rows)}"))
+        return
+    if txn.mode == "txn":
+        # Deferred write: prepare-time errors fail the block at the
+        # offending statement; application is atomic at COMMIT.
+        writer.write(_command_complete(
+            _queue_deferred_write(agent, txn, translated)
+        ))
+        return
+    resp = await agent.execute_async([Statement(translated)])
+    err = next((r.error for r in resp.results if r.error), None)
+    if err:
+        raise _PgError(err, sqlstate_for(err))
+    n = sum(r.rows_affected for r in resp.results)
+    writer.write(
+        _command_complete(_command_tag(_dml_word(translated), n, translated))
+    )
+
+
+def _validate_statement(agent: "Agent", sql: str) -> None:
+    """Prepare (EXPLAIN) without executing: syntax + schema errors
+    surface at queue time; runtime constraint violations defer to
+    COMMIT (documented divergence of the deferred-batch txn)."""
+    import sqlite3 as _sq
+
+    try:
+        agent.store.read_conn.execute(f"EXPLAIN {sql}")
+    except _sq.Error as e:
+        raise _PgError(str(e), sqlstate_for(str(e)))
+
+
+async def _simple_query(
+    agent: "Agent", writer, sql: str, txn: _Txn
+) -> None:
     for part in _split_statements(sql):
-        translated = translate_pg_sql(part)
-        if not translated:
-            writer.write(_command_complete("SET"))
-            continue
         try:
-            if _is_query(translated):
-                cols, rows = await _run_query(agent, translated)
-                writer.write(
-                    _row_description(cols, _infer_oids(rows, len(cols)))
-                )
-                for row in rows:
-                    writer.write(_data_row(row))
-                writer.write(_command_complete(f"SELECT {len(rows)}"))
-            else:
-                resp = await agent.execute_async([Statement(translated)])
-                err = next((r.error for r in resp.results if r.error), None)
-                if err:
-                    raise _PgError(err, sqlstate_for(err))
-                n = sum(r.rows_affected for r in resp.results)
-                word = translated.split(None, 1)[0].upper()
-                tag = f"INSERT 0 {n}" if word == "INSERT" else f"{word} {n}"
-                writer.write(_command_complete(tag))
+            await _one_statement(agent, writer, part, txn)
         except _PgError as e:
+            txn.fail()
             writer.write(_error(str(e), e.code))
             break
         except Exception as e:
+            txn.fail()
             writer.write(_error(str(e), sqlstate_for(str(e))))
             break
-    writer.write(_ready())
+    writer.write(_ready(txn.status))
