@@ -1646,18 +1646,35 @@ class Agent:
                 served += 1
         elif isinstance(need, PartialNeed):
             known = booked.get(need.version)
-            if not isinstance(known, Partial):
+            if isinstance(known, Partial):
+                # Read connection (not the writer): the pool's writer
+                # thread may hold an open BEGIN IMMEDIATE on store.conn,
+                # and this read runs on the event loop — same discipline
+                # as changes_for.
+                rows = self.store.read_conn.execute(
+                    "SELECT tbl, pk, cid, val, col_version, db_version,"
+                    " seq, site_id, cl FROM __corro_buffered_changes"
+                    " WHERE actor_id = ? AND version = ? ORDER BY seq",
+                    (bytes.fromhex(actor), need.version),
+                ).fetchall()
+                by_seq = {r[6]: Change.from_tuple(tuple(r)) for r in rows}
+                last_seq, ts = known.last_seq, known.ts
+            elif isinstance(known, Current):
+                # The version is COMPLETE here: a partial need must still
+                # be answerable (sync.rs:248-266 — the requester's gaps
+                # came from lossy dissemination; holders of the applied
+                # version are exactly who can fill them). Without this
+                # branch a node whose partial buffer lost chunks stalls
+                # FOREVER once every peer has compacted the version to
+                # Current (measured: a 2-node catch-up wedged at
+                # 39/40 versions permanently).
+                changes = self.store.changes_for(
+                    bytes.fromhex(actor), known.db_version
+                )
+                by_seq = {c.seq: c for c in changes}
+                last_seq, ts = known.last_seq, known.ts
+            else:
                 return 0
-            # Read connection (not the writer): the pool's writer thread may
-            # hold an open BEGIN IMMEDIATE on store.conn, and this read runs
-            # on the event loop — same discipline as changes_for.
-            rows = self.store.read_conn.execute(
-                "SELECT tbl, pk, cid, val, col_version, db_version, seq,"
-                " site_id, cl FROM __corro_buffered_changes"
-                " WHERE actor_id = ? AND version = ? ORDER BY seq",
-                (bytes.fromhex(actor), need.version),
-            ).fetchall()
-            by_seq = {r[6]: Change.from_tuple(tuple(r)) for r in rows}
             for s, e in need.seqs:
                 have = [by_seq[q] for q in range(s, e + 1) if q in by_seq]
                 if not have:
@@ -1668,7 +1685,7 @@ class Agent:
                     session,
                     self._sync_changes_frame(
                         actor, need.version, have, (lo, hi),
-                        known.last_seq, known.ts,
+                        last_seq, ts,
                     ),
                     chunker,
                 )

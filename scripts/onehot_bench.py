@@ -1,0 +1,107 @@
+"""Micro-harness for the one-hot primitive variants at data-plane shapes.
+
+Times rowmax/rowgather/rowsum at the broadcast plane's real shapes —
+inside a scanned loop so per-call dispatch does not pollute the numbers
+(memory: isolated microbenches LIE on axon) — for:
+
+- the jnp minor-most-reduce forms (production default),
+- the Pallas VMEM-tiled kernels (CORRO_ONEHOT_PALLAS=1 route),
+
+at both the wan_100k (W=512, M=144) and anywrite_sparse (W=2048, M=320)
+operating points. Feeds the SCALING.md roofline iteration (VERDICT r4
+next #3). Usage: python scripts/onehot_bench.py [rows]
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def time_scanned(fn, args, iters=20):
+    @partial(jax.jit, static_argnames=("n",))
+    def scan(args, n):
+        def body(c, _):
+            out = fn(*c)
+            # Fold the output back into a carry input so the loop cannot
+            # be collapsed; idx/val stay constant.
+            idx, val, mask, table = c[0], c[1], c[2], c[3]
+            table = table ^ out
+            return (idx, val, mask, table), ()
+
+        c, _ = jax.lax.scan(body, args, None, length=n)
+        return c
+
+    out = scan(args, iters)
+    jax.block_until_ready(jax.tree.leaves(out))
+    t0 = time.perf_counter()
+    out = scan(args, iters)
+    jax.block_until_ready(jax.tree.leaves(out))
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
+def main():
+    from corrosion_tpu.ops import onehot
+    from corrosion_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    results = {}
+    for w, m in ((512, 144), (2048, 320)):
+        key = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(key)
+        idx = jax.random.randint(k1, (rows, m), 0, w)
+        val = jax.random.randint(k2, (rows, m), 0, 1 << 20).astype(
+            jnp.uint32
+        )
+        mask = idx < (w - 1)
+        table = jnp.zeros((rows, w), jnp.uint32)
+
+        def f_rowmax(idx, val, mask, table):
+            return onehot.rowmax(idx, val, mask, w) | table * 0
+
+        def f_rowsum(idx, val, mask, table):
+            return onehot.rowsum(idx, val, mask, w) | table * 0
+
+        args = (idx, val, mask, table)
+        for name, f in (("rowmax", f_rowmax), ("rowsum", f_rowsum)):
+            ms = time_scanned(f, args)
+            results[f"{name}_w{w}_m{m}"] = round(ms, 2)
+
+        def f_gather(idx, val, mask, table):
+            g = onehot.rowgather(table, idx)
+            return (
+                jnp.zeros((rows, w), jnp.uint32)
+                .at[:, 0]
+                .set(g.sum(axis=1, dtype=jnp.uint32))
+            )
+
+        results[f"rowgather_w{w}_m{m}"] = round(
+            time_scanned(f_gather, args), 2
+        )
+        def f_gather_wide(idx, val, mask, table):
+            g = onehot.rowgather_wide(table, idx)
+            return (
+                jnp.zeros((rows, w), jnp.uint32)
+                .at[:, 0]
+                .set(g.sum(axis=1, dtype=jnp.uint32))
+            )
+
+        results[f"rowgather_wide_w{w}_m{m}"] = round(
+            time_scanned(f_gather_wide, args), 2
+        )
+    results["pallas"] = _os.environ.get("CORRO_ONEHOT_PALLAS", "0")
+    results["rows"] = rows
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

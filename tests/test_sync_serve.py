@@ -154,3 +154,40 @@ def test_serve_partial_need_serves_buffered_seq_ranges(tmp_path):
         assert run(main(PartialNeed(99, [(0, 1)]))) == []
     finally:
         a.store.close()
+
+
+def test_serve_partial_need_from_current_version(tmp_path):
+    """A partial need against a holder of the COMPLETE version must be
+    served from the applied changeset (sync.rs:248-266): the requester's
+    gaps came from lossy dissemination, and once every peer compacted
+    the version to Current, a Partial-only server would strand it
+    forever (regression: a 2-node catch-up wedged at 39/40 versions
+    permanently until this branch existed)."""
+    a = make_agent(tmp_path)
+    try:
+        a.execute(
+            [Statement(
+                "INSERT INTO tests (id, text) VALUES (1, 'a'), (2, 'b'),"
+                " (3, 'c')"
+            )]
+        )
+        booked = a.bookie.for_actor(a.actor_id)
+        known = booked.get(1)
+        assert isinstance(known, Current) and known.last_seq == 2
+
+        async def main(need):
+            s = FakeSession()
+            await a._serve_need(s, a.actor_id, booked, need)
+            return s.frames
+
+        # The requester holds seq 0 and lacks 1..2.
+        frames = run(main(PartialNeed(1, [(1, 2)])))
+        assert [f["t"] for f in frames] == ["sync_changes"]
+        assert frames[0]["version"] == 1
+        assert frames[0]["seqs"] == [1, 2]
+        assert frames[0]["last_seq"] == 2
+        assert [c[6] for c in frames[0]["changes"]] == [1, 2]
+        # Ranges beyond the version's seqs serve nothing.
+        assert run(main(PartialNeed(1, [(5, 9)]))) == []
+    finally:
+        a.store.close()
