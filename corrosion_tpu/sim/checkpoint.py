@@ -96,3 +96,68 @@ def load_schedule(path: str) -> Schedule:
             sample_ver=data["sample_ver"],
             sample_round=data["sample_round"],
         )
+
+
+# -- sparse-engine resume snapshots -------------------------------------------
+
+
+def save_sparse_resume(path: str, resume: dict) -> None:
+    """Persist a sim.sparse_engine resume point (device trees + host
+    planner) — the sparse plane's checkpoint/resume analogue."""
+    tree = (resume["sstate"], resume["swim"], resume["vis_round"])
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    arrays = {
+        f"leaf{idx}": np.asarray(leaf)
+        for idx, (_, leaf) in enumerate(leaves_with_paths)
+    }
+    arrays["__paths__"] = np.array(json.dumps(_paths(tree)).encode())
+    for k, v in resume["planner"].items():
+        arrays[f"planner_{k}"] = np.asarray(v)
+    arrays["next_epoch"] = np.asarray(int(resume["next_epoch"]))
+    np.savez_compressed(path, **arrays)
+
+
+def load_sparse_resume(path: str, cfg, n_samples: int) -> dict:
+    """Load a resume point for the given SparseClusterConfig; structure
+    and shapes are checked against the config like load_state."""
+    from corrosion_tpu.ops import sparse_writers as sw_ops
+    from corrosion_tpu.ops import swim as swim_ops
+
+    template = (
+        sw_ops.init_sparse(cfg.gossip, cfg.sparse),
+        swim_ops.impl(cfg.swim).init_state(cfg.swim),
+        np.zeros((n_samples, cfg.n_nodes), np.int32),
+    )
+    with np.load(path) as data:
+        saved_paths = json.loads(bytes(data["__paths__"].item()).decode())
+        tmpl_paths = _paths(template)
+        if saved_paths != tmpl_paths:
+            raise ValueError(
+                "sparse resume structure does not match the config "
+                f"(saved {len(saved_paths)} leaves, config implies "
+                f"{len(tmpl_paths)})"
+            )
+        leaves = []
+        for idx, (tmpl_leaf, p) in enumerate(
+            zip(jax.tree.leaves(template), tmpl_paths)
+        ):
+            arr = data[f"leaf{idx}"]
+            if arr.shape != np.asarray(tmpl_leaf).shape:
+                raise ValueError(
+                    f"sparse resume leaf {p} has shape {arr.shape}, "
+                    f"config implies {np.asarray(tmpl_leaf).shape}"
+                )
+            leaves.append(arr)
+        treedef = jax.tree.structure(template)
+        sstate, swim_state, vis_round = jax.tree.unflatten(treedef, leaves)
+        planner = {
+            k[len("planner_"):]: data[k]
+            for k in data.files if k.startswith("planner_")
+        }
+        return {
+            "sstate": sstate,
+            "swim": swim_state,
+            "vis_round": vis_round,
+            "planner": planner,
+            "next_epoch": int(data["next_epoch"]),
+        }

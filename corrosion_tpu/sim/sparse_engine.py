@@ -199,6 +199,21 @@ class _Planner:
         wid = np.maximum(self.writer_of, 0).astype(np.uint32)
         return wn, won, wid
 
+    def snapshot(self) -> dict:
+        """Host planner state for checkpoint/resume (sim/checkpoint.py)."""
+        return {
+            "slot_of": self.slot_of.copy(),
+            "writer_of": self.writer_of.copy(),
+            "last_active": self.last_active.copy(),
+            "free": np.asarray(self.free, np.int32),
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.slot_of = np.asarray(snap["slot_of"], np.int32).copy()
+        self.writer_of = np.asarray(snap["writer_of"], np.int32).copy()
+        self.last_active = np.asarray(snap["last_active"], np.int64).copy()
+        self.free = [int(x) for x in snap["free"]]
+
 
 @partial(jax.jit, static_argnames=("cfg", "sp", "has_churn"))
 def _epoch_scan(
@@ -305,9 +320,16 @@ def simulate_sparse(
     topo_base: Topology,
     schedule: Schedule,  # writes [rounds, N] — every node may write
     seed: int = 0,
+    resume: dict | None = None,
+    stop_after_epoch: int | None = None,
 ):
     """Run the epoch-rotated any-node-writes simulation. Returns
-    (final_sparse_state, swim_state, vis_round, curves, info)."""
+    (final_sparse_state, swim_state, vis_round, curves, info).
+
+    ``resume`` (from ``make_resume``) continues a previous run from its
+    next epoch: device state + host planner snapshot + epoch cursor. The
+    per-round RNG folds the absolute round index, so save/resume is
+    bit-identical to an uninterrupted run (tests assert it)."""
     sp = cfg.sparse
     n = cfg.n_nodes
     rounds = schedule.rounds
@@ -325,6 +347,13 @@ def simulate_sparse(
     swim_state = swim_ops.impl(cfg.swim).init_state(cfg.swim)
     n_samples = len(schedule.sample_writer)
     vis_round = jnp.full((n_samples, n), -1, jnp.int32)
+    start_epoch = 0
+    if resume is not None:
+        planner.restore(resume["planner"])
+        sstate = resume["sstate"]
+        swim_state = resume["swim"]
+        vis_round = resume["vis_round"]
+        start_epoch = int(resume["next_epoch"])
     s_writer = jnp.asarray(schedule.sample_writer)
     s_ver = jnp.asarray(schedule.sample_ver)
     s_round_np = schedule.sample_round
@@ -340,7 +369,7 @@ def simulate_sparse(
     curve_parts = []
     info = {"epochs": 0, "retired": 0, "promoted": 0, "dev_dropped": 0,
             "max_dev_entries": 0}
-    for e0 in range(0, rounds, e_len):
+    for e0 in range(start_epoch * e_len, rounds, e_len):
         e1 = min(e0 + e_len, rounds)
         epoch = e0 // e_len
         w_ep = schedule.writes[e0:e1]
@@ -414,10 +443,19 @@ def simulate_sparse(
                 sstate, vis_round, s_writer, s_ver, s_cold,
                 jnp.int32(e1 - 1),
             )
+        if stop_after_epoch is not None and epoch >= stop_after_epoch:
+            break
 
     merged = {
         k: np.concatenate([p[k] for p in curve_parts])
         for k in curve_parts[0]
+    }
+    info["resume"] = {
+        "planner": planner.snapshot(),
+        "sstate": sstate,
+        "swim": swim_state,
+        "vis_round": vis_round,
+        "next_epoch": info["epochs"] + start_epoch,
     }
     return sstate, swim_state, vis_round, merged, info
 

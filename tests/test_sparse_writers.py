@@ -342,3 +342,37 @@ def test_sparse_engine_with_churn():
     # Visibility: only pairs where the observer was dead at commit may
     # resolve late; all must resolve by the end.
     assert int((np.asarray(vis_round) < 0).sum()) == 0
+
+
+def test_sparse_checkpoint_resume_bit_identical(tmp_path):
+    """Save after 3 epochs, reload, run the rest: bit-identical to the
+    uninterrupted run (the sparse plane's checkpoint/resume parity —
+    sim/checkpoint.py save/load_sparse_resume)."""
+    from corrosion_tpu.sim import checkpoint
+
+    cfg, topo, sched = _small(rounds=48)
+    full = sparse_engine.simulate_sparse(cfg, topo, sched, seed=5)
+
+    part1 = sparse_engine.simulate_sparse(
+        cfg, topo, sched, seed=5, stop_after_epoch=2
+    )
+    p = str(tmp_path / "sparse.npz")
+    checkpoint.save_sparse_resume(p, part1[4]["resume"])
+    resume = checkpoint.load_sparse_resume(
+        p, cfg, len(sched.sample_writer)
+    )
+    part2 = sparse_engine.simulate_sparse(
+        cfg, topo, sched, seed=5, resume=resume
+    )
+    assert (
+        np.asarray(full[0].data.contig)
+        == np.asarray(part2[0].data.contig)
+    ).all()
+    assert (
+        np.asarray(full[0].data.cells.cl)
+        == np.asarray(part2[0].data.cells.cl)
+    ).all()
+    assert (
+        np.asarray(full[0].head_full) == np.asarray(part2[0].head_full)
+    ).all()
+    assert (np.asarray(full[2]) == np.asarray(part2[2])).all()  # vis
