@@ -49,8 +49,12 @@ def _time_plane(step, carry, iters=10):
 
 
 def main() -> None:
-    from corrosion_tpu.utils.cache import enable_persistent_cache
+    from corrosion_tpu.utils.cache import (
+        enable_persistent_cache,
+        ensure_live_backend,
+    )
 
+    ensure_live_backend()  # dead tunnel → CPU smoke, never a hang
     enable_persistent_cache()
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)

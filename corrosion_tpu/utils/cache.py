@@ -39,3 +39,44 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         return cand
     return None  # no writable location: run uncached
+
+
+def ensure_live_backend(timeout_s: float = 120.0) -> str:
+    """Fall back to CPU when the accelerator tunnel is unreachable.
+
+    The axon relay can die out from under the session (observed: the
+    terminal-side service at 127.0.0.1:8083 stops listening), and
+    ``jax.devices()`` then HANGS instead of raising — wedging any
+    measurement script and the driver's bench run with it. Probe backend
+    init in a SUBPROCESS (which inherits the same sitecustomize) under a
+    timeout, and pin the platform to CPU before this process touches a
+    backend when the probe fails. Returns the platform decision.
+
+    Call BEFORE the first jax.devices()/jit in entry-point scripts; a
+    healthy tunnel costs one subprocess backend init (~seconds)."""
+    import jax
+
+    plats = jax.config.jax_platforms or ""
+    if plats and "axon" not in plats:
+        return plats
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        ok = r.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        jax.config.update("jax_platforms", "cpu")
+        print(
+            "[cache] accelerator tunnel unreachable - falling back to "
+            "CPU for this run",
+            file=__import__("sys").stderr,
+        )
+        return "cpu-fallback"
+    return "axon"

@@ -50,8 +50,12 @@ def time_scanned(fn, args, iters=20):
 
 def main():
     from corrosion_tpu.ops import onehot
-    from corrosion_tpu.utils.cache import enable_persistent_cache
+    from corrosion_tpu.utils.cache import (
+        enable_persistent_cache,
+        ensure_live_backend,
+    )
 
+    ensure_live_backend()
     enable_persistent_cache()
     rows = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     results = {}

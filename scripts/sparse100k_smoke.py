@@ -26,8 +26,12 @@ from corrosion_tpu.sim import sparse_engine
 
 
 def main() -> None:
-    from corrosion_tpu.utils.cache import enable_persistent_cache
+    from corrosion_tpu.utils.cache import (
+        enable_persistent_cache,
+        ensure_live_backend,
+    )
 
+    ensure_live_backend()
     enable_persistent_cache()
     nums = [a for a in sys.argv[1:] if not a.startswith("-")]
     rounds = int(nums[0]) if nums else 240

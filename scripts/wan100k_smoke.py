@@ -35,8 +35,12 @@ CHUNK = 16
 
 
 def main() -> None:
-    from corrosion_tpu.utils.cache import enable_persistent_cache
+    from corrosion_tpu.utils.cache import (
+        enable_persistent_cache,
+        ensure_live_backend,
+    )
 
+    ensure_live_backend()
     enable_persistent_cache()
     steady = "--steady" in sys.argv  # no partition: pure propagation p99
     steptime = "--steptime" in sys.argv  # warm-chunk step timing only
