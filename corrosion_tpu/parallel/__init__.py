@@ -12,5 +12,6 @@ from corrosion_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     make_wan_mesh,
     shard_cluster_state,
+    shard_sparse_state,
     shard_topology,
 )

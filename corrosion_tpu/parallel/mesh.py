@@ -123,3 +123,37 @@ def shard_cluster_state(
         round=_put(state.round, mesh, rep),
         vis_round=_put(state.vis_round, mesh, P(None, axis)),
     )
+
+
+def shard_sparse_state(sstate, mesh: Mesh, axis=None):
+    """NamedSharding placement for the sparse writer plane
+    (ops/sparse_writers.SparseState): node-major tensors shard like the
+    dense plane; slot-indexed vectors replicate (slots are global
+    metadata, a few KB)."""
+    from corrosion_tpu.ops.sparse_writers import SparseState
+
+    axis = _node_axis(mesh, axis)
+    row = P(axis, None)
+    vec = P(axis)
+    rep = P()
+    d = sstate.data
+    d = DataState(
+        head=_put(d.head, mesh, rep),
+        contig=_put(d.contig, mesh, row),
+        seen=_put(d.seen, mesh, row),
+        oo=_put(d.oo, mesh, P(None, axis, None)),
+        oo_any=_put(d.oo_any, mesh, rep),
+        q_writer=_put(d.q_writer, mesh, row),
+        q_ver=_put(d.q_ver, mesh, row),
+        q_tx=_put(d.q_tx, mesh, row),
+        q_gw=_put(d.q_gw, mesh, row),
+        cells=jax.tree.map(lambda a: _put(a, mesh, vec), d.cells),
+    )
+    return SparseState(
+        data=d,
+        head_full=_put(sstate.head_full, mesh, vec),
+        slot_writer=_put(sstate.slot_writer, mesh, rep),
+        dev_writer=_put(sstate.dev_writer, mesh, row),
+        dev_contig=_put(sstate.dev_contig, mesh, row),
+        dev_any=_put(sstate.dev_any, mesh, rep),
+    )

@@ -315,6 +315,19 @@ def _cold_vis_update(
     )
 
 
+def initial_resume(cfg: SparseClusterConfig, n_samples: int) -> dict:
+    """An epoch-0 resume point: lets callers place/shard the device
+    arrays (parallel/mesh.shard_sparse_state) before the run starts."""
+    planner = _Planner(cfg.n_nodes, cfg.w_hot, cfg.sparse)
+    return {
+        "planner": planner.snapshot(),
+        "sstate": sw_ops.init_sparse(cfg.gossip, cfg.sparse),
+        "swim": swim_ops.impl(cfg.swim).init_state(cfg.swim),
+        "vis_round": jnp.full((n_samples, cfg.n_nodes), -1, jnp.int32),
+        "next_epoch": 0,
+    }
+
+
 def simulate_sparse(
     cfg: SparseClusterConfig,
     topo_base: Topology,

@@ -119,3 +119,60 @@ def test_sharded_state_is_actually_distributed():
     # The cell plane shards on the flat node-major axis too.
     cell_shards = {s.data.shape for s in state0.data.cells.cl.addressable_shards}
     assert cell_shards == {(64 * 256 // 8,)}
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sparse_plane_sharded_bit_identical():
+    """The round-5 sparse writer plane (rotation + deviation tables +
+    cold sync) under the node-sharded mesh placement: bit-identical to
+    the unsharded run, including a forced-demotion scenario so the
+    deviation machinery runs sharded too."""
+    from corrosion_tpu.models.baselines import anywrite_sparse
+    from corrosion_tpu.sim import sparse_engine
+
+    cfg, topo, sched = anywrite_sparse(
+        n=64, w_hot=8, rounds=48, n_regions=4, epoch_rounds=8,
+        cohort=4, burst_writes=2, samples=32, k_dev=16, partition=True,
+    )
+    final_u = sparse_engine.simulate_sparse(cfg, topo, sched, seed=2)
+
+    mesh = parallel.make_mesh(8)
+    resume = sparse_engine.initial_resume(cfg, len(sched.sample_writer))
+    resume["sstate"] = parallel.shard_sparse_state(resume["sstate"], mesh)
+    resume["swim"] = jax.tree.map(
+        lambda x: jax.device_put(
+            x,
+            jax.sharding.NamedSharding(
+                mesh,
+                jax.sharding.PartitionSpec(
+                    "nodes", *([None] * (x.ndim - 1))
+                ),
+            ),
+        ),
+        resume["swim"],
+    )
+    topo_s = parallel.shard_topology(topo, mesh)
+    final_s = sparse_engine.simulate_sparse(
+        cfg, topo_s, sched, seed=2, resume=resume
+    )
+    for name in ("head", "contig", "seen"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(final_u[0].data, name)),
+            np.asarray(getattr(final_s[0].data, name)),
+            err_msg=name,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(final_u[0].head_full), np.asarray(final_s[0].head_full)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(final_u[0].dev_writer), np.asarray(final_s[0].dev_writer)
+    )
+    for name in ("cl", "col_version", "value_rank"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(final_u[0].data.cells, name)),
+            np.asarray(getattr(final_s[0].data.cells, name)),
+            err_msg=f"cells.{name}",
+        )
+    np.testing.assert_array_equal(
+        np.asarray(final_u[2]), np.asarray(final_s[2])
+    )
