@@ -120,6 +120,19 @@ _WRITE_ACTIONS = {
 }
 
 
+def _clear_authorizer(conn: sqlite3.Connection) -> None:
+    """``set_authorizer(None)`` only uninstalls the hook on Python >= 3.11
+    (gh-90732); on older runtimes it is a silent no-op and the deny hook
+    would poison every later statement on the connection ("not
+    authorized") — overwrite with an allow-all hook instead."""
+    import sys
+
+    if sys.version_info >= (3, 11):
+        conn.set_authorizer(None)
+    else:
+        conn.set_authorizer(lambda *_: sqlite3.SQLITE_OK)
+
+
 def _referenced_tables(conn: sqlite3.Connection, sql: str) -> set[str]:
     """Tables a SELECT reads, via the authorizer hook during prepare.
     Rejects anything that would write — subscriptions are SELECT-only
@@ -143,7 +156,7 @@ def _referenced_tables(conn: sqlite3.Connection, sql: str) -> set[str]:
             raise ValueError("subscriptions must be SELECT statements") from e
         raise
     finally:
-        conn.set_authorizer(None)
+        _clear_authorizer(conn)
     if writes:
         raise ValueError("subscriptions must be SELECT statements")
     return {t for t in seen if not t.startswith("__")}

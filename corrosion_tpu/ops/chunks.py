@@ -102,66 +102,94 @@ def chunk_round(
     has_any = jnp.any(live, axis=1)  # bool[rows]
 
     # ---- 1. epidemic chunk send: random covered sub-range to f targets ----
-    tgt = jax.random.randint(k_tgt, (rows, f), 0, n)  # receiver node
-    u = jax.random.uniform(k_slot, (rows, f, cfg.cap))
-    scores = jnp.where(live[:, None, :], u, -1.0)
-    slot = jnp.argmax(scores, axis=-1)  # [rows, f]
-    ss = jnp.take_along_axis(have.starts, slot, axis=1)
-    se = jnp.take_along_axis(have.ends, slot, axis=1)
-    span = jnp.maximum(se - ss + 1, 1)
-    pos = ss + jax.random.randint(k_pos, (rows, f), 0, 1 << 30) % span
-    ce = jnp.minimum(pos + cfg.chunk_len - 1, se)
-    lost = jax.random.uniform(k_loss, (rows, f)) < cfg.loss_prob
-    ok = (
-        has_any[:, None]
-        & alive[row_node][:, None]
-        & alive[tgt]
-        & (tgt != row_node[:, None])
-        & ~lost
-    )
+    with jax.named_scope("corro_broadcast"):
+        tgt = jax.random.randint(k_tgt, (rows, f), 0, n)  # receiver node
+        u = jax.random.uniform(k_slot, (rows, f, cfg.cap))
+        scores = jnp.where(live[:, None, :], u, -1.0)
+        slot = jnp.argmax(scores, axis=-1)  # [rows, f]
+        ss = jnp.take_along_axis(have.starts, slot, axis=1)
+        se = jnp.take_along_axis(have.ends, slot, axis=1)
+        span = jnp.maximum(se - ss + 1, 1)
+        pos = ss + jax.random.randint(k_pos, (rows, f), 0, 1 << 30) % span
+        ce = jnp.minimum(pos + cfg.chunk_len - 1, se)
+        lost = jax.random.uniform(k_loss, (rows, f)) < cfg.loss_prob
+        ok = (
+            has_any[:, None]
+            & alive[row_node][:, None]
+            & alive[tgt]
+            & (tgt != row_node[:, None])
+            & ~lost
+        )
 
-    m_row = (tgt * s_count + row_stream[:, None]).reshape(-1)
-    in_mask, (in_s, in_e) = routing.bounded_intake(
-        m_row, ok.reshape(-1), (pos.reshape(-1), ce.reshape(-1)), rows, cfg.k_in
-    )
-    for j in range(cfg.k_in):
-        inserted = _v_insert(have, in_s[:, j], in_e[:, j])
-        have = _select(in_mask[:, j], inserted, have)
+        m_row = (tgt * s_count + row_stream[:, None]).reshape(-1)
+        in_mask, (in_s, in_e) = routing.bounded_intake(
+            m_row, ok.reshape(-1), (pos.reshape(-1), ce.reshape(-1)), rows,
+            cfg.k_in,
+        )
+        for j in range(cfg.k_in):
+            inserted = _v_insert(have, in_s[:, j], in_e[:, j])
+            have = _select(in_mask[:, j], inserted, have)
 
     # ---- 2. partial-need sync (SyncNeedV1::Partial analogue) --------------
-    phase = (row_node * jnp.int32(40503)) % jnp.int32(cfg.sync_interval)
-    due = (
-        alive[row_node]
-        & ((round_idx + phase) % jnp.int32(cfg.sync_interval) == 0)
-    )
-    peer = jax.random.randint(k_peer, (n,), 0, n)
-    peer_ok = alive[peer] & (peer != jnp.arange(n))
-    p_row = peer[row_node] * s_count + row_stream
-    gaps = _v_gaps(have, jnp.zeros((rows,), jnp.int32), row_last)
-    ps, pe = have.starts[p_row], have.ends[p_row]
-    p_live = ps <= pe
-    budget_left = jnp.full((rows,), cfg.sync_seq_budget, jnp.int32)
-    granted = jnp.zeros((rows,), jnp.int32)
-    for g in range(cfg.gap_requests):
-        gs, ge = gaps.starts[:, g], gaps.ends[:, g]
-        valid_gap = gs <= ge
-        overlap = p_live & (ps <= ge[:, None]) & (pe >= gs[:, None])
-        any_ov = jnp.any(overlap, axis=1)
-        idx = jnp.argmax(overlap, axis=1)
-        g_s = jnp.maximum(gs, jnp.take_along_axis(ps, idx[:, None], axis=1)[:, 0])
-        g_e = jnp.minimum(ge, jnp.take_along_axis(pe, idx[:, None], axis=1)[:, 0])
-        g_e = jnp.minimum(g_e, g_s + budget_left - 1)
-        ok_g = due & peer_ok[row_node] & valid_gap & any_ov & (budget_left > 0)
-        inserted = _v_insert(have, g_s, g_e)
-        have = _select(ok_g, inserted, have)
-        got = jnp.where(ok_g, g_e - g_s + 1, 0)
-        budget_left -= got
-        granted += got
+    with jax.named_scope("corro_sync"):
+        phase = (row_node * jnp.int32(40503)) % jnp.int32(cfg.sync_interval)
+        due = (
+            alive[row_node]
+            & ((round_idx + phase) % jnp.int32(cfg.sync_interval) == 0)
+        )
+        peer = jax.random.randint(k_peer, (n,), 0, n)
+        peer_ok = alive[peer] & (peer != jnp.arange(n))
+        p_row = peer[row_node] * s_count + row_stream
+        gaps = _v_gaps(have, jnp.zeros((rows,), jnp.int32), row_last)
+        ps, pe = have.starts[p_row], have.ends[p_row]
+        p_live = ps <= pe
+        budget_left = jnp.full((rows,), cfg.sync_seq_budget, jnp.int32)
+        granted = jnp.zeros((rows,), jnp.int32)
+        for g in range(cfg.gap_requests):
+            gs, ge = gaps.starts[:, g], gaps.ends[:, g]
+            valid_gap = gs <= ge
+            overlap = p_live & (ps <= ge[:, None]) & (pe >= gs[:, None])
+            any_ov = jnp.any(overlap, axis=1)
+            idx = jnp.argmax(overlap, axis=1)
+            g_s = jnp.maximum(
+                gs, jnp.take_along_axis(ps, idx[:, None], axis=1)[:, 0]
+            )
+            g_e = jnp.minimum(
+                ge, jnp.take_along_axis(pe, idx[:, None], axis=1)[:, 0]
+            )
+            g_e = jnp.minimum(g_e, g_s + budget_left - 1)
+            ok_g = (
+                due & peer_ok[row_node] & valid_gap & any_ov
+                & (budget_left > 0)
+            )
+            inserted = _v_insert(have, g_s, g_e)
+            have = _select(ok_g, inserted, have)
+            got = jnp.where(ok_g, g_e - g_s + 1, 0)
+            budget_left -= got
+            granted += got
 
     new_state = ChunkState(have=have)
+    # Remaining seq deficit to full coverage, summed cluster-wide. f32:
+    # rows x seqs can exceed the u32 domain at 100k-node scale, and the
+    # telemetry plane treats it as a level gauge anyway.
+    live_new = intervals.slot_mask(have)
+    covered = jnp.sum(
+        jnp.where(live_new, have.ends - have.starts + 1, 0), axis=1
+    )
+    need_seqs = jnp.sum(
+        jnp.maximum(row_last + 1 - covered, 0).astype(jnp.float32)
+    )
+    # Node-level sync sessions this round (phase depends only on the node).
+    phase_n = (jnp.arange(n) * jnp.int32(40503)) % jnp.int32(
+        cfg.sync_interval
+    )
+    due_n = alive & ((round_idx + phase_n) % jnp.int32(cfg.sync_interval) == 0)
     stats = {
         "chunks_sent": jnp.sum(ok, dtype=jnp.uint32),
+        "chunks_applied": jnp.sum(in_mask, dtype=jnp.uint32),
         "seqs_granted": jnp.sum(granted, dtype=jnp.uint32),
+        "sessions": jnp.sum(due_n & peer_ok, dtype=jnp.uint32),
+        "need_seqs": need_seqs,
         "applied_nodes": jnp.sum(
             applied_mask(new_state, last_seq, cfg), dtype=jnp.uint32
         ),

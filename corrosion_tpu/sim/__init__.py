@@ -16,6 +16,13 @@ from corrosion_tpu.sim.engine import (  # noqa: F401
     simulate,
     visibility_latencies,
 )
+from corrosion_tpu.sim.telemetry import (  # noqa: F401
+    ROUND_CURVE_KEYS,
+    FlightRecorder,
+    KernelTelemetry,
+    publish_curves,
+    replay_flight,
+)
 from corrosion_tpu.sim.trace import (  # noqa: F401
     Trace,
     replay,

@@ -6,6 +6,13 @@ as a scanned whole-cluster simulation with first-application tracking, the
 same shape the main engine gives the version-granular plane. A stream is
 "applied" at a node when its coverage is gap-free to last_seq (the
 process_fully_buffered_changes trigger, agent.rs:1667-1806).
+
+The scan body emits the canonical RoundCurves schema (sim/telemetry.py):
+``msgs`` = chunks sent, ``applied_broadcast`` = chunks accepted by bounded
+intake, ``applied_sync`` = seqs granted by partial-need sync, ``need`` =
+remaining seq deficit to full coverage, ``vis_count`` = (node, stream)
+pairs newly reassembled this round; membership/CRDT keys zero-fill (this
+plane has no SWIM or cell state).
 """
 
 from __future__ import annotations
@@ -18,22 +25,31 @@ import numpy as np
 
 from corrosion_tpu.ops import chunks as chunk_ops
 from corrosion_tpu.ops.chunks import ChunkConfig
+from corrosion_tpu.sim import telemetry as telemetry_mod
+from corrosion_tpu.sim.telemetry import KernelTelemetry
 
 
-@partial(jax.jit, static_argnames=("cfg", "rounds"))
-def _scan(state, last_seq, alive, base_key, cfg, rounds):
+@partial(jax.jit, static_argnames=("cfg",))
+def _scan(state, vis, last_seq, alive, base_key, ridx, cfg):
     def body(carry, r):
         st, vis = carry
         key = jax.random.fold_in(base_key, r)
         st, stats = chunk_ops.chunk_round(st, last_seq, alive, r, key, cfg)
-        applied = chunk_ops.applied_mask(st, last_seq, cfg)
-        vis = jnp.where((vis < 0) & applied, r, vis)
-        return (st, vis), stats
+        with jax.named_scope("corro_track"):
+            applied = chunk_ops.applied_mask(st, last_seq, cfg)
+            newly = (vis < 0) & applied
+            vis = jnp.where(newly, r, vis)
+        curves = telemetry_mod.round_curves(
+            msgs=stats["chunks_sent"],
+            applied_broadcast=stats["chunks_applied"],
+            applied_sync=stats["seqs_granted"],
+            sessions=stats["sessions"],
+            need=stats["need_seqs"],
+            vis_count=jnp.sum(newly, dtype=jnp.uint32),
+        )
+        return (st, vis), curves
 
-    vis0 = jnp.full((cfg.n_nodes, cfg.n_streams), -1, jnp.int32)
-    return jax.lax.scan(
-        body, (state, vis0), jnp.arange(rounds, dtype=jnp.int32)
-    )
+    return jax.lax.scan(body, (state, vis), ridx)
 
 
 def simulate_chunks(
@@ -43,19 +59,58 @@ def simulate_chunks(
     rounds: int,
     seed: int = 0,
     round_ms: float = 500.0,
+    max_chunk: int | None = None,
+    telemetry: KernelTelemetry | None = None,
 ):
     """Run ``rounds`` chunk-plane rounds; returns (state, metrics dict).
 
     Metrics: applied coverage fraction, p50/p99 first-application latency in
     simulated seconds over all (node, stream) pairs (unapplied pairs counted
-    in ``unapplied``)."""
+    in ``unapplied``), plus run totals derived from the canonical curves
+    (``curves`` itself is returned under that key).
+
+    ``max_chunk`` splits the run into several device executions (the state
+    and visibility tensors carry across; per-round RNG keys fold the
+    absolute round index, so results are identical either way), and
+    ``telemetry`` instruments each execution as a chunk — timed, spanned,
+    and flushed to the flight recorder.
+    """
     origin = jnp.asarray(origin, jnp.int32)
     last_seq = jnp.asarray(last_seq, jnp.int32)
     state = chunk_ops.init_chunks(cfg, origin, last_seq)
     alive = jnp.ones((cfg.n_nodes,), bool)
-    (state, vis), curves = _scan(
-        state, last_seq, alive, jax.random.PRNGKey(seed), cfg, rounds
+    vis = jnp.full((cfg.n_nodes, cfg.n_streams), -1, jnp.int32)
+    base_key = jax.random.PRNGKey(seed)
+
+    step = max_chunk if max_chunk is not None else max(rounds, 1)
+    # rounds == 0 is a valid degenerate run: empty canonical curves.
+    curve_parts: list[dict] = (
+        [] if rounds > 0
+        else [{k: np.zeros((0,)) for k in telemetry_mod.ROUND_CURVE_KEYS}]
     )
+    for r0 in range(0, rounds, step):
+        nr = min(step, rounds - r0)
+        ridx = jnp.arange(r0, r0 + nr, dtype=jnp.int32)
+        if telemetry is None:
+            (state, vis), curves = _scan(
+                state, vis, last_seq, alive, base_key, ridx, cfg
+            )
+        else:
+            def _run(state=state, vis=vis, ridx=ridx):
+                (st, vi), curves = _scan(
+                    state, vis, last_seq, alive, base_key, ridx, cfg
+                )
+                return (st, vi), curves
+
+            (state, vis), curves = telemetry.run_chunk(r0, _run)
+        curve_parts.append({k: np.asarray(v) for k, v in curves.items()})
+    merged = {
+        k: np.concatenate([p[k] for p in curve_parts])
+        for k in curve_parts[0]
+    }
+    if telemetry is not None:
+        telemetry.on_run_end(merged)
+
     vis_np = np.asarray(vis)
     applied = vis_np >= 0
     lat = vis_np[applied].astype(np.float64) * (round_ms / 1000.0)
@@ -64,7 +119,8 @@ def simulate_chunks(
         "unapplied": int((~applied).sum()),
         "p50_s": float(np.percentile(lat, 50)) if lat.size else float("nan"),
         "p99_s": float(np.percentile(lat, 99)) if lat.size else float("nan"),
-        "seqs_granted": int(np.asarray(curves["seqs_granted"]).sum()),
-        "chunks_sent": int(np.asarray(curves["chunks_sent"]).sum()),
+        "seqs_granted": int(merged["applied_sync"].sum()),
+        "chunks_sent": int(merged["msgs"].sum()),
+        "curves": merged,
     }
     return state, metrics

@@ -34,6 +34,8 @@ from corrosion_tpu.ops import gossip as gossip_ops
 from corrosion_tpu.ops import swim as swim_ops
 from corrosion_tpu.ops.gossip import DataState, GossipConfig, Topology
 from corrosion_tpu.ops.swim import SwimConfig, SwimState
+from corrosion_tpu.sim import telemetry as telemetry_mod
+from corrosion_tpu.sim.telemetry import KernelTelemetry
 
 
 @dataclass(frozen=True)
@@ -136,41 +138,50 @@ def cluster_round(
         )
     alive = sw.alive
 
-    data, bstats = gossip_ops.broadcast_round(
-        state.data, topo, alive, partition, writes, k_bcast, cfg.gossip
-    )
-    sw = swim_impl.swim_round(sw, k_swim, state.round, cfg.swim)
-    data, sstats = gossip_ops.sync_round(
-        data, topo, alive, partition, state.round, k_sync, cfg.gossip
-    )
-    if has_churn:
-        # Rejoining nodes pull immediately instead of waiting out their
-        # cohort slot (the reference syncs on rejoin).
-        data, rstats = gossip_ops.revive_sync(
-            data, topo, alive, partition, revive, k_rejoin, cfg.gossip
+    with jax.named_scope("corro_broadcast"):
+        data, bstats = gossip_ops.broadcast_round(
+            state.data, topo, alive, partition, writes, k_bcast, cfg.gossip
         )
-        sstats = {k: sstats[k] + rstats[k] for k in sstats}
+    with jax.named_scope("corro_swim"):
+        sw = swim_impl.swim_round(sw, k_swim, state.round, cfg.swim)
+    with jax.named_scope("corro_sync"):
+        data, sstats = gossip_ops.sync_round(
+            data, topo, alive, partition, state.round, k_sync, cfg.gossip
+        )
+        if has_churn:
+            # Rejoining nodes pull immediately instead of waiting out their
+            # cohort slot (the reference syncs on rejoin).
+            data, rstats = gossip_ops.revive_sync(
+                data, topo, alive, partition, revive, k_rejoin, cfg.gossip
+            )
+            sstats = {k: sstats[k] + rstats[k] for k in sstats}
 
     # Visibility tracking for sampled writes that have been committed.
-    active = state.round >= sample_round  # [S]
-    vis_now = gossip_ops.visibility(data, sample_writer, sample_ver)  # [S, N]
-    vis_round = jnp.where(
-        (state.vis_round < 0) & vis_now & active[:, None],
-        state.round,
-        state.vis_round,
-    )
+    with jax.named_scope("corro_track"):
+        active = state.round >= sample_round  # [S]
+        vis_now = gossip_ops.visibility(
+            data, sample_writer, sample_ver
+        )  # [S, N]
+        vis_round = jnp.where(
+            (state.vis_round < 0) & vis_now & active[:, None],
+            state.round,
+            state.vis_round,
+        )
 
-    stats = {
-        "mismatches": swim_impl.mismatches(sw),
-        "need": gossip_ops.total_need(data),
-        "applied_broadcast": bstats["applied_broadcast"],
-        "applied_sync": sstats["applied_sync"],
-        "msgs": bstats["msgs"],
-        "sessions": sstats["sessions"],
-        "cell_merges": bstats["cell_merges"] + sstats["cell_merges"],
-        "window_degraded": bstats["window_degraded"],
-        "sync_regrant": sstats["sync_regrant"],
-    }
+    stats = telemetry_mod.round_curves(
+        mismatches=swim_impl.mismatches(sw),
+        need=gossip_ops.total_need(data),
+        applied_broadcast=bstats["applied_broadcast"],
+        applied_sync=sstats["applied_sync"],
+        msgs=bstats["msgs"],
+        sessions=sstats["sessions"],
+        cell_merges=bstats["cell_merges"] + sstats["cell_merges"],
+        window_degraded=bstats["window_degraded"],
+        sync_regrant=sstats["sync_regrant"],
+        vis_count=jnp.sum(
+            (vis_round >= 0) & (state.vis_round < 0), dtype=jnp.uint32
+        ),
+    )
     return (
         ClusterState(
             swim=sw, data=data, round=state.round + 1, vis_round=vis_round
@@ -186,6 +197,7 @@ def simulate(
     seed: int = 0,
     state: ClusterState | None = None,
     max_chunk: int | None = None,
+    telemetry: KernelTelemetry | None = None,
 ) -> tuple[ClusterState, dict]:
     """Scan `cluster_round` over the schedule. Returns final state + per-round
     metric curves (numpy arrays of length schedule.rounds).
@@ -195,6 +207,12 @@ def simulate(
     can trip device-side watchdogs, and chunking also bounds the stacked
     curve buffers. Results are identical either way — per-round RNG keys
     fold in the absolute round index.
+
+    ``telemetry`` (sim.telemetry.KernelTelemetry) instruments the run:
+    each chunk execution (the whole run counts as one chunk when
+    unchunked) is timed, spanned, and flushed to the flight recorder,
+    and the finished curves fold into the metrics registry as
+    ``corro_kernel_*`` series. Curves and final state are unchanged.
     """
     # The CRDT merge packs (cl, col_version) into one u32 (ops/crdt.py
     # apply_changes): versions must stay below 2^24. Bound the reachable
@@ -229,12 +247,25 @@ def simulate(
                 sample_ver=schedule.sample_ver,
                 sample_round=schedule.sample_round,
             )
-            cur, curves = simulate(cfg, topo, part, seed=seed, state=cur)
+            if telemetry is None:
+                cur, curves = simulate(cfg, topo, part, seed=seed, state=cur)
+            else:
+                # Chunk boundary: time the execution, span it, and flush
+                # the chunk's per-round curves to the flight recorder so
+                # long runs stream progress instead of going dark.
+                cur, curves = telemetry.run_chunk(
+                    start_round + start,
+                    lambda part=part, cur=cur: simulate(
+                        cfg, topo, part, seed=seed, state=cur
+                    ),
+                )
             curve_parts.append(curves)
         merged = {
             k: np.concatenate([p[k] for p in curve_parts])
             for k in curve_parts[0]
         }
+        if telemetry is not None:
+            telemetry.on_run_end(merged)
         return cur, merged
     n = cfg.n_nodes
     n_regions = int(np.asarray(topo.region).max()) + 1
@@ -275,10 +306,23 @@ def simulate(
         writes, partition, kill, revive,
         jnp.arange(offset, offset + rounds, dtype=jnp.int32),
     )
-    final, curves = _scan_rounds(
-        state, topo, xs, s_writer, s_ver, s_round, base_key, cfg, has_churn
-    )
+    if telemetry is None:
+        final, curves = _scan_rounds(
+            state, topo, xs, s_writer, s_ver, s_round, base_key, cfg,
+            has_churn,
+        )
+    else:
+        # Unchunked run with telemetry: the whole execution is one chunk.
+        final, curves = telemetry.run_chunk(
+            offset,
+            lambda: _scan_rounds(
+                state, topo, xs, s_writer, s_ver, s_round, base_key, cfg,
+                has_churn,
+            ),
+        )
     curves = {k: np.asarray(v) for k, v in curves.items()}
+    if telemetry is not None:
+        telemetry.on_run_end(curves)
     return final, curves
 
 

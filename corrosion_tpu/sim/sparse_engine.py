@@ -40,7 +40,9 @@ from corrosion_tpu.ops import swim as swim_ops
 from corrosion_tpu.ops.gossip import GossipConfig, Topology
 from corrosion_tpu.ops.sparse_writers import SparseConfig, SparseState
 from corrosion_tpu.ops.swim import SwimConfig
+from corrosion_tpu.sim import telemetry as telemetry_mod
 from corrosion_tpu.sim.engine import Schedule
+from corrosion_tpu.sim.telemetry import KernelTelemetry
 
 
 @dataclass(frozen=True)
@@ -247,50 +249,59 @@ def _epoch_scan(
             k_b, k_sw, k_sy = jax.random.split(key, 3)
         alive = sw.alive
 
-        data, bstats = gossip_ops.broadcast_round(
-            st.data, topo, alive, part, w_slots, k_b, cfg.gossip
-        )
-        sw = swim_impl.swim_round(sw, k_sw, r, cfg.swim)
-        data, ssta = gossip_ops.sync_round(
-            data, topo, alive, part, r, k_sy, cfg.gossip
-        )
-        if has_churn:
-            data, rsta = gossip_ops.revive_sync(
-                data, topo, alive, part, rv, k_rejoin, cfg.gossip
+        with jax.named_scope("corro_broadcast"):
+            data, bstats = gossip_ops.broadcast_round(
+                st.data, topo, alive, part, w_slots, k_b, cfg.gossip
             )
-            ssta = {k: ssta[k] + rsta[k] for k in ssta}
-        st = st._replace(data=data)
-        st, csta = sw_ops.cold_sync(
-            st, region, alive, part, cfg.gossip, sp
-        )
+        with jax.named_scope("corro_swim"):
+            sw = swim_impl.swim_round(sw, k_sw, r, cfg.swim)
+        with jax.named_scope("corro_sync"):
+            data, ssta = gossip_ops.sync_round(
+                data, topo, alive, part, r, k_sy, cfg.gossip
+            )
+            if has_churn:
+                data, rsta = gossip_ops.revive_sync(
+                    data, topo, alive, part, rv, k_rejoin, cfg.gossip
+                )
+                ssta = {k: ssta[k] + rsta[k] for k in ssta}
+            st = st._replace(data=data)
+            st, csta = sw_ops.cold_sync(
+                st, region, alive, part, cfg.gossip, sp
+            )
 
         # Hot-plane visibility for samples whose writer holds a slot.
-        hot = s_slot >= 0
-        vis_now = gossip_ops.visibility(
-            st.data, jnp.maximum(s_slot, 0), s_ver
-        )
-        active_s = r >= s_round
-        vr = jnp.where(
-            (vr < 0) & vis_now & (hot & active_s)[:, None], r, vr
-        )
+        with jax.named_scope("corro_track"):
+            hot = s_slot >= 0
+            vis_now = gossip_ops.visibility(
+                st.data, jnp.maximum(s_slot, 0), s_ver
+            )
+            active_s = r >= s_round
+            vr_new = jnp.where(
+                (vr < 0) & vis_now & (hot & active_s)[:, None], r, vr
+            )
 
-        stats = {
-            "mismatches": swim_impl.mismatches(sw),
-            "need": gossip_ops.total_need(st.data) + sw_ops.cold_need(st),
-            "applied_broadcast": bstats["applied_broadcast"],
-            "applied_sync": ssta["applied_sync"],
-            "msgs": bstats["msgs"],
-            "sessions": ssta["sessions"],
-            "cell_merges": (
+        stats = telemetry_mod.round_curves(
+            mismatches=swim_impl.mismatches(sw),
+            need=gossip_ops.total_need(st.data) + sw_ops.cold_need(st),
+            applied_broadcast=bstats["applied_broadcast"],
+            applied_sync=ssta["applied_sync"],
+            msgs=bstats["msgs"],
+            sessions=ssta["sessions"],
+            cell_merges=(
                 bstats["cell_merges"]
                 + ssta["cell_merges"]
                 + csta["cold_merges"]
             ),
-            "window_degraded": bstats["window_degraded"],
-            "sync_regrant": ssta["sync_regrant"],
-            "cold_healed": csta["cold_healed"],
-        }
-        return (st, sw, vr), stats
+            window_degraded=bstats["window_degraded"],
+            sync_regrant=ssta["sync_regrant"],
+            cold_healed=csta["cold_healed"],
+            # Hot-plane visibility events only; demoted-writer samples
+            # resolve at epoch granularity outside the scan.
+            vis_count=jnp.sum(
+                (vr_new >= 0) & (vr < 0), dtype=jnp.uint32
+            ),
+        )
+        return (st, sw, vr_new), stats
 
     (sstate, swim_state, vis_round), curves = jax.lax.scan(
         body,
@@ -335,6 +346,7 @@ def simulate_sparse(
     seed: int = 0,
     resume: dict | None = None,
     stop_after_epoch: int | None = None,
+    telemetry: KernelTelemetry | None = None,
 ):
     """Run the epoch-rotated any-node-writes simulation. Returns
     (final_sparse_state, swim_state, vis_round, curves, info).
@@ -342,7 +354,12 @@ def simulate_sparse(
     ``resume`` (from ``make_resume``) continues a previous run from its
     next epoch: device state + host planner snapshot + epoch cursor. The
     per-round RNG folds the absolute round index, so save/resume is
-    bit-identical to an uninterrupted run (tests assert it)."""
+    bit-identical to an uninterrupted run (tests assert it).
+
+    ``telemetry`` (sim.telemetry.KernelTelemetry) treats every epoch as
+    a chunk boundary: the epoch scan is timed and spanned, its per-round
+    curves flush to the flight recorder, and run totals fold into the
+    metrics registry as ``corro_kernel_*`` series."""
     sp = cfg.sparse
     n = cfg.n_nodes
     rounds = schedule.rounds
@@ -438,11 +455,28 @@ def simulate_sparse(
         )
         ridx = jnp.arange(e0, e1, dtype=jnp.int32)
 
-        sstate, swim_state, vis_round, curves = _epoch_scan(
-            sstate, swim_state, vis_round, topo,
-            (writes_slots, kill, revive, ridx), part,
-            s_slot, s_ver, s_round, base_key, cfg, sp, has_churn,
-        )
+        if telemetry is None:
+            sstate, swim_state, vis_round, curves = _epoch_scan(
+                sstate, swim_state, vis_round, topo,
+                (writes_slots, kill, revive, ridx), part,
+                s_slot, s_ver, s_round, base_key, cfg, sp, has_churn,
+            )
+        else:
+            # Epoch boundary == chunk boundary for the flight recorder.
+            def _run(sstate=sstate, swim_state=swim_state,
+                     vis_round=vis_round, topo=topo,
+                     writes_slots=writes_slots, kill=kill, revive=revive,
+                     ridx=ridx, part=part, s_slot=s_slot):
+                out = _epoch_scan(
+                    sstate, swim_state, vis_round, topo,
+                    (writes_slots, kill, revive, ridx), part,
+                    s_slot, s_ver, s_round, base_key, cfg, sp, has_churn,
+                )
+                return out[:3], out[3]
+
+            (sstate, swim_state, vis_round), curves = telemetry.run_chunk(
+                e0, _run
+            )
         curve_parts.append({k: np.asarray(v) for k, v in curves.items()})
 
         # Epoch-end cold visibility at epoch granularity (exact for
@@ -463,6 +497,8 @@ def simulate_sparse(
         k: np.concatenate([p[k] for p in curve_parts])
         for k in curve_parts[0]
     }
+    if telemetry is not None:
+        telemetry.on_run_end(merged)
     info["resume"] = {
         "planner": planner.snapshot(),
         "sstate": sstate,

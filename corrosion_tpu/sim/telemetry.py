@@ -1,0 +1,423 @@
+"""Kernel telemetry plane shared by all simulation engines.
+
+The host-agent plane already exports ~41 Prometheus series and W3C trace
+propagation (utils/metrics.py, utils/tracing.py, docs/OBSERVABILITY.md);
+this module gives the JAX kernel plane — the whole-cluster simulator —
+the same observability surface:
+
+- **RoundCurves schema**: one canonical per-round stats contract
+  (``ROUND_CURVE_KEYS``) that the ``lax.scan`` bodies of
+  ``sim.engine``, ``sim.sparse_engine``, and ``sim.chunk_engine`` all
+  populate (``round_curves`` zero-fills what an engine doesn't have, so
+  the key set is identical everywhere and downstream consumers never
+  branch per engine).
+- **FlightRecorder**: streams per-round curves to JSONL at every chunk
+  boundary of a chunked run. Long 100k-node runs report progress instead
+  of going dark for minutes, and a crashed run leaves a replayable
+  record (``replay_flight`` tolerates a truncated final line).
+- **Metrics bridge**: ``publish_curves`` folds finished-run curves into
+  a ``MetricsRegistry`` as ``corro_kernel_*`` counters/gauges rendered
+  on the same Prometheus endpoint as the agent series.
+- **Tracer spans**: each chunk execution opens a ``kernel_chunk`` span,
+  so kernel runs appear in the same trace stream as agent sync sessions.
+- **Plane attribution**: ``attribute_planes`` times a composite step
+  with stages enabled cumulatively in execution order (moved here from
+  bench.py); stage increments telescope exactly —
+  ``overhead + sum(increments) == full`` — and ``PlaneAttribution.scale``
+  projects the measured fractions onto a run's real per-round wall so
+  ``sum(plane_ms) + residual_ms == step_ms`` holds by construction.
+
+Everything here is host-side: nothing below traces into the jitted round
+step except ``round_curves`` (a dict constructor) and ``jax.named_scope``
+annotations added by the engines themselves.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import IO, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical per-round curve keys. Every engine's scan body emits exactly
+# this set (superset of the former ad-hoc dicts); semantics per key are
+# documented in docs/OBSERVABILITY.md ("Kernel plane").
+ROUND_CURVE_KEYS = (
+    "msgs",
+    "applied_broadcast",
+    "applied_sync",
+    "cell_merges",
+    "need",
+    "mismatches",
+    "sessions",
+    "window_degraded",
+    "sync_regrant",
+    "cold_healed",
+    "vis_count",
+)
+
+
+def round_curves(**stats) -> dict:
+    """Build a canonical per-round stats dict for a scan body.
+
+    Unknown keys raise (schema drift fails loudly at trace time); missing
+    keys zero-fill, so engines only state what their plane measures.
+    """
+    unknown = set(stats) - set(ROUND_CURVE_KEYS)
+    if unknown:
+        raise ValueError(
+            f"unknown round-curve keys {sorted(unknown)}; canonical set is "
+            f"{ROUND_CURVE_KEYS}"
+        )
+    return {
+        k: stats[k] if k in stats else jnp.uint32(0)
+        for k in ROUND_CURVE_KEYS
+    }
+
+
+class FlightRecorder:
+    """Streams per-round kernel curves to JSONL at chunk boundaries.
+
+    One ``{"kind": "round", "round": r, <curve values>}`` object per
+    round, plus a ``{"kind": "chunk", ...}`` marker per flushed chunk
+    (device-execution wall included) and a ``{"kind": "flight", ...}``
+    header per open. The file is flushed after every chunk, so a crashed
+    run loses at most the in-flight chunk and the tail line may be
+    truncated mid-write — ``replay_flight`` skips unparsable lines.
+
+    Open with ``mode="a"`` (default) to let a resumed run append to the
+    same record.
+    """
+
+    def __init__(self, path: str, engine: str = "dense", mode: str = "a"):
+        self.path = path
+        self.engine = engine
+        self._f: IO[str] | None = open(path, mode)
+        self._write(
+            {"kind": "flight", "version": 1, "engine": engine,
+             "t_unix": time.time()}
+        )
+        self._f.flush()
+
+    def _write(self, obj: dict) -> None:
+        self._f.write(json.dumps(obj) + "\n")
+
+    def record_chunk(
+        self, start_round: int, curves: dict, wall_s: float | None = None
+    ) -> None:
+        """Flush one chunk's per-round curves (rounds are absolute)."""
+        if self._f is None:
+            raise ValueError("FlightRecorder is closed")
+        keys = [k for k in ROUND_CURVE_KEYS if k in curves]
+        n = len(np.asarray(curves[keys[0]])) if keys else 0
+        cols = {k: np.asarray(curves[k]) for k in keys}
+        for i in range(n):
+            obj = {"kind": "round", "round": int(start_round) + i}
+            for k in keys:
+                v = cols[k][i]
+                obj[k] = float(v) if np.issubdtype(
+                    cols[k].dtype, np.floating
+                ) else int(v)
+            self._write(obj)
+        marker = {"kind": "chunk", "start": int(start_round), "rounds": n}
+        if wall_s is not None:
+            marker["wall_s"] = round(float(wall_s), 6)
+        self._write(marker)
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self) -> "FlightRecorder":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def replay_flight(path: str) -> tuple[dict, list[dict]]:
+    """Rebuild (curves, chunk markers) from a flight-recorder JSONL.
+
+    Crash-tolerant: unparsable lines (a write cut mid-line) are skipped.
+    Rounds are sorted by absolute index; duplicate rounds (an overlapping
+    re-run) keep the last record. Curve arrays carry only the keys the
+    file actually recorded.
+    """
+    rows: dict[int, dict] = {}
+    chunks: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue  # truncated tail from a crash — ignore
+            kind = obj.get("kind")
+            if kind == "round" and "round" in obj:
+                rows[int(obj["round"])] = obj
+            elif kind == "chunk":
+                chunks.append(obj)
+    order = sorted(rows)
+    keys = [
+        k for k in ROUND_CURVE_KEYS
+        if any(k in rows[r] for r in order)
+    ]
+    curves = {
+        k: np.asarray([rows[r].get(k, 0) for r in order])
+        for k in keys
+    }
+    curves["round"] = np.asarray(order, np.int64)
+    return curves, chunks
+
+
+def publish_curves(registry, curves: dict, engine: str = "dense") -> None:
+    """Fold finished-run curves into a MetricsRegistry.
+
+    Per canonical key: a ``corro_kernel_<key>_total{engine=...}`` counter
+    holding the run's summed curve. Level-style curves additionally set
+    ``corro_kernel_<key>_last{engine=...}`` gauges to their end-of-run
+    value (their sums are still published so totals always equal summed
+    curves). ``corro_kernel_rounds_total`` counts simulated rounds.
+    """
+    n = 0
+    for k in ROUND_CURVE_KEYS:
+        if k not in curves:
+            continue
+        arr = np.asarray(curves[k], dtype=np.float64)
+        n = max(n, arr.size)
+        registry.counter(
+            f"corro_kernel_{k}_total",
+            f"kernel plane: summed per-round {k}",
+        ).inc(float(arr.sum()), engine=engine)
+        if k in ("need", "mismatches") and arr.size:
+            registry.gauge(
+                f"corro_kernel_{k}_last",
+                f"kernel plane: end-of-run {k}",
+            ).set(float(arr[-1]), engine=engine)
+    registry.counter(
+        "corro_kernel_rounds_total", "kernel plane: simulated rounds"
+    ).inc(float(n), engine=engine)
+
+
+@dataclass
+class KernelTelemetry:
+    """Bundle of per-run telemetry sinks threaded through an engine.
+
+    Any subset may be enabled: ``recorder`` streams JSONL per chunk,
+    ``registry`` receives ``corro_kernel_*`` series at run end (and a
+    ``corro_kernel_chunk_seconds`` histogram per chunk), ``tracer`` opens
+    a ``kernel_chunk`` span around each device execution, ``progress``
+    gets one status line per chunk (the anti-going-dark channel for long
+    runs). ``chunk_walls`` accumulates (rounds, wall seconds) per chunk —
+    ``device_step_ms`` is the instrumented per-round step time over the
+    chunk execution windows only, which is why it is a lower bound on a
+    caller's whole-run wall per round.
+    """
+
+    engine: str = "dense"
+    recorder: FlightRecorder | None = None
+    registry: object | None = None
+    tracer: object | None = None
+    progress: IO[str] | None = None
+    chunk_walls: list = field(default_factory=list)
+
+    def run_chunk(self, start_round: int, fn: Callable):
+        """Execute one chunk ``fn() -> (state, curves)`` under a span,
+        time it to completion (blocks on the returned state), then flush
+        the chunk to every enabled sink."""
+        span_cm = (
+            self.tracer.span(
+                "kernel_chunk", engine=self.engine,
+                start_round=int(start_round),
+            )
+            if self.tracer is not None
+            else contextlib.nullcontext()
+        )
+        t0 = time.perf_counter()
+        with span_cm as span:
+            state, curves = fn()
+            jax.block_until_ready(jax.tree.leaves(state))
+            # Close the timed window before any host-side curve reads so
+            # the wall stays execution-only.
+            wall = time.perf_counter() - t0
+            n = len(np.asarray(next(iter(curves.values())))) if curves else 0
+            if span is not None:
+                span.set_attr("rounds", n)
+                span.set_attr("wall_s", round(wall, 6))
+        self.on_chunk(start_round, curves, wall, n_rounds=n)
+        return state, curves
+
+    def on_chunk(
+        self, start_round: int, curves: dict, wall_s: float,
+        n_rounds: int | None = None,
+    ) -> None:
+        n = (
+            n_rounds
+            if n_rounds is not None
+            else len(np.asarray(next(iter(curves.values())))) if curves else 0
+        )
+        self.chunk_walls.append((n, wall_s))
+        if self.registry is not None:
+            self.registry.histogram(
+                "corro_kernel_chunk_seconds",
+                "kernel plane: wall seconds per chunk execution",
+            ).observe(wall_s, engine=self.engine)
+        if self.recorder is not None:
+            self.recorder.record_chunk(start_round, curves, wall_s)
+        if self.progress is not None:
+            tail = {
+                k: int(np.asarray(curves[k])[-1])
+                for k in ("need", "mismatches") if k in curves and n
+            }
+            msgs = (
+                int(np.asarray(curves["msgs"]).sum())
+                if "msgs" in curves else 0
+            )
+            self.progress.write(
+                f"[flight:{self.engine}] rounds "
+                f"{int(start_round)}..{int(start_round) + n - 1} "
+                f"wall={wall_s:.2f}s msgs={msgs} {json.dumps(tail)}\n"
+            )
+            self.progress.flush()
+
+    def on_run_end(self, curves: dict) -> None:
+        if self.registry is not None:
+            publish_curves(self.registry, curves, engine=self.engine)
+
+    @property
+    def device_step_ms(self) -> float:
+        """Per-round wall over the instrumented chunk executions only
+        (excludes host work between chunks: schedule slicing, curve
+        merging, planner bookkeeping)."""
+        rounds = sum(n for n, _ in self.chunk_walls)
+        if rounds == 0:
+            return float("nan")
+        return sum(w for _, w in self.chunk_walls) / rounds * 1000.0
+
+
+def flight_path_from_argv(
+    argv, default: str = "flight.jsonl"
+) -> str | None:
+    """Shared ``--flight`` CLI parsing for the smoke scripts.
+
+    Accepts ``--flight`` (recorder at ``default``) or ``--flight=PATH``.
+    The path is never taken from a separate token, so a following
+    positional (e.g. a rounds count) is never swallowed. Returns None
+    when the flag is absent.
+    """
+    for a in argv:
+        if a == "--flight":
+            return default
+        if a.startswith("--flight="):
+            return a.split("=", 1)[1] or default
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Plane attribution (moved from bench.py so every engine can reuse it).
+
+
+def time_scan_step(step, carry, iters: int = 10) -> float:
+    """Time ``step`` by scanning it inside ONE jitted computation:
+    per-call dispatch to a (possibly remote) device costs hundreds of ms
+    and would otherwise dominate. Returns warm ms per iteration."""
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def scan(carry, n):
+        def body(c, i):
+            return step(c, i), ()
+
+        out, _ = jax.lax.scan(body, carry, jnp.arange(n))
+        return out
+
+    out = scan(carry, iters)  # compile
+    jax.block_until_ready(jax.tree.leaves(out))
+    t0 = time.perf_counter()
+    out = scan(carry, iters)
+    jax.block_until_ready(jax.tree.leaves(out))
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
+@dataclass(frozen=True)
+class PlaneAttribution:
+    """Cumulative-prefix stage timings for a composite step.
+
+    ``cum_ms[k]`` is the measured per-iteration wall with the first ``k``
+    stages enabled (``cum_ms[0]`` = empty-scan overhead). Increments
+    telescope to the full composite EXACTLY:
+    ``overhead_ms + sum(increments) == full_ms`` is an identity of the
+    construction, asserted in ``check`` so regressions in the harness
+    itself fail loudly.
+    """
+
+    stages: tuple
+    cum_ms: tuple
+
+    @property
+    def full_ms(self) -> float:
+        return self.cum_ms[-1]
+
+    @property
+    def overhead_ms(self) -> float:
+        return self.cum_ms[0]
+
+    @property
+    def increments(self) -> dict:
+        return {
+            s: self.cum_ms[k + 1] - self.cum_ms[k]
+            for k, s in enumerate(self.stages)
+        }
+
+    def check(self, tol: float = 1e-9) -> None:
+        total = self.overhead_ms + sum(self.increments.values())
+        assert abs(total - self.full_ms) <= tol * max(abs(self.full_ms), 1.0), (
+            f"telescoping broken: overhead {self.overhead_ms} + increments "
+            f"{self.increments} != full {self.full_ms}"
+        )
+
+    def scale(self, step_ms: float) -> tuple[dict, float]:
+        """Project measured stage fractions onto a run's real per-round
+        wall. Returns ``(plane_ms, residual_ms)`` with the invariant
+        ``sum(plane_ms) + residual_ms == step_ms`` exact by construction;
+        the residual carries the empty-scan overhead, timer-noise
+        clamping, and any host dispatch the composite can't see."""
+        self.check()
+        if self.full_ms <= 0:
+            return {s: 0.0 for s in self.stages}, step_ms
+        plane = {
+            s: max(inc, 0.0) / self.full_ms * step_ms
+            for s, inc in self.increments.items()
+        }
+        residual = step_ms - sum(plane.values())
+        assert abs(sum(plane.values()) + residual - step_ms) <= 1e-9 * max(
+            abs(step_ms), 1.0
+        )
+        return plane, residual
+
+
+def attribute_planes(
+    make_step, stages: tuple, carry, iters: int = 10
+) -> PlaneAttribution:
+    """Cumulative-prefix attribution: time ``make_step(enabled)`` with
+    stages enabled one at a time in execution order; a stage's cost is
+    the increment over the previous prefix. ``make_step(())`` must
+    return a valid (possibly identity) step — its time is the scan
+    overhead, kept visible as ``overhead_ms``."""
+    cum = tuple(
+        time_scan_step(make_step(tuple(stages[:k])), carry, iters)
+        for k in range(len(stages) + 1)
+    )
+    attr = PlaneAttribution(stages=tuple(stages), cum_ms=cum)
+    attr.check()
+    return attr

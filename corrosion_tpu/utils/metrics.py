@@ -46,13 +46,16 @@ class Counter:
             self._values[key] = self._values.get(key, 0.0) + value
 
     def get(self, **labels: str) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
 
     def render(self) -> list[str]:
         out = [f"# TYPE {self.name} counter"]
         if self.help:
             out.insert(0, f"# HELP {self.name} {self.help}")
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(key)} {v:g}")
         if len(out) <= (2 if self.help else 1):
             out.append(f"{self.name} 0")
@@ -76,13 +79,16 @@ class Gauge:
             self._values[key] = self._values.get(key, 0.0) + value
 
     def get(self, **labels: str) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
 
     def render(self) -> list[str]:
         out = [f"# TYPE {self.name} gauge"]
         if self.help:
             out.insert(0, f"# HELP {self.name} {self.help}")
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
             out.append(f"{self.name}{_fmt_labels(key)} {v:g}")
         if len(out) <= (2 if self.help else 1):
             out.append(f"{self.name} 0")
@@ -110,41 +116,57 @@ class Histogram:
             self._totals[key] = self._totals.get(key, 0) + 1
 
     def count(self, **labels: str) -> int:
-        return self._totals.get(_label_key(labels), 0)
+        with self._lock:
+            return self._totals.get(_label_key(labels), 0)
 
     def quantile(self, q: float, **labels: str) -> float:
-        """Approximate quantile from bucket boundaries (diagnostics)."""
+        """Approximate quantile from bucket counts (diagnostics).
+
+        Interpolates linearly WITHIN the winning bucket (Prometheus'
+        histogram_quantile rule) instead of returning its upper bound —
+        the latter biased p50/p99 up by as much as one bucket width.
+        Observations beyond the last bucket still report +inf.
+        """
         key = _label_key(labels)
-        total = self._totals.get(key, 0)
-        if total == 0:
-            return float("nan")
+        with self._lock:
+            total = self._totals.get(key, 0)
+            if total == 0:
+                return float("nan")
+            counts = list(self._counts[key])
         target = q * total
         for i, b in enumerate(self.buckets):
-            if self._counts[key][i] >= target:
-                return b
+            if counts[i] >= target:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                below = counts[i - 1] if i > 0 else 0
+                in_bucket = counts[i] - below
+                if in_bucket <= 0:
+                    return lo
+                frac = (target - below) / in_bucket
+                return lo + frac * (b - lo)
         return float("inf")
 
     def render(self) -> list[str]:
         out = [f"# TYPE {self.name} histogram"]
         if self.help:
             out.insert(0, f"# HELP {self.name} {self.help}")
-        for key in sorted(self._totals):
+        # Snapshot under the lock so a concurrent observe() can neither
+        # resize the dicts mid-iteration nor tear a bucket/sum/count trio.
+        with self._lock:
+            snap = [
+                (key, list(self._counts[key]), self._sums[key],
+                 self._totals[key])
+                for key in sorted(self._totals)
+            ]
+        for key, counts, total_sum, total in snap:
             for i, b in enumerate(self.buckets):
                 lk = key + (("le", f"{b:g}"),)
                 out.append(
-                    f"{self.name}_bucket{_fmt_labels(lk)} "
-                    f"{self._counts[key][i]}"
+                    f"{self.name}_bucket{_fmt_labels(lk)} {counts[i]}"
                 )
             lk = key + (("le", "+Inf"),)
-            out.append(
-                f"{self.name}_bucket{_fmt_labels(lk)} {self._totals[key]}"
-            )
-            out.append(
-                f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]:g}"
-            )
-            out.append(
-                f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}"
-            )
+            out.append(f"{self.name}_bucket{_fmt_labels(lk)} {total}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {total_sum:g}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {total}")
         return out
 
 
@@ -182,14 +204,23 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         """Flat dict for the admin RPC / tests."""
         out: dict[str, float] = {}
-        for name, m in self._metrics.items():
+        with self._lock:
+            metrics = list(self._metrics.items())
+        for name, m in metrics:
             if isinstance(m, (Counter, Gauge)):
-                for key, v in m._values.items():
+                with m._lock:
+                    items = list(m._values.items())
+                for key, v in items:
                     out[name + _fmt_labels(key)] = v
             elif isinstance(m, Histogram):
-                for key, t in m._totals.items():
+                with m._lock:
+                    items = [
+                        (key, t, m._sums[key])
+                        for key, t in m._totals.items()
+                    ]
+                for key, t, s in items:
                     out[name + "_count" + _fmt_labels(key)] = t
-                    out[name + "_sum" + _fmt_labels(key)] = m._sums[key]
+                    out[name + "_sum" + _fmt_labels(key)] = s
         return out
 
 
@@ -205,7 +236,13 @@ async def serve_prometheus(
                 h = await reader.readline()
                 if h in (b"\r\n", b"\n", b""):
                     break
-            ok = b"/metrics" in line or b"GET / " in line
+            # Parse the request line properly: "METHOD SP PATH SP VERSION".
+            # Substring matching (`b"/metrics" in line`) accepted any URL
+            # merely containing "metrics".
+            parts = line.split()
+            method = parts[0] if len(parts) >= 1 else b""
+            path = parts[1].split(b"?", 1)[0] if len(parts) >= 2 else b""
+            ok = method == b"GET" and path in (b"/metrics", b"/")
             body = registry.render().encode() if ok else b""
             status = (
                 b"HTTP/1.1 200 OK\r\n" if ok else b"HTTP/1.1 404 Not Found\r\n"

@@ -6,7 +6,13 @@ each epoch (ops/sparse_writers.py). Reports the north-star visibility
 metric, convergence over watermarks AND CRDT cells vs the serial-merge
 ground truth, per-node state bytes, and rotation stats.
 
+The run is instrumented by the kernel telemetry plane (sim/telemetry.py):
+every epoch prints a progress line to stderr (long 100k runs no longer go
+dark for minutes), and ``--flight PATH`` additionally streams per-round
+curves to a replayable JSONL flight record.
+
 Usage: python scripts/sparse100k_smoke.py [rounds] [--cells-check]
+       [--flight[=PATH]]
 """
 
 from __future__ import annotations
@@ -23,6 +29,11 @@ import numpy as np
 
 from corrosion_tpu import models
 from corrosion_tpu.sim import sparse_engine
+from corrosion_tpu.sim.telemetry import (
+    FlightRecorder,
+    KernelTelemetry,
+    flight_path_from_argv,
+)
 
 
 def main() -> None:
@@ -33,6 +44,7 @@ def main() -> None:
 
     ensure_live_backend()
     enable_persistent_cache()
+    flight = flight_path_from_argv(sys.argv)
     nums = [a for a in sys.argv[1:] if not a.startswith("-")]
     rounds = int(nums[0]) if nums else 240
     cells_check = "--cells-check" in sys.argv
@@ -45,12 +57,23 @@ def main() -> None:
             cohort=24, k_dev=16, samples=128,
         )
 
+    tele = KernelTelemetry(
+        engine="sparse",
+        progress=sys.stderr,
+        recorder=(
+            FlightRecorder(flight, engine="sparse") if flight else None
+        ),
+    )
     t0 = time.perf_counter()
     sstate, swim_state, vis_round, curves, info = (
-        sparse_engine.simulate_sparse(cfg, topo, sched, seed=0)
+        sparse_engine.simulate_sparse(
+            cfg, topo, sched, seed=0, telemetry=tele
+        )
     )
     jax.block_until_ready(sstate.data.contig)
     wall = time.perf_counter() - t0
+    if tele.recorder is not None:
+        tele.recorder.close()
 
     lat_rounds = np.asarray(vis_round) - sched.sample_round[:, None]
     seen = np.asarray(vis_round) >= 0
@@ -72,6 +95,7 @@ def main() -> None:
         "max_dev_entries": info["max_dev_entries"],
         "wall_s": round(wall, 2),
         "step_ms": round(wall / rounds * 1000.0, 1),
+        "step_inner_ms": round(tele.device_step_ms, 1),
         "state_mib": round(state_bytes / 2**20, 1),
         "state_bytes_per_node": int(state_bytes / cfg.n_nodes),
         "applied": int(

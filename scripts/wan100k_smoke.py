@@ -10,6 +10,14 @@ not affected by the scheduled partition (steady-state) — a write originating
 in a region that is cut off for 30 simulated seconds cannot be visible
 elsewhere before the heal, so the overall p99 measures partition recovery,
 not propagation speed.
+
+The run is instrumented by the kernel telemetry plane (sim/telemetry.py):
+every chunk execution prints a progress line to stderr (long 100k runs no
+longer go dark for minutes), and ``--flight PATH`` additionally streams
+per-round curves to a replayable JSONL flight record.
+
+Usage: python scripts/wan100k_smoke.py [rounds] [--steady] [--steptime]
+       [--flight[=PATH]]
 """
 
 from __future__ import annotations
@@ -27,6 +35,11 @@ import numpy as np
 from corrosion_tpu import models
 from corrosion_tpu.ops import swim_sparse
 from corrosion_tpu.sim import simulate, visibility_latencies
+from corrosion_tpu.sim.telemetry import (
+    FlightRecorder,
+    KernelTelemetry,
+    flight_path_from_argv,
+)
 
 # Device executions per dispatch (watchdog-safe at current step times;
 # the steptime warm slice must equal this so the timed window never
@@ -44,6 +57,7 @@ def main() -> None:
     enable_persistent_cache()
     steady = "--steady" in sys.argv  # no partition: pure propagation p99
     steptime = "--steptime" in sys.argv  # warm-chunk step timing only
+    flight = flight_path_from_argv(sys.argv)
     nums = [a for a in sys.argv[1:] if not a.startswith("-")]
     rounds = int(nums[0]) if nums else 16
     cfg, topo, sched = models.wan_100k(
@@ -55,6 +69,14 @@ def main() -> None:
         # compile skew. The warm slice must match max_chunk, or the timed
         # window compiles a different scan length.
         import dataclasses
+
+        if flight:
+            print(
+                "[wan100k] --flight is ignored with --steptime: the "
+                "recorder's per-chunk JSONL flush would skew the timed "
+                "window",
+                file=sys.stderr,
+            )
 
         ck = CHUNK
         if rounds - ck <= 0 or (rounds - ck) % ck != 0:
@@ -84,10 +106,21 @@ def main() -> None:
             "step_ms": round(wall / max(rounds - ck, 1) * 1000.0, 1),
         }))
         return
+    tele = KernelTelemetry(
+        engine="dense",
+        progress=sys.stderr,
+        recorder=(
+            FlightRecorder(flight, engine="dense") if flight else None
+        ),
+    )
     t0 = time.perf_counter()
-    final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=CHUNK)
+    final, curves = simulate(
+        cfg, topo, sched, seed=0, max_chunk=CHUNK, telemetry=tele
+    )
     jax.block_until_ready(final.data.contig)
     wall = time.perf_counter() - t0
+    if tele.recorder is not None:
+        tele.recorder.close()
 
     state_bytes = sum(
         x.size * x.dtype.itemsize
@@ -106,6 +139,7 @@ def main() -> None:
         "rounds": rounds,
         "wall_s": round(wall, 2),
         "step_ms": round(wall / rounds * 1000.0, 1),
+        "step_inner_ms": round(tele.device_step_ms, 1),
         "state_mib": round(state_bytes / 2**20, 1),
         "swim_bytes_per_node": swim_sparse.state_bytes_per_node(cfg.swim),
         "applied": int(

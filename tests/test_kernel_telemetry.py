@@ -1,0 +1,326 @@
+"""Kernel telemetry plane tests (sim/telemetry.py).
+
+Covers the RoundCurves schema parity across all three engines, the flight
+recorder (chunk-boundary streaming, crash tolerance, resume), the
+corro_kernel_* metrics bridge, the kernel_chunk tracer span, and the
+plane-attribution telescoping invariant.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from corrosion_tpu import models
+from corrosion_tpu.sim import simulate
+from corrosion_tpu.sim import telemetry as T
+from corrosion_tpu.sim.engine import Schedule
+from corrosion_tpu.utils import metrics as M
+from corrosion_tpu.utils import tracing as TR
+
+
+def _dense_run(**kw):
+    cfg, topo, sched = models.merge_10k(n=64, rounds=24, samples=16)
+    return simulate(cfg, topo, sched, seed=5, **kw)
+
+
+def test_round_curves_schema_rejects_unknown_keys():
+    with pytest.raises(ValueError):
+        T.round_curves(msgs=1, not_a_curve=2)
+    full = T.round_curves(msgs=1)
+    assert tuple(full) == T.ROUND_CURVE_KEYS
+
+
+def test_engines_emit_identical_round_curve_keys():
+    """The unify-and-assert parity check: dense, sparse, and chunk engines
+    must emit exactly the canonical RoundCurves key set."""
+    _, dense_curves = _dense_run()
+
+    from corrosion_tpu.sim import sparse_engine
+
+    s_cfg, s_topo, s_sched = models.anywrite_sparse(
+        n=96, w_hot=16, n_regions=4, rounds=24, cohort=8, epoch_rounds=8,
+        k_dev=8, samples=16,
+    )
+    *_, sparse_curves, _info = sparse_engine.simulate_sparse(
+        s_cfg, s_topo, s_sched, seed=0
+    )
+
+    from corrosion_tpu.ops.chunks import ChunkConfig
+    from corrosion_tpu.sim.chunk_engine import simulate_chunks
+
+    c_cfg = ChunkConfig(
+        n_nodes=16, n_streams=2, chunk_len=64, fanout=3, sync_interval=4,
+        gap_requests=4,
+    )
+    _, m = simulate_chunks(c_cfg, [0, 5], [511, 255], rounds=24, seed=1)
+    chunk_curves = m["curves"]
+
+    want = set(T.ROUND_CURVE_KEYS)
+    assert set(dense_curves) == want
+    assert set(sparse_curves) == want
+    assert set(chunk_curves) - {"round"} == want
+    for curves in (dense_curves, sparse_curves):
+        for k in T.ROUND_CURVE_KEYS:
+            assert curves[k].shape == (24,), k
+
+
+def test_vis_count_totals_match_final_visibility():
+    final, curves = _dense_run()
+    assert int(curves["vis_count"].sum()) == int(
+        (np.asarray(final.vis_round) >= 0).sum()
+    )
+
+
+def test_flight_recorder_chunked_run_and_metrics_bridge(tmp_path):
+    """A chunked run with the recorder writes per-round JSONL at each
+    chunk boundary; the registry afterwards carries corro_kernel_* series
+    whose totals equal the summed curves; the tracer holds one
+    kernel_chunk span per chunk."""
+    path = str(tmp_path / "flight.jsonl")
+    reg = M.MetricsRegistry()
+    tracer = TR.Tracer()
+    tele = T.KernelTelemetry(
+        engine="dense",
+        recorder=T.FlightRecorder(path, engine="dense"),
+        registry=reg,
+        tracer=tracer,
+    )
+    final, curves = _dense_run(max_chunk=8, telemetry=tele)
+    tele.recorder.close()
+
+    # Chunk boundaries: 24 rounds / 8 = 3 chunks, timed and spanned.
+    assert len(tele.chunk_walls) == 3
+    assert all(n == 8 for n, _ in tele.chunk_walls)
+    assert tele.device_step_ms > 0
+    spans = tracer.recent(name="kernel_chunk")
+    assert len(spans) == 3
+    assert [s["attrs"]["start_round"] for s in spans] == [0, 8, 16]
+
+    # JSONL replay reproduces the returned curves exactly.
+    rec, chunk_markers = T.replay_flight(path)
+    assert rec["round"].tolist() == list(range(24))
+    assert len(chunk_markers) == 3
+    assert all("wall_s" in c for c in chunk_markers)
+    for k in T.ROUND_CURVE_KEYS:
+        np.testing.assert_array_equal(
+            rec[k].astype(np.float64), curves[k].astype(np.float64), err_msg=k
+        )
+
+    # Metrics bridge: totals equal summed curves, on the same renderer
+    # the agent plane uses.
+    text = reg.render()
+    for k in T.ROUND_CURVE_KEYS:
+        got = reg.counter(f"corro_kernel_{k}_total").get(engine="dense")
+        assert got == float(curves[k].astype(np.float64).sum()), k
+        assert f"corro_kernel_{k}_total" in text
+    assert reg.counter("corro_kernel_rounds_total").get(engine="dense") == 24
+    assert reg.gauge("corro_kernel_need_last").get(engine="dense") == float(
+        curves["need"][-1]
+    )
+    assert reg.histogram("corro_kernel_chunk_seconds").count(engine="dense") == 3
+
+
+def test_flight_recorder_crash_resume(tmp_path):
+    """Kill mid-run (simulated: first half recorded, then a torn partial
+    line from the crash), resume from carried state appending to the same
+    record: replay must match a clean uninterrupted run exactly."""
+    cfg, topo, sched = models.merge_10k(n=64, rounds=24, samples=16)
+    clean_final, clean_curves = simulate(cfg, topo, sched, seed=7)
+
+    path = str(tmp_path / "flight.jsonl")
+    first = Schedule(
+        writes=sched.writes[:12], sample_writer=sched.sample_writer,
+        sample_ver=sched.sample_ver, sample_round=sched.sample_round,
+    )
+    second = Schedule(
+        writes=sched.writes[12:], sample_writer=sched.sample_writer,
+        sample_ver=sched.sample_ver, sample_round=sched.sample_round,
+    )
+    tele1 = T.KernelTelemetry(
+        engine="dense", recorder=T.FlightRecorder(path, engine="dense")
+    )
+    mid, _ = simulate(cfg, topo, first, seed=7, max_chunk=6, telemetry=tele1)
+    tele1.recorder.close()
+    # The crash: a round record torn mid-write.
+    with open(path, "a") as f:
+        f.write('{"kind": "round", "round": 12, "msgs": 31')
+
+    tele2 = T.KernelTelemetry(
+        engine="dense", recorder=T.FlightRecorder(path, engine="dense")
+    )
+    final, _ = simulate(
+        cfg, topo, second, seed=7, state=mid, max_chunk=6, telemetry=tele2
+    )
+    tele2.recorder.close()
+
+    rec, _ = T.replay_flight(path)
+    assert rec["round"].tolist() == list(range(24))
+    for k in T.ROUND_CURVE_KEYS:
+        np.testing.assert_array_equal(
+            rec[k].astype(np.float64),
+            clean_curves[k].astype(np.float64),
+            err_msg=k,
+        )
+    for a, b in zip(
+        np.asarray(final.vis_round), np.asarray(clean_final.vis_round)
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_replay_flight_skips_garbage_lines(tmp_path):
+    path = str(tmp_path / "f.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "flight", "version": 1}) + "\n")
+        f.write(json.dumps({"kind": "round", "round": 0, "msgs": 3}) + "\n")
+        f.write("{\"kind\": \"round\", \"round\": 1, \"msg")  # torn tail
+    rec, chunks = T.replay_flight(path)
+    assert rec["round"].tolist() == [0]
+    assert rec["msgs"].tolist() == [3]
+    assert chunks == []
+
+
+def test_progress_stream_emits_per_chunk_lines(tmp_path):
+    import io
+
+    out = io.StringIO()
+    tele = T.KernelTelemetry(engine="dense", progress=out)
+    _dense_run(max_chunk=8, telemetry=tele)
+    lines = [ln for ln in out.getvalue().splitlines() if ln]
+    assert len(lines) == 3
+    assert lines[0].startswith("[flight:dense] rounds 0..7 ")
+    assert lines[-1].startswith("[flight:dense] rounds 16..23 ")
+
+
+def test_plane_attribution_telescopes_and_scales():
+    """Cumulative-prefix attribution on a toy composite: increments plus
+    overhead telescope exactly to the full composite, and scaling onto a
+    run wall keeps sum(plane_ms) + residual_ms == step_ms."""
+    import jax.numpy as jnp
+
+    def make_step(enabled):
+        def step(carry, i):
+            x = carry
+            if "a" in enabled:
+                x = x + jnp.float32(1.0)
+            if "b" in enabled:
+                x = x * jnp.float32(1.0001)
+            return x
+
+        return step
+
+    attr = T.attribute_planes(
+        make_step, ("a", "b"), jnp.zeros((64,), jnp.float32), iters=3
+    )
+    attr.check()  # overhead + sum(increments) == full, exact
+    assert attr.full_ms > 0
+    plane, residual = attr.scale(100.0)
+    assert set(plane) == {"a", "b"}
+    assert all(v >= 0 for v in plane.values())
+    assert abs(sum(plane.values()) + residual - 100.0) < 1e-9
+
+
+def test_flight_path_from_argv_never_swallows_positionals():
+    f = T.flight_path_from_argv
+    assert f(["prog", "300"]) is None
+    assert f(["prog", "--flight", "300"]) == "flight.jsonl"  # 300 = rounds
+    assert f(["prog", "--flight=/tmp/x.jsonl", "300"]) == "/tmp/x.jsonl"
+    assert f(["prog", "--flight="]) == "flight.jsonl"
+    assert f(["prog"], default="d.jsonl") is None
+
+
+def test_simulate_chunks_zero_rounds_returns_empty_curves():
+    from corrosion_tpu.ops.chunks import ChunkConfig
+    from corrosion_tpu.sim.chunk_engine import simulate_chunks
+
+    cfg = ChunkConfig(n_nodes=8, n_streams=1, chunk_len=64, fanout=2)
+    _, m = simulate_chunks(cfg, [0], [63], rounds=0)
+    assert set(m["curves"]) == set(T.ROUND_CURVE_KEYS)
+    assert all(v.shape == (0,) for v in m["curves"].values())
+    assert m["chunks_sent"] == 0 and m["unapplied"] == 8
+
+
+def test_publish_curves_handles_partial_dicts():
+    reg = M.MetricsRegistry()
+    T.publish_curves(
+        reg, {"msgs": np.asarray([2, 3]), "need": np.asarray([5, 1])},
+        engine="chunk",
+    )
+    assert reg.counter("corro_kernel_msgs_total").get(engine="chunk") == 5
+    assert reg.gauge("corro_kernel_need_last").get(engine="chunk") == 1
+    assert reg.counter("corro_kernel_rounds_total").get(engine="chunk") == 2
+    # Keys absent from the curves emit nothing for that engine label.
+    assert (
+        reg.counter("corro_kernel_sessions_total").get(engine="chunk") == 0
+    )
+
+
+def test_chunk_engine_chunked_run_with_recorder(tmp_path):
+    """simulate_chunks(max_chunk=...) carries state/visibility across
+    device executions (identical results), and the recorder streams at
+    each boundary under engine="chunk"."""
+    from corrosion_tpu.ops.chunks import ChunkConfig
+    from corrosion_tpu.sim.chunk_engine import simulate_chunks
+
+    cfg = ChunkConfig(
+        n_nodes=16, n_streams=2, chunk_len=64, fanout=3, sync_interval=4,
+        gap_requests=4,
+    )
+    _, plain = simulate_chunks(cfg, [0, 5], [511, 255], rounds=24, seed=2)
+
+    path = str(tmp_path / "chunk.jsonl")
+    reg = M.MetricsRegistry()
+    tele = T.KernelTelemetry(
+        engine="chunk",
+        recorder=T.FlightRecorder(path, engine="chunk"),
+        registry=reg,
+    )
+    _, chunked = simulate_chunks(
+        cfg, [0, 5], [511, 255], rounds=24, seed=2, max_chunk=8,
+        telemetry=tele,
+    )
+    tele.recorder.close()
+
+    # Chunked == unchunked (RNG folds the absolute round index).
+    for k in T.ROUND_CURVE_KEYS:
+        np.testing.assert_array_equal(
+            plain["curves"][k], chunked["curves"][k], err_msg=k
+        )
+    assert chunked["p99_s"] == plain["p99_s"]
+    assert len(tele.chunk_walls) == 3
+    rec, markers = T.replay_flight(path)
+    assert rec["round"].tolist() == list(range(24))
+    assert [m["start"] for m in markers] == [0, 8, 16]
+    assert reg.counter("corro_kernel_applied_sync_total").get(
+        engine="chunk"
+    ) == float(chunked["curves"]["applied_sync"].astype(np.float64).sum())
+
+
+def test_sparse_engine_flight_recorder_per_epoch(tmp_path):
+    """Sparse runs flush at epoch boundaries and publish under
+    engine="sparse"."""
+    from corrosion_tpu.sim import sparse_engine
+
+    cfg, topo, sched = models.anywrite_sparse(
+        n=96, w_hot=16, n_regions=4, rounds=24, cohort=8, epoch_rounds=8,
+        k_dev=8, samples=16,
+    )
+    path = str(tmp_path / "sparse.jsonl")
+    reg = M.MetricsRegistry()
+    tele = T.KernelTelemetry(
+        engine="sparse",
+        recorder=T.FlightRecorder(path, engine="sparse"),
+        registry=reg,
+    )
+    *_, curves, info = sparse_engine.simulate_sparse(
+        cfg, topo, sched, seed=0, telemetry=tele
+    )
+    tele.recorder.close()
+    assert len(tele.chunk_walls) == info["epochs"] == 3
+    rec, markers = T.replay_flight(path)
+    assert rec["round"].tolist() == list(range(24))
+    assert [m["start"] for m in markers] == [0, 8, 16]
+    np.testing.assert_array_equal(rec["cold_healed"], curves["cold_healed"])
+    assert reg.counter("corro_kernel_msgs_total").get(engine="sparse") == float(
+        curves["msgs"].astype(np.float64).sum()
+    )

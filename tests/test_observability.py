@@ -65,6 +65,57 @@ def test_prometheus_http_endpoint():
     run(main())
 
 
+def test_prometheus_endpoint_404_on_other_paths():
+    """The request line is parsed, not substring-matched: only GET
+    /metrics (and /) serve the registry; any other URL — including ones
+    merely CONTAINING "metrics" — is a 404."""
+
+    async def main():
+        reg = M.MetricsRegistry()
+        reg.counter("corro_up").inc()
+        server, (host, port) = await M.serve_prometheus(reg, "127.0.0.1", 0)
+
+        def fetch_status(path):
+            try:
+                urllib.request.urlopen(f"http://{host}:{port}{path}")
+                return 200
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        try:
+            for path in ("/metricsfoo", "/not/metrics", "/favicon.ico",
+                         "/x?y=/metrics"):
+                status = await asyncio.to_thread(fetch_status, path)
+                assert status == 404, path
+            assert await asyncio.to_thread(fetch_status, "/metrics") == 200
+            # Query strings on the real path still serve.
+            assert (
+                await asyncio.to_thread(fetch_status, "/metrics?x=1") == 200
+            )
+        finally:
+            server.close()
+
+    run(main())
+
+
+def test_histogram_quantile_interpolates_within_bucket():
+    h = M.Histogram("h", buckets=(1.0, 2.0, 4.0))
+    for v in (1.2, 1.8):
+        h.observe(v)
+    # Both observations land in the (1, 2] bucket: the quantile must
+    # interpolate inside it, not report the 2.0 upper bound.
+    assert h.quantile(0.5) == pytest.approx(1.5)
+    assert h.quantile(1.0) == pytest.approx(2.0)
+    assert h.quantile(0.25) == pytest.approx(1.25)
+    # Observations beyond the last bucket surface as +inf, not a bound.
+    h.observe(100.0)
+    assert h.quantile(0.99) == float("inf")
+    # Empty histogram stays NaN.
+    import math
+
+    assert math.isnan(M.Histogram("e").quantile(0.5))
+
+
 def test_span_parentage_and_traceparent():
     tr = T.Tracer()
     with tr.span("outer", kind="test") as outer:
