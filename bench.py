@@ -99,6 +99,27 @@ def main() -> None:
                     + curves["applied_sync"].astype(np.float64).sum())
     merges = float(curves["cell_merges"].astype(np.float64).sum())
     lat = visibility_latencies(final, sched, cfg)
+
+    # Convergence health plane (sim/health.py): run-level protocol
+    # verdicts from the SAME timed run's curves, published alongside the
+    # corro_kernel_* series. The on-device delivery-latency histogram
+    # must agree with the exact host-side percentiles to one bucket —
+    # asserted here so the two measurement paths can never drift apart
+    # silently.
+    from corrosion_tpu.sim import health as health_mod
+
+    rep = health_mod.report_from_curves(
+        curves, engine="dense", round_ms=cfg.round_ms
+    )
+    health_mod.publish_report(registry, rep)
+    if np.isfinite(lat["p50_s"]) and rep.vis_total:
+        host_b = health_mod.latency_bucket(
+            lat["p50_s"] / (cfg.round_ms / 1000.0)
+        )
+        assert abs(host_b - rep.vis_p50_bucket) <= 1, (
+            f"on-device delivery-latency histogram disagrees with "
+            f"host-side p50: bucket {rep.vis_p50_bucket} vs {host_b}"
+        )
     heads = np.asarray(final.data.head, dtype=np.float64)
     contig = np.asarray(final.data.contig, dtype=np.float64)
     converged = bool((contig == heads[None, :]).all())
@@ -216,8 +237,16 @@ def main() -> None:
             (np.asarray(st5.data.contig) == heads5[None, :]).all()
         )
         p99_5 = lat5["p99_s"]
+        rep5 = health_mod.report_from_curves(
+            curves5, engine="dense", round_ms=cfg5.round_ms
+        )
         extra_100k = {
             "p99_change_visibility_100k_s": round(p99_5, 2),
+            # Health plane over the timed window (rounds ck..); the
+            # converged round is relative to that window's start.
+            "staleness_p99_100k": round(rep5.staleness_p99, 1),
+            "vis_hist_p99_100k_s": rep5.to_dict()["vis_p99_s"],
+            "queue_backlog_peak_100k": rep5.queue_backlog_peak,
             "p50_100k_s": round(lat5["p50_s"], 2),
             "vs_baseline_100k": (
                 round(10.0 / p99_5, 2) if p99_5 > 0 else None
@@ -267,6 +296,17 @@ def main() -> None:
                     round(step_ms, 1) - round(swim_ms, 1) - round(bcast_ms, 1)
                     - round(sync_ms, 1) - round(track_ms, 1), 1
                 ),
+                # Convergence health plane (derived from the flight
+                # curves alone; bucket-edge seconds, so >= the exact
+                # percentiles above by construction).
+                "converged_round": rep.converged_round,
+                "staleness_p99": round(rep.staleness_p99, 1),
+                "staleness_peak_node": rep.staleness_max_peak,
+                # Through the report's JSON-safe serializer: overflow
+                # percentiles become "inf", never a bare Infinity token.
+                "vis_hist_p50_s": rep.to_dict()["vis_p50_s"],
+                "vis_hist_p99_s": rep.to_dict()["vis_p99_s"],
+                "queue_backlog_peak": rep.queue_backlog_peak,
                 **extra_100k,
             }
         )

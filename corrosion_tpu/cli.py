@@ -87,6 +87,57 @@ def _build_parser() -> argparse.ArgumentParser:
     cs = add("consul", help="consul bridge")
     cs.add_argument("consul_cmd", choices=["sync"])
 
+    # Kernel convergence observability (sim/health.py): turn a flight
+    # recording into a protocol-health verdict, follow one live, diff
+    # two runs for regressions, or record a small demo flight.
+    ob = add("obs", help="kernel convergence observability")
+    ob_sub = ob.add_subparsers(dest="obs_cmd", required=True)
+
+    orp = ob_sub.add_parser(
+        "report", parents=[common],
+        help="derive a convergence report from a flight JSONL",
+    )
+    orp.add_argument("flight", help="flight-recorder JSONL path")
+    orp.add_argument("--round-ms", type=float, default=500.0)
+    orp.add_argument("--kill-round", type=int, action="append",
+                     default=None, help="ground-truth churn kill round "
+                     "(repeatable; refines detection latency)")
+    orp.add_argument("--json", action="store_true")
+
+    otl = ob_sub.add_parser(
+        "tail", parents=[common],
+        help="stream a flight record's progress (live with --follow)",
+    )
+    otl.add_argument("flight")
+    otl.add_argument("--follow", "-f", action="store_true",
+                     help="keep polling for new records (tail -f)")
+    otl.add_argument("--rounds", action="store_true",
+                     help="print every round record, not chunk summaries")
+    otl.add_argument("--poll", type=float, default=0.25)
+    otl.add_argument("--idle-timeout", type=float, default=None,
+                     help="stop following after this many idle seconds")
+
+    odf = ob_sub.add_parser(
+        "diff", parents=[common],
+        help="flag convergence regressions between two runs",
+    )
+    odf.add_argument("baseline", help="flight JSONL or report JSON")
+    odf.add_argument("candidate", help="flight JSONL or report JSON")
+    odf.add_argument("--tolerance", type=float, default=0.2,
+                     help="relative regression tolerance (default 0.2)")
+    odf.add_argument("--round-ms", type=float, default=500.0)
+    odf.add_argument("--json", action="store_true")
+
+    orc = ob_sub.add_parser(
+        "record", parents=[common],
+        help="record a small-cluster demo flight (CI artifact source)",
+    )
+    orc.add_argument("--out", default="flight.jsonl")
+    orc.add_argument("--nodes", type=int, default=128)
+    orc.add_argument("--rounds", type=int, default=64)
+    orc.add_argument("--churn", action="store_true")
+    orc.add_argument("--seed", type=int, default=0)
+
     # command/tls.rs:1-94: `corrosion tls {ca,server,client} generate`
     tl = add("tls", help="certificate generation")
     tl.add_argument("tls_kind", choices=["ca", "server", "client"])
@@ -114,6 +165,8 @@ def main(argv: list[str] | None = None) -> int:
 
 
 async def _dispatch(args, cfg: Config) -> int:
+    if args.command == "obs":
+        return _obs(args)
     if args.command == "agent":
         return await _run_agent(cfg)
     if args.command == "query":
@@ -183,6 +236,89 @@ async def _dispatch(args, cfg: Config) -> int:
         from corrosion_tpu.integrations.consul import run_consul_sync
 
         await run_consul_sync(cfg)
+        return 0
+    return 2
+
+
+def _obs(args) -> int:
+    """`corrosion obs {report,tail,diff,record}` — the convergence health
+    plane's CLI (sim/health.py). The import is deferred so the agent
+    subcommands never pay for it; note that any ``corrosion_tpu.sim``
+    import pulls in jax (the package __init__ loads the engines), so obs
+    startup costs the jax import even for pure-JSONL report/tail/diff."""
+    from corrosion_tpu.sim import health
+
+    if args.obs_cmd == "report":
+        rep = health.report_from_flight(
+            args.flight, round_ms=args.round_ms,
+            kill_rounds=args.kill_round,
+        )
+        if args.json:
+            print(json.dumps(rep.to_dict()))
+        else:
+            print(rep.render())
+        return 0
+
+    if args.obs_cmd == "tail":
+        last_round: dict = {}
+        n_rounds = 0
+        for rec in health.iter_flight(
+            args.flight, follow=args.follow, poll_s=args.poll,
+            idle_timeout_s=args.idle_timeout,
+        ):
+            kind = rec.get("kind")
+            if kind == "flight":
+                print(
+                    f"[flight] engine={rec.get('engine', '?')} "
+                    f"version={rec.get('version', '?')}"
+                )
+            elif kind == "round":
+                last_round = rec
+                n_rounds += 1
+                if args.rounds:
+                    print(json.dumps(rec))
+            elif kind == "chunk" and not args.rounds:
+                wall = rec.get("wall_s")
+                tail = {
+                    k: last_round.get(k)
+                    for k in (
+                        "need", "mismatches", "staleness_sum",
+                        "queue_backlog", "swim_undetected_deaths",
+                    )
+                    if k in last_round
+                }
+                print(
+                    f"[chunk] rounds {rec.get('start')}.."
+                    f"{rec.get('start', 0) + rec.get('rounds', 0) - 1}"
+                    + (f" wall={wall}s" if wall is not None else "")
+                    + f" {json.dumps(tail)}"
+                )
+        print(f"[tail] {n_rounds} round records")
+        return 0
+
+    if args.obs_cmd == "diff":
+        base = health.load_report(args.baseline, round_ms=args.round_ms)
+        cand = health.load_report(args.candidate, round_ms=args.round_ms)
+        diff = health.diff_reports(base, cand, tolerance=args.tolerance)
+        if args.json:
+            print(json.dumps(diff))
+        else:
+            for row in diff["rows"]:
+                mark = "ok" if row["ok"] else "REGRESSION"
+                print(
+                    f"{row['metric']}: {row['baseline']} -> "
+                    f"{row['candidate']} [{mark}]"
+                )
+            for r in diff["regressions"]:
+                print(f"REGRESSION: {r}", file=sys.stderr)
+        return 1 if diff["regressions"] else 0
+
+    if args.obs_cmd == "record":
+        facts = health.record_demo_flight(
+            args.out, nodes=args.nodes, rounds=args.rounds,
+            churn=args.churn, seed=args.seed, progress=sys.stderr,
+        )
+        print(json.dumps(facts))
         return 0
     return 2
 

@@ -349,6 +349,7 @@ def anywrite_sparse(
 def mixed_storm(
     n: int = 1000, streams: int = 16, last_seq: int = 2047,
     rounds: int = 200, samples: int = 256, seed: int = 13,
+    n_cells: int = 512,
 ):
     """Config 3c: MIXED workload — ``streams`` large multi-chunk
     transactions disseminating seq-granularly WHILE a background
@@ -372,7 +373,10 @@ def mixed_storm(
         sync_budget=512,
         sync_chunk=128,
         queue=16,
-        n_cells=512,
+        # n_cells=0 drops the whole CRDT merge graph — schema-level
+        # tests use it to keep compiles cheap; convergence tests keep
+        # the live cell plane.
+        n_cells=n_cells,
     )
     rng = np.random.default_rng(seed)
     # Background storm: every writer commits small writes at ~4%/round.

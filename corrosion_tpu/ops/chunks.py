@@ -176,8 +176,15 @@ def chunk_round(
     covered = jnp.sum(
         jnp.where(live_new, have.ends - have.starts + 1, 0), axis=1
     )
-    need_seqs = jnp.sum(
-        jnp.maximum(row_last + 1 - covered, 0).astype(jnp.float32)
+    row_deficit = jnp.maximum(row_last + 1 - covered, 0)
+    need_seqs = jnp.sum(row_deficit.astype(jnp.float32))
+    # Worst single node's seq deficit (summed over its streams) — the
+    # chunk plane's staleness_max analogue. Bounded by S·(last_seq+1),
+    # comfortably u32.
+    need_node_max = jnp.max(
+        jnp.sum(
+            row_deficit.reshape(n, s_count).astype(jnp.uint32), axis=1
+        )
     )
     # Node-level sync sessions this round (phase depends only on the node).
     phase_n = (jnp.arange(n) * jnp.int32(40503)) % jnp.int32(
@@ -190,6 +197,7 @@ def chunk_round(
         "seqs_granted": jnp.sum(granted, dtype=jnp.uint32),
         "sessions": jnp.sum(due_n & peer_ok, dtype=jnp.uint32),
         "need_seqs": need_seqs,
+        "need_node_max": need_node_max,
         "applied_nodes": jnp.sum(
             applied_mask(new_state, last_seq, cfg), dtype=jnp.uint32
         ),

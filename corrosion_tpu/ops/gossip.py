@@ -1521,6 +1521,34 @@ def total_need(data: DataState) -> jax.Array:
     return jax.lax.cond(data.oo_any, _minus_window, lambda oo: need, data.oo)
 
 
+def staleness(data: DataState) -> tuple[jax.Array, jax.Array]:
+    """(staleness_sum f32[], staleness_max u32[]): per-node watermark lag
+    against the writers' committed heads.
+
+    A node's lag is Σ_w (head[w] - contig[node, w]) — how many committed
+    versions its applied watermark trails, the "how stale can a node
+    get" question (SURVEY north star). ``staleness_sum`` is the
+    cluster-wide mass (f32: N·W·versions exceeds u32 at 100k scale),
+    ``staleness_max`` the worst single node. Window-possessed versions
+    still count as lag: their content is applied but the watermark — and
+    therefore a causally-consistent read — has not crossed them.
+    """
+    gap = data.head[None, :] - jnp.minimum(data.contig, data.head[None, :])
+    node_lag = jnp.sum(gap, axis=1, dtype=jnp.uint32)  # u32[N]
+    return (
+        jnp.sum(node_lag.astype(jnp.float32)),
+        jnp.max(node_lag),
+    )
+
+
+def queue_backlog(data: DataState) -> jax.Array:
+    """u32[]: occupied pending-broadcast queue slots cluster-wide — the
+    anti-entropy backlog mass (the `corro_broadcast_pending` analogue
+    for the kernel plane). Sustained growth means the epidemic plane is
+    admitting faster than budgets expire entries."""
+    return jnp.sum(data.q_writer >= 0, dtype=jnp.uint32)
+
+
 def visibility(data: DataState, sample_writer: jax.Array, sample_ver: jax.Array) -> jax.Array:
     """bool[S, N]: is sampled write s visible at each node yet? Visible =
     at or below the contiguous watermark, OR possessed out-of-order in the

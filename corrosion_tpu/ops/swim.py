@@ -361,6 +361,35 @@ def mismatches(state: SwimState) -> jax.Array:
     return jnp.sum((believed_up != truth) & obs)
 
 
+def health_counts(state: SwimState) -> tuple[jax.Array, jax.Array]:
+    """(false_alarms, undetected_deaths): the directional split of the
+    membership error, per (live observer, non-self target) pair.
+
+    - ``false_alarms``: the target is ALIVE but believed suspect or down
+      — SWIM false suspicions (probe loss, slow refutation propagation).
+      Strictly wider than the alarm half of ``mismatches()``, which only
+      counts alive-believed-DOWN: a suspicion is already an alarm (the
+      reference starts the suspect→down timer on it).
+    - ``undetected_deaths``: the target is DEAD but still believed up
+      (severity below down) — detection lag after a kill; the per-event
+      rounds-to-detection curve derives from this host-side
+      (sim.health.detection_latencies).
+    """
+    n = state.view.shape[0]
+    sev = packed_sev(state.view)
+    obs = state.alive[:, None] & (
+        jnp.arange(n)[None, :] != jnp.arange(n)[:, None]
+    )
+    alive_t = state.alive[None, :]
+    false_alarms = jnp.sum(
+        obs & alive_t & (sev >= SEV_SUSPECT), dtype=jnp.uint32
+    )
+    undetected = jnp.sum(
+        obs & ~alive_t & (sev < SEV_DOWN), dtype=jnp.uint32
+    )
+    return false_alarms, undetected
+
+
 def accuracy(state: SwimState) -> jax.Array:
     """Approximate fraction of correct beliefs (f32; use mismatches() for
     exact convergence checks — XLA f32 division is reciprocal-based and

@@ -534,6 +534,39 @@ def mismatches(state: SparseSwimState) -> jax.Array:
     return default_mis + ent_mis - ent_default_mis
 
 
+def health_counts(state: SparseSwimState) -> tuple[jax.Array, jax.Array]:
+    """(false_alarms, undetected_deaths) — the dense kernel's directional
+    membership-error split, computed without materializing N×N.
+
+    Pairs with no exception entry hold the baseline alive@inc0 belief:
+    never a false alarm, always an undetected death when the target is
+    dead. Exception entries then correct both defaults per entry (at
+    most one entry per (row, target) — a ``_merge_one`` invariant).
+    """
+    n = state.exc_tgt.shape[0]
+    alive = state.alive
+    alive_count = jnp.sum(alive, dtype=jnp.uint32)
+    dead_count = jnp.uint32(n) - alive_count
+    ent_valid = (
+        (state.exc_tgt >= 0)
+        & alive[:, None]
+        & (state.exc_tgt != jnp.arange(n)[:, None])
+    )
+    t = jnp.maximum(state.exc_tgt, 0)
+    sev = packed_sev(state.exc_pkd)
+    # i32 gather (pred gathers serialize on TPU; see mismatches()).
+    truth = alive.astype(jnp.int32)[t] > 0
+    false_alarms = jnp.sum(
+        ent_valid & truth & (sev >= SEV_SUSPECT), dtype=jnp.uint32
+    )
+    # Default: every (live observer, dead target) pair is undetected;
+    # entries that reached DOWN severity are the detections.
+    detected = jnp.sum(
+        ent_valid & ~truth & (sev == SEV_DOWN), dtype=jnp.uint32
+    )
+    return false_alarms, alive_count * dead_count - detected
+
+
 def beliefs_about(state: SparseSwimState, target: int) -> jax.Array:
     """packed[N]: every node's belief about one target (tests/diagnostics)."""
     n = state.exc_tgt.shape[0]

@@ -16,8 +16,17 @@ from corrosion_tpu.sim.engine import (  # noqa: F401
     simulate,
     visibility_latencies,
 )
+from corrosion_tpu.sim.health import (  # noqa: F401
+    ConvergenceReport,
+    diff_reports,
+    publish_report,
+    report_from_curves,
+    report_from_flight,
+)
 from corrosion_tpu.sim.telemetry import (  # noqa: F401
+    HEALTH_CURVE_KEYS,
     ROUND_CURVE_KEYS,
+    VIS_LAT_EDGES,
     FlightRecorder,
     KernelTelemetry,
     publish_curves,

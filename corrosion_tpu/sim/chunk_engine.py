@@ -12,7 +12,13 @@ The scan body emits the canonical RoundCurves schema (sim/telemetry.py):
 intake, ``applied_sync`` = seqs granted by partial-need sync, ``need`` =
 remaining seq deficit to full coverage, ``vis_count`` = (node, stream)
 pairs newly reassembled this round; membership/CRDT keys zero-fill (this
-plane has no SWIM or cell state).
+plane has no SWIM or cell state). Convergence-health keys: staleness is
+in SEQS (``staleness_sum`` mirrors ``need``, ``staleness_max`` is the
+worst node's deficit), ``streams_applied`` is the reassembly level,
+``chunks_sent``/``seqs_granted`` carry the plane's own traffic names
+(mixed runs keep them separable from version-plane keys), and the
+delivery-latency histogram buckets the round each pair completed
+(streams commit at round 0).
 """
 
 from __future__ import annotations
@@ -46,6 +52,17 @@ def _scan(state, vis, last_seq, alive, base_key, ridx, cfg):
             sessions=stats["sessions"],
             need=stats["need_seqs"],
             vis_count=jnp.sum(newly, dtype=jnp.uint32),
+            # Convergence health plane. Staleness is in SEQS here (the
+            # plane's unit of need); streams commit at round 0, so a
+            # pair's delivery latency is simply the round it completed.
+            staleness_sum=stats["need_seqs"],
+            staleness_max=stats["need_node_max"],
+            streams_applied=stats["applied_nodes"],
+            chunks_sent=stats["chunks_sent"],
+            seqs_granted=stats["seqs_granted"],
+            **telemetry_mod.delivery_latency_hist(
+                jnp.broadcast_to(r, newly.shape), newly
+            ),
         )
         return (st, vis), curves
 

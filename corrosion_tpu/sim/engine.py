@@ -143,6 +143,9 @@ def cluster_round(
             state.data, topo, alive, partition, writes, k_bcast, cfg.gossip
         )
     with jax.named_scope("corro_swim"):
+        # Snapshot incarnations AFTER churn (revive bumps are rejoins,
+        # not flaps) so swim_flaps counts only refutation-driven bumps.
+        inc_pre = sw.incarnation
         sw = swim_impl.swim_round(sw, k_swim, state.round, cfg.swim)
     with jax.named_scope("corro_sync"):
         data, sstats = gossip_ops.sync_round(
@@ -168,6 +171,16 @@ def cluster_round(
             state.vis_round,
         )
 
+    # Convergence health observables (all elementwise/reduce — they fuse
+    # into the round; docs/OBSERVABILITY.md "Convergence plane").
+    with jax.named_scope("corro_health"):
+        newly = (vis_round >= 0) & (state.vis_round < 0)
+        lat_hist = telemetry_mod.delivery_latency_hist(
+            state.round - sample_round[:, None], newly
+        )
+        stale_sum, stale_max = gossip_ops.staleness(data)
+        false_alarms, undetected = swim_impl.health_counts(sw)
+
     stats = telemetry_mod.round_curves(
         mismatches=swim_impl.mismatches(sw),
         need=gossip_ops.total_need(data),
@@ -178,9 +191,14 @@ def cluster_round(
         cell_merges=bstats["cell_merges"] + sstats["cell_merges"],
         window_degraded=bstats["window_degraded"],
         sync_regrant=sstats["sync_regrant"],
-        vis_count=jnp.sum(
-            (vis_round >= 0) & (state.vis_round < 0), dtype=jnp.uint32
-        ),
+        vis_count=jnp.sum(newly, dtype=jnp.uint32),
+        staleness_sum=stale_sum,
+        staleness_max=stale_max,
+        swim_false_alarms=false_alarms,
+        swim_undetected_deaths=undetected,
+        swim_flaps=jnp.sum(sw.incarnation != inc_pre, dtype=jnp.uint32),
+        queue_backlog=gossip_ops.queue_backlog(data),
+        **lat_hist,
     )
     return (
         ClusterState(

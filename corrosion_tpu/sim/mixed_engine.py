@@ -45,7 +45,9 @@ from corrosion_tpu.ops import gossip as gossip_ops
 from corrosion_tpu.ops import intervals, swim as swim_ops
 from corrosion_tpu.ops.chunks import ChunkConfig, ChunkState
 from corrosion_tpu.ops.gossip import DataState, Topology
+from corrosion_tpu.sim import telemetry as telemetry_mod
 from corrosion_tpu.sim.engine import ClusterConfig, Schedule
+from corrosion_tpu.sim.telemetry import KernelTelemetry
 
 
 @dataclass(frozen=True)
@@ -182,6 +184,7 @@ def mixed_round(
     k_b, k_sw, k_sy, k_ck = jax.random.split(rng, 4)
     swim_impl = swim_ops.impl(cfg.swim)
     sw = state.swim
+    inc_pre = sw.incarnation
     alive = sw.alive
     n_regions = topo.region_rtt.shape[0]
     part = jnp.zeros((n_regions, n_regions), bool)
@@ -252,19 +255,46 @@ def mixed_round(
         state.round,
         state.vis_round,
     )
+    newly = (vis_round >= 0) & (state.vis_round < 0)
 
-    stats = {
-        "applied_broadcast": bstats["applied_broadcast"],
-        "applied_sync": sstats["applied_sync"],
-        "cell_merges": (
+    # Canonical RoundCurves schema (sim/telemetry.py): version-plane
+    # traffic rides the usual keys, the chunk plane stays separable via
+    # ``chunks_sent`` / ``seqs_granted`` / ``streams_applied`` (the big
+    # transactions' completion level), and the convergence health keys
+    # measure the composite: staleness over the version-plane watermarks
+    # (the big versions count — a node lags until its watermark crosses
+    # them), `need` carries both planes' outstanding mass.
+    stale_sum, stale_max = gossip_ops.staleness(data)
+    false_alarms, undetected = swim_impl.health_counts(sw)
+    stats = telemetry_mod.round_curves(
+        msgs=bstats["msgs"],
+        applied_broadcast=bstats["applied_broadcast"],
+        applied_sync=sstats["applied_sync"],
+        cell_merges=(
             bstats["cell_merges"] + sstats["cell_merges"] + admit_merges
         ),
-        "chunks_sent": cstats["chunks_sent"],
-        "seqs_granted": cstats["seqs_granted"],
-        "big_applied_nodes": jnp.sum(applied_after, dtype=jnp.uint32),
-        "need": gossip_ops.total_need(data),
-        "window_degraded": bstats["window_degraded"],
-    }
+        sessions=sstats["sessions"],
+        mismatches=swim_impl.mismatches(sw),
+        chunks_sent=cstats["chunks_sent"],
+        seqs_granted=cstats["seqs_granted"],
+        streams_applied=jnp.sum(applied_after, dtype=jnp.uint32),
+        need=(
+            gossip_ops.total_need(data).astype(jnp.float32)
+            + cstats["need_seqs"]
+        ),
+        window_degraded=bstats["window_degraded"],
+        sync_regrant=sstats["sync_regrant"],
+        vis_count=jnp.sum(newly, dtype=jnp.uint32),
+        staleness_sum=stale_sum,
+        staleness_max=stale_max,
+        swim_false_alarms=false_alarms,
+        swim_undetected_deaths=undetected,
+        swim_flaps=jnp.sum(sw.incarnation != inc_pre, dtype=jnp.uint32),
+        queue_backlog=gossip_ops.queue_backlog(data),
+        **telemetry_mod.delivery_latency_hist(
+            state.round - sample_round[:, None], newly
+        ),
+    )
     return (
         MixedState(
             data=data, swim=sw, chunks=chunks,
@@ -275,6 +305,25 @@ def mixed_round(
     )
 
 
+@partial(jax.jit, static_argnames=("cfg", "ccfg"))
+def _scan_mixed(
+    state, topo, xs, s_writer, s_version, s_last, s_w, s_v, s_r,
+    base_key, cfg, ccfg,
+):
+    """Whole-chunk scan, jitted once per (cfg, shapes) — chunked runs
+    with equal chunk lengths hit the compile cache."""
+
+    def body(carry, x):
+        w, c, r = x
+        key = jax.random.fold_in(base_key, r)
+        return mixed_round(
+            carry, topo, w, c, s_writer, s_version, s_last,
+            s_w, s_v, s_r, key, cfg, ccfg,
+        )
+
+    return jax.lax.scan(body, state, xs)
+
+
 def simulate_mixed(
     cfg: ClusterConfig,
     ccfg: ChunkConfig,
@@ -282,8 +331,19 @@ def simulate_mixed(
     schedule: Schedule,  # SMALL writes only
     streams: StreamSpec,
     seed: int = 0,
+    max_chunk: int | None = None,
+    telemetry: KernelTelemetry | None = None,
 ):
-    """Scan mixed_round over the schedule. Returns (final, curves)."""
+    """Scan mixed_round over the schedule. Returns (final, curves).
+
+    Emits the canonical RoundCurves schema (sim/telemetry.py) like every
+    other engine. ``max_chunk`` splits the run into several device
+    executions (state carried across; per-round RNG keys fold the
+    absolute round index, so results are identical either way), and
+    ``telemetry`` (sim.telemetry.KernelTelemetry) instruments each
+    execution as a chunk — timed, spanned, flushed to the flight
+    recorder, with run totals folded into the metrics registry.
+    """
     n = cfg.n_nodes
     s_writer = jnp.asarray(streams.writer, jnp.int32)
     s_version = jnp.asarray(streams.version, jnp.uint32)
@@ -315,20 +375,35 @@ def simulate_mixed(
     s_r = jnp.asarray(schedule.sample_round)
     base_key = jax.random.PRNGKey(seed)
 
-    @partial(jax.jit, static_argnames=())
-    def scan(state):
-        def body(carry, x):
-            w, c, r = x
-            key = jax.random.fold_in(base_key, r)
-            return mixed_round(
-                carry, topo, w, c, s_writer, s_version, s_last,
-                s_w, s_v, s_r, key, cfg, ccfg,
-            )
-
-        return jax.lax.scan(
-            body, state,
-            (writes, commit, jnp.arange(rounds, dtype=jnp.int32)),
+    step = max_chunk if max_chunk is not None else max(rounds, 1)
+    curve_parts: list[dict] = (
+        [] if rounds > 0
+        else [{k: np.zeros((0,)) for k in telemetry_mod.ROUND_CURVE_KEYS}]
+    )
+    for r0 in range(0, rounds, step):
+        r1 = min(r0 + step, rounds)
+        xs = (
+            writes[r0:r1], commit[r0:r1],
+            jnp.arange(r0, r1, dtype=jnp.int32),
         )
+        if telemetry is None:
+            state, curves = _scan_mixed(
+                state, topo, xs, s_writer, s_version, s_last,
+                s_w, s_v, s_r, base_key, cfg, ccfg,
+            )
+        else:
+            def _run(state=state, xs=xs):
+                return _scan_mixed(
+                    state, topo, xs, s_writer, s_version, s_last,
+                    s_w, s_v, s_r, base_key, cfg, ccfg,
+                )
 
-    final, curves = scan(state)
-    return final, {k: np.asarray(v) for k, v in curves.items()}
+            state, curves = telemetry.run_chunk(r0, _run)
+        curve_parts.append({k: np.asarray(v) for k, v in curves.items()})
+    merged = {
+        k: np.concatenate([p[k] for p in curve_parts])
+        for k in curve_parts[0]
+    }
+    if telemetry is not None:
+        telemetry.on_run_end(merged)
+    return state, merged

@@ -254,6 +254,8 @@ def _epoch_scan(
                 st.data, topo, alive, part, w_slots, k_b, cfg.gossip
             )
         with jax.named_scope("corro_swim"):
+            # After churn: revive bumps are rejoins, not flaps.
+            inc_pre = sw.incarnation
             sw = swim_impl.swim_round(sw, k_sw, r, cfg.swim)
         with jax.named_scope("corro_sync"):
             data, ssta = gossip_ops.sync_round(
@@ -280,6 +282,20 @@ def _epoch_scan(
                 (vr < 0) & vis_now & (hot & active_s)[:, None], r, vr
             )
 
+        # Convergence health observables. Staleness is measured on the
+        # HOT slot plane (head vs contig over the rotating slots); the
+        # cold plane's residue is already carried by `need` through
+        # cold_need, and demoted writers are zero-lag by rotation
+        # feasibility, so hot-plane lag is the whole story between
+        # forced demotions.
+        with jax.named_scope("corro_health"):
+            newly = (vr_new >= 0) & (vr < 0)
+            lat_hist = telemetry_mod.delivery_latency_hist(
+                r - s_round[:, None], newly
+            )
+            stale_sum, stale_max = gossip_ops.staleness(st.data)
+            false_alarms, undetected = swim_impl.health_counts(sw)
+
         stats = telemetry_mod.round_curves(
             mismatches=swim_impl.mismatches(sw),
             need=gossip_ops.total_need(st.data) + sw_ops.cold_need(st),
@@ -297,9 +313,16 @@ def _epoch_scan(
             cold_healed=csta["cold_healed"],
             # Hot-plane visibility events only; demoted-writer samples
             # resolve at epoch granularity outside the scan.
-            vis_count=jnp.sum(
-                (vr_new >= 0) & (vr < 0), dtype=jnp.uint32
+            vis_count=jnp.sum(newly, dtype=jnp.uint32),
+            staleness_sum=stale_sum,
+            staleness_max=stale_max,
+            swim_false_alarms=false_alarms,
+            swim_undetected_deaths=undetected,
+            swim_flaps=jnp.sum(
+                sw.incarnation != inc_pre, dtype=jnp.uint32
             ),
+            queue_backlog=gossip_ops.queue_backlog(st.data),
+            **lat_hist,
         )
         return (st, sw, vr_new), stats
 

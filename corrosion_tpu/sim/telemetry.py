@@ -7,10 +7,14 @@ the same observability surface:
 
 - **RoundCurves schema**: one canonical per-round stats contract
   (``ROUND_CURVE_KEYS``) that the ``lax.scan`` bodies of
-  ``sim.engine``, ``sim.sparse_engine``, and ``sim.chunk_engine`` all
-  populate (``round_curves`` zero-fills what an engine doesn't have, so
-  the key set is identical everywhere and downstream consumers never
-  branch per engine).
+  ``sim.engine``, ``sim.sparse_engine``, ``sim.chunk_engine``, and
+  ``sim.mixed_engine`` all populate (``round_curves`` zero-fills what an
+  engine doesn't have, so the key set is identical everywhere and
+  downstream consumers never branch per engine). The schema carries two
+  planes: the PR 1 performance keys and the convergence *health* keys
+  (``HEALTH_CURVE_KEYS``: staleness lag, SWIM health counters, backlog
+  mass, and a fixed-bucket delivery-latency histogram) analyzed
+  host-side by ``sim.health.ConvergenceReport``.
 - **FlightRecorder**: streams per-round curves to JSONL at every chunk
   boundary of a chunked run. Long 100k-node runs report progress instead
   of going dark for minutes, and a crashed run leaves a replayable
@@ -44,9 +48,34 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Delivery-latency histogram bucket upper edges, in ROUNDS (fixed at
+# trace time so the on-device bucketize is shape-static; one extra
+# overflow bucket catches everything past the last edge). With the
+# default 500 ms round these cover 0.5 s .. 32 s — the reference's
+# "how fast is a write visible cluster-wide" operating range.
+VIS_LAT_EDGES = (1, 2, 4, 8, 16, 32, 64)
+VIS_LAT_KEYS = tuple(f"vis_lat_b{i}" for i in range(len(VIS_LAT_EDGES) + 1))
+
+# Convergence health plane (PR 2): protocol-level observables computed
+# on-device inside every engine's scan body. Published under the
+# ``corro_kernel_health_*`` prefix (see ``series_name``); semantics per
+# key in docs/OBSERVABILITY.md ("Convergence plane").
+HEALTH_CURVE_KEYS = (
+    "staleness_sum",  # Σ per-node (head - contig watermark) gap, level
+    "staleness_max",  # max per-node watermark gap, level
+    "swim_false_alarms",  # (live obs, ALIVE target) believed suspect/down
+    "swim_undetected_deaths",  # (live obs, DEAD target) still believed up
+    "swim_flaps",  # refutation-driven incarnation bumps this round
+    "queue_backlog",  # occupied pending-broadcast queue slots, level
+    "streams_applied",  # (node, stream) pairs fully reassembled, level
+    "chunks_sent",  # chunk-plane chunks gossiped this round
+    "seqs_granted",  # chunk-plane seqs granted by partial-need sync
+) + VIS_LAT_KEYS
+
 # Canonical per-round curve keys. Every engine's scan body emits exactly
 # this set (superset of the former ad-hoc dicts); semantics per key are
-# documented in docs/OBSERVABILITY.md ("Kernel plane").
+# documented in docs/OBSERVABILITY.md ("Kernel plane" + "Convergence
+# plane").
 ROUND_CURVE_KEYS = (
     "msgs",
     "applied_broadcast",
@@ -59,7 +88,56 @@ ROUND_CURVE_KEYS = (
     "sync_regrant",
     "cold_healed",
     "vis_count",
+) + HEALTH_CURVE_KEYS
+
+# Level-style curves whose end-of-run value is a convergence verdict on
+# its own: published additionally as ``<series>_last`` gauges.
+LEVEL_CURVE_KEYS = (
+    "need",
+    "mismatches",
+    "staleness_sum",
+    "staleness_max",
+    "swim_false_alarms",
+    "swim_undetected_deaths",
+    "queue_backlog",
+    "streams_applied",
 )
+
+
+def series_name(key: str) -> str:
+    """Prometheus series stem for a canonical curve key.
+
+    PR 1 performance keys render as ``corro_kernel_<key>``; the
+    convergence health plane renders as ``corro_kernel_health_<key>`` so
+    dashboards can scrape the protocol-health surface as one family.
+    """
+    prefix = (
+        "corro_kernel_health_" if key in HEALTH_CURVE_KEYS
+        else "corro_kernel_"
+    )
+    return prefix + key
+
+
+def delivery_latency_hist(lat_rounds, newly) -> dict:
+    """Fixed-bucket delivery-latency histogram for one round, on-device.
+
+    ``lat_rounds`` (int[...]) is commit-to-visible latency in rounds for
+    every tracked pair; ``newly`` (bool[...], same shape) masks the pairs
+    that became visible THIS round. Bucket b counts newly-visible pairs
+    with ``VIS_LAT_EDGES[b-1] < lat <= VIS_LAT_EDGES[b]`` (b0 =
+    ``lat <= edges[0]``; the final bucket is the overflow past the last
+    edge). Shape-static bucketize + one-hot sum — a handful of
+    elementwise compares and reductions, TPU-friendly inside a scan
+    body. Returns ``{vis_lat_b0: u32, ...}`` ready for ``round_curves``.
+    """
+    lat = lat_rounds.astype(jnp.int32)
+    idx = jnp.zeros(lat.shape, jnp.int32)
+    for e in VIS_LAT_EDGES:
+        idx = idx + (lat > e).astype(jnp.int32)
+    return {
+        k: jnp.sum(newly & (idx == b), dtype=jnp.uint32)
+        for b, k in enumerate(VIS_LAT_KEYS)
+    }
 
 
 def round_curves(**stats) -> dict:
@@ -105,7 +183,12 @@ class FlightRecorder:
         self._f.flush()
 
     def _write(self, obj: dict) -> None:
+        # Flush every record: `obs tail` / external `tail -f` must see
+        # progress as it happens, not at close. Records are flushed in
+        # whole lines, so a live reader only ever races the final
+        # in-flight line (which replay_flight and iter_flight skip).
         self._f.write(json.dumps(obj) + "\n")
+        self._f.flush()
 
     def record_chunk(
         self, start_round: int, curves: dict, wall_s: float | None = None
@@ -183,10 +266,13 @@ def replay_flight(path: str) -> tuple[dict, list[dict]]:
 def publish_curves(registry, curves: dict, engine: str = "dense") -> None:
     """Fold finished-run curves into a MetricsRegistry.
 
-    Per canonical key: a ``corro_kernel_<key>_total{engine=...}`` counter
-    holding the run's summed curve. Level-style curves additionally set
-    ``corro_kernel_<key>_last{engine=...}`` gauges to their end-of-run
-    value (their sums are still published so totals always equal summed
+    Per canonical key: a ``<series>_total{engine=...}`` counter holding
+    the run's summed curve, where ``<series>`` is ``series_name(key)``
+    (``corro_kernel_<key>`` for the performance plane,
+    ``corro_kernel_health_<key>`` for the convergence health plane).
+    Level-style curves (``LEVEL_CURVE_KEYS``) additionally set
+    ``<series>_last{engine=...}`` gauges to their end-of-run value
+    (their sums are still published so totals always equal summed
     curves). ``corro_kernel_rounds_total`` counts simulated rounds.
     """
     n = 0
@@ -196,12 +282,12 @@ def publish_curves(registry, curves: dict, engine: str = "dense") -> None:
         arr = np.asarray(curves[k], dtype=np.float64)
         n = max(n, arr.size)
         registry.counter(
-            f"corro_kernel_{k}_total",
+            f"{series_name(k)}_total",
             f"kernel plane: summed per-round {k}",
         ).inc(float(arr.sum()), engine=engine)
-        if k in ("need", "mismatches") and arr.size:
+        if k in LEVEL_CURVE_KEYS and arr.size:
             registry.gauge(
-                f"corro_kernel_{k}_last",
+                f"{series_name(k)}_last",
                 f"kernel plane: end-of-run {k}",
             ).set(float(arr[-1]), engine=engine)
     registry.counter(

@@ -28,7 +28,7 @@ import jax
 import numpy as np
 
 from corrosion_tpu import models
-from corrosion_tpu.sim import sparse_engine
+from corrosion_tpu.sim import health, sparse_engine
 from corrosion_tpu.sim.telemetry import (
     FlightRecorder,
     KernelTelemetry,
@@ -108,6 +108,21 @@ def main() -> None:
         "vis_p99_s": round(float(np.percentile(lat_s, 99)), 2),
         "unseen_pairs": int((~seen).sum()),
     }
+    # Convergence health plane (hot-slot staleness; cold residue rides
+    # `need`). Same derivation as `obs report` on the --flight record.
+    rep = health.report_from_curves(
+        curves, engine="sparse", round_ms=cfg.round_ms
+    )
+    out.update({
+        "converged_round": rep.converged_round,
+        "staleness_p99": round(rep.staleness_p99, 1),
+        "staleness_peak_node": rep.staleness_max_peak,
+        # JSON-safe serializer: overflow percentiles render "inf".
+        "vis_hist_p50_s": rep.to_dict()["vis_p50_s"],
+        "vis_hist_p99_s": rep.to_dict()["vis_p99_s"],
+        "queue_backlog_peak": rep.queue_backlog_peak,
+        "swim_false_alarms": int(rep.false_alarms_total),
+    })
     if cells_check:
         from corrosion_tpu.ops import gossip as gossip_ops
         from corrosion_tpu.ops import sparse_writers as sw_ops

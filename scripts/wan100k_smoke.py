@@ -34,7 +34,7 @@ import numpy as np
 
 from corrosion_tpu import models
 from corrosion_tpu.ops import swim_sparse
-from corrosion_tpu.sim import simulate, visibility_latencies
+from corrosion_tpu.sim import health, simulate, visibility_latencies
 from corrosion_tpu.sim.telemetry import (
     FlightRecorder,
     KernelTelemetry,
@@ -159,6 +159,21 @@ def main() -> None:
         "vis_p99_s": round(lat["p99_s"], 2),
         "unseen_pairs": lat["unseen"],
     }
+    # Convergence health plane: run-level verdicts from the round curves
+    # (identical derivation to `obs report` on the --flight record).
+    rep = health.report_from_curves(
+        curves, engine="dense", round_ms=cfg.round_ms
+    )
+    out.update({
+        "converged_round": rep.converged_round,
+        "staleness_p99": round(rep.staleness_p99, 1),
+        "staleness_peak_node": rep.staleness_max_peak,
+        # JSON-safe serializer: overflow percentiles render "inf".
+        "vis_hist_p50_s": rep.to_dict()["vis_p50_s"],
+        "vis_hist_p99_s": rep.to_dict()["vis_p99_s"],
+        "queue_backlog_peak": rep.queue_backlog_peak,
+        "swim_false_alarms": int(rep.false_alarms_total),
+    })
     if rounds >= 120 and sched.partition is not None:
         # Every write committed while region 0 is cut (rounds [60, 120)) has
         # unreachable observers until the heal — and writes up to ~2 sync

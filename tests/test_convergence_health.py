@@ -1,0 +1,380 @@
+"""Convergence health plane tests (sim/health.py + the extended
+RoundCurves schema + the `obs` CLI).
+
+The acceptance anchor: on a 512-node dense run WITH churn, `obs report`
+derives time-to-convergence, staleness p99, a delivery-latency CDF, and
+per-churn-event detection latency from the flight recording ALONE, and
+the CDF agrees with the exact host-side recomputation
+(``visibility_latencies``) to within one histogram bucket.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim import health as H
+from corrosion_tpu.sim import simulate, visibility_latencies
+from corrosion_tpu.sim import telemetry as T
+from corrosion_tpu.sim.engine import Schedule
+
+
+def _churn_cluster(n=512, rounds=96, samples=64, seed=9):
+    """The SAME scenario builder `obs record` and CI use — the tests must
+    exercise the artifact pipeline's cluster, not a private twin."""
+    return H.churned_demo_cluster(
+        nodes=n, rounds=rounds, samples=samples, seed=seed
+    )
+
+
+@pytest.fixture(scope="module")
+def churn_run(tmp_path_factory):
+    """One 512-node churned run, flight-recorded, shared by the module's
+    assertions (the run dominates the test wall)."""
+    cfg, topo, sched, kill_rounds = _churn_cluster()
+    path = str(tmp_path_factory.mktemp("flight") / "churn512.jsonl")
+    tele = T.KernelTelemetry(
+        engine="dense", recorder=T.FlightRecorder(path, engine="dense")
+    )
+    final, curves = simulate(
+        cfg, topo, sched, seed=2, max_chunk=24, telemetry=tele
+    )
+    tele.recorder.close()
+    return cfg, topo, sched, kill_rounds, path, final, curves
+
+
+def test_report_from_flight_alone_derives_convergence(churn_run):
+    """Acceptance: time-to-convergence, staleness p99, delivery CDF, and
+    detection latency all come out of the JSONL flight record with no
+    final state in sight."""
+    cfg, topo, sched, kill_rounds, path, final, curves = churn_run
+    rep = H.report_from_flight(
+        path, round_ms=cfg.round_ms, kill_rounds=kill_rounds
+    )
+    assert rep.engine == "dense"
+    assert rep.rounds == sched.rounds
+
+    # The run must actually converge (drain tail sized for it), and the
+    # report must see it strictly before the final round.
+    assert rep.converged, (rep.need_last, rep.staleness_last)
+    assert 0 < rep.converged_round < sched.rounds
+    assert rep.ttc_s == rep.converged_round * cfg.round_ms / 1000.0
+    # Ground truth: every round from converged_round on is all-quiet,
+    # and the one before it is not.
+    quiet = (
+        (np.asarray(curves["need"]) == 0)
+        & (np.asarray(curves["mismatches"]) == 0)
+        & (np.asarray(curves["staleness_sum"]) == 0)
+    )
+    assert quiet[rep.converged_round:].all()
+    assert not quiet[rep.converged_round - 1]
+
+    # Staleness verdicts match the curves.
+    assert rep.staleness_p99 == pytest.approx(
+        float(np.percentile(np.asarray(curves["staleness_sum"]), 99))
+    )
+    assert rep.staleness_max_peak == float(
+        np.asarray(curves["staleness_max"]).max()
+    )
+    assert rep.staleness_p99 > 0  # the churn run was not trivially quiet
+
+    # Churn detection: the kill wave was detected, in bounded time.
+    assert len(rep.detection_events) == 1
+    det = rep.detection_events[0]["detected_rounds"]
+    assert det is not None and 0 < det < sched.rounds
+    assert rep.undetected_unresolved == 0
+    # The SWIM plane actually saw the event.
+    assert float(np.asarray(curves["swim_undetected_deaths"]).max()) > 0
+
+
+def test_device_cdf_agrees_with_host_recomputation(churn_run):
+    """Acceptance: the on-device delivery-latency histogram's p50/p99
+    agree with the exact host-side visibility_latencies percentiles to
+    within one histogram bucket."""
+    cfg, topo, sched, kill_rounds, path, final, curves = churn_run
+    rep = H.report_from_flight(path, round_ms=cfg.round_ms)
+    lat = visibility_latencies(final, sched, cfg)
+
+    # Full agreement on event counts: every (sample, node) visibility
+    # event landed in exactly one bucket.
+    assert rep.vis_total == int((np.asarray(final.vis_round) >= 0).sum())
+    assert rep.vis_total == int(np.asarray(curves["vis_count"]).sum())
+    assert lat["unseen"] == 0  # converged: nothing unseen
+
+    rm = cfg.round_ms / 1000.0
+    for q, got_bucket in (
+        (50, rep.vis_p50_bucket), (99, rep.vis_p99_bucket),
+    ):
+        host_rounds = lat[f"p{q}_s"] / rm
+        host_bucket = H.latency_bucket(host_rounds)
+        assert abs(host_bucket - got_bucket) <= 1, (
+            f"p{q}: host bucket {host_bucket} "
+            f"({lat[f'p{q}_s']}s) vs device bucket {got_bucket}"
+        )
+    # CDF is a proper CDF.
+    cdf = rep.vis_cdf
+    assert all(a <= b + 1e-12 for a, b in zip(cdf, cdf[1:]))
+    assert cdf[-1] == pytest.approx(1.0)
+
+
+def test_backlog_and_flap_curves_behave(churn_run):
+    cfg, topo, sched, kill_rounds, path, final, curves = churn_run
+    backlog = np.asarray(curves["queue_backlog"])
+    # Busy mid-run, fully drained once converged (budgets expire).
+    assert backlog.max() > 0
+    assert backlog[-1] == 0
+    # False suspicions healed by the end (everyone revived).
+    assert np.asarray(curves["swim_false_alarms"])[-1] == 0
+    assert np.asarray(curves["swim_undetected_deaths"])[-1] == 0
+
+
+def test_flight_recorder_streams_while_open(tmp_path):
+    """Satellite: each record is flushed as written, so a reader (obs
+    tail / tail -f) sees a chunk's rounds while the recorder is still
+    open — not at close."""
+    path = str(tmp_path / "live.jsonl")
+    rec = T.FlightRecorder(path, engine="dense", mode="w")
+    rec.record_chunk(0, {"msgs": np.asarray([3, 1, 4])}, wall_s=0.5)
+    # Recorder still open: an independent reader must see everything.
+    records = list(H.iter_flight(path, follow=False))
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["flight", "round", "round", "round", "chunk"]
+    assert [r["msgs"] for r in records if r["kind"] == "round"] == [3, 1, 4]
+
+    # A torn partial line is held back, not yielded.
+    rec._f.write('{"kind": "round", "round": 3, "msgs"')
+    rec._f.flush()
+    assert len(list(H.iter_flight(path, follow=False))) == 5
+    rec.close()
+
+
+@pytest.fixture(scope="module")
+def small_churn():
+    """One 64-node churned run with max_chunk=8: the sliced-schedule
+    tests below re-run 8-round chunks of the SAME shapes and hit the jit
+    cache instead of compiling their own scan lengths."""
+    cfg, topo, sched, _ = _churn_cluster(n=64, rounds=72, samples=32,
+                                         seed=1)
+    final, curves = simulate(cfg, topo, sched, seed=1, max_chunk=8)
+    return cfg, topo, sched, final, curves
+
+
+def _slice_schedule(sched, rounds):
+    return Schedule(
+        writes=sched.writes[:rounds],
+        kill=sched.kill[:rounds], revive=sched.revive[:rounds],
+        sample_writer=sched.sample_writer, sample_ver=sched.sample_ver,
+        sample_round=sched.sample_round,
+    )
+
+
+def test_visibility_latencies_all_dead_returns_nan(small_churn):
+    """Satellite: alive_only=True with every node dead must yield NaN
+    percentiles, not crash."""
+    cfg, topo, sched, _final, _curves = small_churn
+    short = _slice_schedule(sched, 8)
+    final, _ = simulate(cfg, topo, short, seed=1, max_chunk=8)
+    dead = final._replace(
+        swim=final.swim._replace(
+            alive=np.zeros_like(np.asarray(final.swim.alive))
+        )
+    )
+    lat = visibility_latencies(dead, short, cfg, alive_only=True)
+    assert np.isnan(lat["p50_s"]) and np.isnan(lat["p99_s"])
+    assert lat["pairs"] == 0 and lat["unseen"] == 0
+
+
+def test_visibility_latencies_reports_unseen(small_churn):
+    """Satellite: a run cut short of convergence reports unseen > 0 and
+    takes percentiles over the seen pairs only."""
+    cfg, topo, sched, _final, _curves = small_churn
+    short = _slice_schedule(sched, 16)
+    final, _ = simulate(cfg, topo, short, seed=1, max_chunk=8)
+    lat = visibility_latencies(final, short, cfg)
+    assert lat["unseen"] > 0
+    assert lat["pairs"] > 0
+    assert np.isfinite(lat["p50_s"])  # seen pairs still yield percentiles
+
+
+def test_visibility_hist_agreement_small_dense_run(small_churn):
+    """Satellite: on a small dense run the on-device histogram and the
+    host-side percentiles agree within one bucket (the cheap twin of the
+    512-node acceptance check — and with zero unseen pairs the bucketed
+    counts are exactly the host latencies' histogram)."""
+    cfg, topo, sched, final, curves = small_churn
+    lat = visibility_latencies(final, sched, cfg, alive_only=False)
+    assert lat["unseen"] == 0
+    hist = np.asarray([int(curves[k].sum()) for k in T.VIS_LAT_KEYS])
+    vis = np.asarray(final.vis_round)
+    lat_rounds = (vis - sched.sample_round[:, None])[vis >= 0]
+    want = np.zeros(len(T.VIS_LAT_KEYS), np.int64)
+    for lr in lat_rounds:
+        want[H.latency_bucket(float(lr))] += 1
+    np.testing.assert_array_equal(hist, want)
+
+
+def test_detection_latencies_synthetic():
+    u = np.asarray([0, 0, 3, 3, 1, 0, 0, 2, 2, 2])
+    events = H.detection_latencies(u)
+    assert events == [
+        {"round": 2, "detected_rounds": 3},
+        {"round": 7, "detected_rounds": None},  # unresolved at record end
+    ]
+    # Ground-truth kill rounds split overlapping events.
+    events = H.detection_latencies(u, kill_rounds=[2, 3])
+    assert events == [
+        {"round": 2, "detected_rounds": 3},
+        {"round": 3, "detected_rounds": 2},
+    ]
+
+
+def test_cdf_quantile_and_bucket_helpers():
+    counts = np.zeros(len(T.VIS_LAT_KEYS))
+    assert H.cdf_quantile(counts, 0.5) == (-1, float("nan")) or np.isnan(
+        H.cdf_quantile(counts, 0.5)[1]
+    )
+    counts[1] = 9
+    counts[3] = 1
+    idx, edge = H.cdf_quantile(counts, 0.5)
+    assert (idx, edge) == (1, 2.0)
+    idx, edge = H.cdf_quantile(counts, 0.99)
+    assert (idx, edge) == (3, 8.0)
+    # Overflow bucket reports inf.
+    counts[:] = 0
+    counts[-1] = 5
+    assert H.cdf_quantile(counts, 0.5)[1] == float("inf")
+    # Host-side bucketize mirrors the on-device edges.
+    assert H.latency_bucket(1) == 0
+    assert H.latency_bucket(2) == 1
+    assert H.latency_bucket(65) == len(T.VIS_LAT_EDGES)
+
+
+def test_report_publish_and_diff_regression(churn_run):
+    from corrosion_tpu.utils import metrics as M
+
+    cfg, topo, sched, kill_rounds, path, final, curves = churn_run
+    rep = H.report_from_curves(curves, engine="dense")
+    reg = M.MetricsRegistry()
+    H.publish_report(reg, rep)
+    assert reg.gauge("corro_kernel_health_converged").get(
+        engine="dense"
+    ) == 1.0
+    assert "corro_kernel_health_vis_p99_seconds" in reg.render()
+
+    # Self-diff is clean; a degraded candidate flags regressions.
+    assert H.diff_reports(rep, rep)["regressions"] == []
+    worse = H.report_from_curves(curves, engine="dense")
+    worse.vis_p99_s = rep.vis_p99_s * 2 + 1
+    worse.converged_round = None  # also: never converged
+    diff = H.diff_reports(rep, worse)
+    assert any("vis_p99_s" in r for r in diff["regressions"])
+    assert any("did not converge" in r for r in diff["regressions"])
+    # A candidate regressing into the OVERFLOW bucket (inf) is the worst
+    # case and must flag, not silently skip.
+    overflow = H.report_from_curves(curves, engine="dense")
+    overflow.vis_p99_s = float("inf")
+    assert any(
+        "vis_p99_s" in r
+        for r in H.diff_reports(rep, overflow)["regressions"]
+    )
+
+
+def test_load_report_classifies_large_and_pretty_json(tmp_path, churn_run):
+    """load_report must not mis-sniff a report JSON as a flight record:
+    big reports (schema key past any fixed prefix) and pretty-printed
+    ones both load as reports, and a flight JSONL still replays."""
+    cfg, topo, sched, kill_rounds, path, final, curves = churn_run
+    rep = H.report_from_curves(curves, engine="dense")
+    # Pad detection_events so the serialized schema key sits far past 4k.
+    rep.detection_events = [
+        {"round": i, "detected_rounds": 3} for i in range(400)
+    ]
+    big = tmp_path / "big.json"
+    big.write_text(json.dumps(rep.to_dict()))
+    assert len(big.read_text()) > 4096
+    loaded = H.load_report(str(big))
+    assert loaded.rounds == rep.rounds
+    assert len(loaded.detection_events) == 400
+    pretty = tmp_path / "pretty.json"
+    pretty.write_text(json.dumps(rep.to_dict(), indent=2))
+    assert H.load_report(str(pretty)).rounds == rep.rounds
+    assert H.load_report(path).rounds == sched.rounds  # flight unaffected
+
+
+def test_obs_cli_report_tail_diff(churn_run, capsys, tmp_path):
+    """The obs CLI end to end on a real flight record: report (text +
+    json), tail summary, self-diff exit 0, regression diff exit 1."""
+    from corrosion_tpu import cli
+
+    cfg, topo, sched, kill_rounds, path, final, curves = churn_run
+    assert cli.main(["obs", "report", path]) == 0
+    text = capsys.readouterr().out
+    assert "converged: yes at round" in text
+    assert "delivery latency" in text
+
+    assert cli.main(["obs", "report", path, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["schema"] == H.REPORT_SCHEMA
+    assert rep["engine"] == "dense"
+    report_json = tmp_path / "report.json"
+    report_json.write_text(json.dumps(rep))
+
+    assert cli.main(["obs", "tail", path]) == 0
+    tail = capsys.readouterr().out
+    assert "[flight] engine=dense" in tail
+    assert f"[tail] {sched.rounds} round records" in tail
+
+    # Self-diff (flight vs its own saved report) passes...
+    assert cli.main(["obs", "diff", path, str(report_json)]) == 0
+    capsys.readouterr()
+    # ...and a doctored regression fails with exit 1.
+    rep_bad = dict(rep)
+    rep_bad["vis_p99_s"] = (rep["vis_p99_s"] or 1) * 10 + 5
+    bad_json = tmp_path / "bad.json"
+    bad_json.write_text(json.dumps(rep_bad))
+    assert cli.main(["obs", "diff", path, str(bad_json)]) == 1
+
+
+def test_report_tolerates_pre_health_flights(tmp_path):
+    """Old flight files (PR 1 schema, no health keys) still replay into
+    a report: health series read as zero, no crashes."""
+    path = str(tmp_path / "old.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(
+            {"kind": "flight", "version": 1, "engine": "dense"}
+        ) + "\n")
+        for r in range(4):
+            f.write(json.dumps(
+                {"kind": "round", "round": r, "msgs": 5, "need": 0,
+                 "mismatches": 0}
+            ) + "\n")
+    rep = H.report_from_flight(path)
+    assert rep.rounds == 4
+    assert rep.converged  # need/mismatches zero, health zero-filled
+    assert rep.vis_total == 0
+    assert np.isnan(rep.vis_p50_s)
+
+    # The JSON encoding of a no-events report must be STRICT json (no
+    # bare NaN/Infinity tokens), and load_report round-trips it.
+    d = rep.to_dict()
+    text = json.dumps(d)
+    assert "NaN" not in text and "Infinity" not in text
+    assert d["vis_p50_s"] is None
+    saved = str(tmp_path / "rep.json")
+    with open(saved, "w") as f:
+        f.write(text)
+    back = H.load_report(saved)
+    assert np.isnan(back.vis_p50_s) and back.rounds == 4
+    # inf (overflow bucket) round-trips as "inf" and still diffs as a
+    # regression against a finite baseline.
+    rep_inf = H.report_from_flight(path)
+    rep_inf.vis_p99_s = float("inf")
+    with open(saved, "w") as f:
+        f.write(json.dumps(rep_inf.to_dict()))
+    assert H.load_report(saved).vis_p99_s == float("inf")
+    fin = H.report_from_flight(path)
+    fin.vis_p99_s = 4.0
+    assert any(
+        "vis_p99_s" in r
+        for r in H.diff_reports(fin, H.load_report(saved))["regressions"]
+    )

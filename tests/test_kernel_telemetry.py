@@ -32,8 +32,9 @@ def test_round_curves_schema_rejects_unknown_keys():
 
 
 def test_engines_emit_identical_round_curve_keys():
-    """The unify-and-assert parity check: dense, sparse, and chunk engines
-    must emit exactly the canonical RoundCurves key set."""
+    """The unify-and-assert parity check: dense, sparse, chunk, AND mixed
+    engines must emit exactly the canonical RoundCurves key set
+    (health-plane keys included)."""
     _, dense_curves = _dense_run()
 
     from corrosion_tpu.sim import sparse_engine
@@ -56,11 +57,22 @@ def test_engines_emit_identical_round_curve_keys():
     _, m = simulate_chunks(c_cfg, [0, 5], [511, 255], rounds=24, seed=1)
     chunk_curves = m["curves"]
 
+    from corrosion_tpu.models.baselines import mixed_storm
+    from corrosion_tpu.sim import mixed_engine
+
+    m_cfg, m_ccfg, m_topo, m_sched, m_spec = mixed_storm(
+        n=64, streams=2, last_seq=255, rounds=24, samples=16, n_cells=0
+    )
+    _, mixed_curves = mixed_engine.simulate_mixed(
+        m_cfg, m_ccfg, m_topo, m_sched, m_spec, seed=0
+    )
+
     want = set(T.ROUND_CURVE_KEYS)
     assert set(dense_curves) == want
     assert set(sparse_curves) == want
     assert set(chunk_curves) - {"round"} == want
-    for curves in (dense_curves, sparse_curves):
+    assert set(mixed_curves) == want
+    for curves in (dense_curves, sparse_curves, mixed_curves):
         for k in T.ROUND_CURVE_KEYS:
             assert curves[k].shape == (24,), k
 
@@ -108,16 +120,20 @@ def test_flight_recorder_chunked_run_and_metrics_bridge(tmp_path):
         )
 
     # Metrics bridge: totals equal summed curves, on the same renderer
-    # the agent plane uses.
+    # the agent plane uses. Health-plane keys render under the
+    # corro_kernel_health_ prefix (T.series_name).
     text = reg.render()
     for k in T.ROUND_CURVE_KEYS:
-        got = reg.counter(f"corro_kernel_{k}_total").get(engine="dense")
+        got = reg.counter(f"{T.series_name(k)}_total").get(engine="dense")
         assert got == float(curves[k].astype(np.float64).sum()), k
-        assert f"corro_kernel_{k}_total" in text
+        assert f"{T.series_name(k)}_total" in text
     assert reg.counter("corro_kernel_rounds_total").get(engine="dense") == 24
     assert reg.gauge("corro_kernel_need_last").get(engine="dense") == float(
         curves["need"][-1]
     )
+    assert reg.gauge("corro_kernel_health_staleness_sum_last").get(
+        engine="dense"
+    ) == float(curves["staleness_sum"][-1])
     assert reg.histogram("corro_kernel_chunk_seconds").count(engine="dense") == 3
 
 

@@ -42,11 +42,22 @@ def test_mixed_workload_converges_with_big_versions():
     # Every (node, stream) fully reassembled (directly or via sync
     # backfill).
     assert bool(np.asarray(final.applied_before).all())
-    assert int(curves["big_applied_nodes"][-1]) == cfg.n_nodes * len(
+    assert int(curves["streams_applied"][-1]) == cfg.n_nodes * len(
         spec.writer
     )
+    # Converged end state shows in the health plane too.
+    assert float(curves["staleness_sum"][-1]) == 0.0
+    assert float(curves["need"][-1]) == 0.0
     # Sampled small writes all became visible everywhere.
     assert int((np.asarray(final.vis_round) < 0).sum()) == 0
+    # The big versions' content moves on the chunk plane; the version
+    # plane's queues must never have carried them. Final queues should
+    # be drained anyway, but the stronger check: chunk traffic happened
+    # AND big versions applied at nodes whose coverage came gap-free.
+    # The canonical schema keeps the chunk plane separable from the
+    # version-plane msgs/applied_sync exactly for this.
+    assert int(curves["chunks_sent"].sum()) > 0
+    assert int(curves["seqs_granted"].sum()) > 0
     # Cells: ground truth = serial merge over every version of every
     # writer, big ones included (they derive cells like any version).
     ref = gossip.serial_merge_reference(heads, cfg.gossip)
@@ -56,14 +67,53 @@ def test_mixed_workload_converges_with_big_versions():
     assert bool(jnp.all(pc.value_rank == ref.value_rank[None, :]))
 
 
-def test_big_versions_do_not_ride_broadcast_queues():
-    cfg, ccfg, topo, sched, spec, final, curves = _run_small(rounds=120)
-    # The big versions' content moves on the chunk plane; the version
-    # plane's queues must never have carried them. Final queues should be
-    # drained anyway, but the stronger check: chunk traffic happened AND
-    # big versions applied at nodes whose coverage came gap-free.
-    assert int(curves["chunks_sent"].sum()) > 0
-    assert int(curves["seqs_granted"].sum()) > 0
+def test_mixed_engine_chunked_run_with_telemetry(tmp_path):
+    """simulate_mixed(max_chunk=...) carries state across device
+    executions (identical curves), and the flight recorder streams at
+    each boundary under engine="mixed" — the PR 1 telemetry API the
+    mixed engine was missing.
+
+    Uses the same small config as test_kernel_telemetry's parity check
+    deliberately: the unchunked baseline scan is then already in the jit
+    cache and only the chunk-length scan compiles.
+    """
+    from corrosion_tpu.sim import telemetry as T
+    from corrosion_tpu.utils import metrics as M
+
+    cfg, ccfg, topo, sched, spec = mixed_storm(
+        n=64, streams=2, last_seq=255, rounds=24, samples=16, n_cells=0
+    )
+    _, plain = mixed_engine.simulate_mixed(
+        cfg, ccfg, topo, sched, spec, seed=0
+    )
+
+    path = str(tmp_path / "mixed.jsonl")
+    reg = M.MetricsRegistry()
+    tele = T.KernelTelemetry(
+        engine="mixed",
+        recorder=T.FlightRecorder(path, engine="mixed"),
+        registry=reg,
+    )
+    _, chunked = mixed_engine.simulate_mixed(
+        cfg, ccfg, topo, sched, spec, seed=0, max_chunk=8,
+        telemetry=tele,
+    )
+    tele.recorder.close()
+
+    for k in T.ROUND_CURVE_KEYS:
+        np.testing.assert_array_equal(plain[k], chunked[k], err_msg=k)
+    assert len(tele.chunk_walls) == 3
+    rec, markers = T.replay_flight(path)
+    assert rec["round"].tolist() == list(range(24))
+    assert [m["start"] for m in markers] == [0, 8, 16]
+    assert reg.counter("corro_kernel_msgs_total").get(
+        engine="mixed"
+    ) == float(chunked["msgs"].astype(np.float64).sum())
+    assert reg.counter(
+        "corro_kernel_health_chunks_sent_total"
+    ).get(engine="mixed") == float(
+        chunked["chunks_sent"].astype(np.float64).sum()
+    )
 
 
 def test_partial_coverage_differential_vs_bookie():
