@@ -1,0 +1,28 @@
+"""Static-analysis plane: kernel-purity, schema-parity, and concurrency
+lints (`corrosion lint`), plus the runtime retrace/dtype sanitizer.
+
+The telemetry plane (sim/telemetry.py) and the convergence-health plane
+(sim/health.py) observe what the kernels *do*; this package guards the
+code that produces those numbers. Three pillars, each a module:
+
+- ``purity``: AST lints over the kernel modules (``ops/`` and the
+  ``sim/*engine*.py`` scan bodies) for host-trip and dtype-promotion
+  hazards — the bug classes that silently retrace or slow every engine.
+- ``schema``: statically extracts the telemetry keys each engine's scan
+  body emits and diffs them against the canonical ``ROUND_CURVE_KEYS``,
+  turning the runtime parity test into a compile-time check.
+- ``concurrency``: blocking calls under held locks and lock-acquisition-
+  order cycles in the host agent plane.
+
+``runner.lint_paths`` orchestrates the three over a file tree;
+``sanitize.sanitize_engines`` is the runtime companion (strict dtype
+promotion + debug_nans + a one-trace-per-engine retrace tripwire). Rule
+ids, rationale, and the ``# corro-lint: disable=CT0xx reason=...``
+suppression syntax are documented in docs/ANALYSIS.md.
+
+Everything except ``sanitize`` is pure stdlib (ast/tokenize) — linting
+never imports jax, so `corrosion lint` stays fast and runs anywhere.
+"""
+
+from corrosion_tpu.analysis.findings import RULES, Finding  # noqa: F401
+from corrosion_tpu.analysis.runner import LintResult, lint_paths  # noqa: F401

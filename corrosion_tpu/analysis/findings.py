@@ -1,0 +1,146 @@
+"""Finding model and the CT0xx rule registry.
+
+Every rule has a stable id so suppressions (``# corro-lint:
+disable=CT003 reason=...``), CI gating, and the JSON report format stay
+meaningful as rules are added. What each violation costs on TPU is
+documented per rule in docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+# rule id -> (title, one-line rationale). The long-form rationale (what
+# the violation costs at kernel scale) lives in docs/ANALYSIS.md.
+RULES: dict[str, tuple[str, str]] = {
+    "CT000": (
+        "bad-suppression",
+        "corro-lint suppression without a reason= string or naming an "
+        "unknown rule id",
+    ),
+    "CT001": (
+        "numpy-in-traced-code",
+        "numpy (np.*) usage inside a traced kernel function — a host "
+        "round-trip that blocks the device per call if it ever touches "
+        "a traced value",
+    ),
+    "CT002": (
+        "local-numpy-import",
+        "function-local `import numpy` in a kernel module — hoist to "
+        "module scope or suppress with a reason",
+    ),
+    "CT003": (
+        "dtypeless-jnp-literal",
+        "jnp.array/zeros/ones/full/empty without an explicit dtype in a "
+        "kernel module — promotion drift changes downstream widths",
+    ),
+    "CT004": (
+        "traced-value-coercion",
+        "float()/int()/bool()/.item()/.tolist() in a traced kernel "
+        "function — forces a device sync per call",
+    ),
+    "CT005": (
+        "python-branch-on-traced",
+        "Python if/while on a traced parameter of a scan-body or jitted "
+        "function — retraces per value or raises TracerBoolConversion",
+    ),
+    "CT010": (
+        "round-curve-schema",
+        "engine scan body emits a telemetry key outside the canonical "
+        "ROUND_CURVE_KEYS set (or its emission cannot be statically "
+        "resolved)",
+    ),
+    "CT020": (
+        "blocking-call-under-lock",
+        "blocking call (sleep/subprocess/socket/file I/O) inside a "
+        "`with <lock>:` block — stalls every waiter for the call's wall",
+    ),
+    "CT021": (
+        "lock-order-cycle",
+        "cycle in the lock-acquisition-order graph — a latent deadlock",
+    ),
+    "CT030": (
+        "retrace-tripwire",
+        "sanitizer: an engine's scanned round compiled more than once "
+        "across same-shape chunks (silent retrace)",
+    ),
+    "CT031": (
+        "strict-dtype-violation",
+        "sanitizer: engine fails under "
+        "jax_numpy_dtype_promotion='strict' (implicit promotion in the "
+        "round graph)",
+    ),
+    "CT032": (
+        "nan-produced",
+        "sanitizer: engine produced a NaN under jax_debug_nans",
+    ),
+    "CT033": (
+        "sanitizer-run-failure",
+        "sanitizer: engine run failed for a reason other than dtype "
+        "promotion or NaNs (the tiny-config run itself is broken)",
+    ),
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    col: int = 0
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def render(self) -> str:
+        title = RULES.get(self.rule, ("?",))[0]
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"[{title}] {self.message}"
+        )
+
+
+@dataclass
+class LintResult:
+    """Outcome of a lint run: active findings gate, suppressed ones are
+    kept for transparency, per-engine emitted key sets feed the schema
+    tests and the JSON artifact."""
+
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    files: int = 0
+    engines: dict[str, list[str]] = field(default_factory=dict)
+    canonical_keys: tuple[str, ...] = ()
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "ok": self.ok,
+            "files": self.files,
+            "findings": [asdict(f) for f in self.findings],
+            "suppressed": [asdict(f) for f in self.suppressed],
+            "engines": self.engines,
+            "canonical_keys": list(self.canonical_keys),
+            "rules": {k: {"title": t, "why": w} for k, (t, w) in RULES.items()},
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    def render_text(self, show_suppressed: bool = False) -> str:
+        lines = [f.render() for f in self.findings]
+        if show_suppressed:
+            for f in self.suppressed:
+                lines.append(
+                    f"{f.render()}  (suppressed: {f.suppress_reason})"
+                )
+        lines.append(
+            f"{len(self.findings)} finding(s), "
+            f"{len(self.suppressed)} suppressed, {self.files} file(s)"
+        )
+        return "\n".join(lines)

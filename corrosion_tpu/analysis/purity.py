@@ -1,0 +1,211 @@
+"""Kernel-purity lints (CT001-CT005) over kernel modules.
+
+Targets the failure modes that silently wreck a scanned round step on
+TPU: host round-trips (numpy on traced values, float()/int() coercions)
+that serialize the device per call, dtype-less literals whose promotion
+drifts downstream widths, and Python control flow on traced values that
+either retraces per value or raises at trace time. Scope per rule:
+
+- CT002/CT003 apply module-wide in kernel modules (a dtype-less literal
+  is a hazard wherever the array ends up feeding a kernel).
+- CT001/CT004 apply inside *traced* functions (jit-decorated, scan/cond
+  bodies, nested in one — or presumed, in ``ops/``).
+- CT005 applies only to explicitly-traced functions (scan bodies and
+  jit-decorated defs), where a parameter is traced by construction;
+  jit static_argnames are exempt, as are shape/dtype attribute tests
+  and ``is None`` checks (static at trace time).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from corrosion_tpu.analysis.findings import Finding
+from corrosion_tpu.analysis.source import FunctionInfo, SourceModule, dotted_name
+
+# jnp constructors that take an optional dtype and default to promotion-
+# prone widths. zeros_like/asarray/arange are excluded: _like preserves
+# dtype, asarray converts an existing array, and arange's int default is
+# stable (documented in docs/ANALYSIS.md).
+_DTYPE_CTORS = {"array", "zeros", "ones", "full", "empty"}
+# positional index where dtype may appear per ctor.
+_DTYPE_POS = {"array": 1, "zeros": 1, "ones": 1, "empty": 1, "full": 2}
+
+_COERCIONS = {"float", "int", "bool"}
+_COERCION_METHODS = {"item", "tolist"}
+
+# attribute reads that are static at trace time even on traced values.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+def _has_dtype(call: ast.Call, ctor: str) -> bool:
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    return len(call.args) > _DTYPE_POS[ctor]
+
+
+def _is_constant_expr(node: ast.AST) -> bool:
+    return all(
+        isinstance(
+            n,
+            (ast.Constant, ast.Tuple, ast.List, ast.UnaryOp, ast.BinOp,
+             ast.USub, ast.UAdd, ast.operator, ast.unaryop, ast.Load),
+        )
+        for n in ast.walk(node)
+    )
+
+
+def _static_only_test(test: ast.AST, fn: FunctionInfo) -> bool:
+    """True when a branch test cannot involve a traced value: `is None`
+    comparisons, isinstance/len on anything, shape/dtype attribute
+    chains, and names in jit static_argnames."""
+    static_names: set[str] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(node, ast.Call) and dotted_name(node.func) in (
+            "isinstance", "len", "hasattr", "getattr"
+        ):
+            return True
+        # `x is None` / `x is not None` tests identity of the pytree
+        # structure, which is static at trace time — names under such a
+        # Compare never witness a traced *value*.
+        if isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            static_names |= {
+                n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+            }
+    names = {
+        n.id for n in ast.walk(test) if isinstance(n, ast.Name)
+    }
+    params = _param_names(fn)
+    hits = (names - static_names) & params
+    return not hits or hits <= fn.static_params
+
+
+def _param_names(fn: FunctionInfo) -> set[str]:
+    a = fn.node.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def check_purity(mod: SourceModule) -> list[Finding]:
+    if not mod.is_kernel:
+        return []
+    out: list[Finding] = []
+    # value-position Attribute nodes: `np.random.default_rng` should fire
+    # once (outermost), not once per link of the chain.
+    inner_attrs = {
+        id(n.value) for n in ast.walk(mod.tree)
+        if isinstance(n, ast.Attribute)
+    }
+
+    def add(rule: str, node: ast.AST, msg: str) -> None:
+        out.append(
+            Finding(rule=rule, path=mod.path, line=node.lineno,
+                    col=node.col_offset, message=msg)
+        )
+
+    for node in ast.walk(mod.tree):
+        # CT002: function-local numpy import anywhere in a kernel module.
+        if isinstance(node, ast.Import):
+            fn = mod.enclosing_function(node)
+            if fn is not None:
+                for alias in node.names:
+                    if alias.name == "numpy" or alias.name.startswith(
+                        "numpy."
+                    ):
+                        add(
+                            "CT002", node,
+                            f"function-local `import {alias.name}` in "
+                            f"kernel function {fn.qualname}; hoist to "
+                            "module scope or suppress with a reason",
+                        )
+
+        # CT003: dtype-less jnp literal constructors, module-wide.
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            parts = fname.split(".")
+            if (
+                len(parts) == 2
+                and parts[0] in ("jnp", "jax_numpy")
+                and parts[1] in _DTYPE_CTORS
+                and not _has_dtype(node, parts[1])
+            ):
+                add(
+                    "CT003", node,
+                    f"`{fname}(...)` without an explicit dtype; default "
+                    "promotion drifts downstream widths — state it",
+                )
+
+        fn = mod.enclosing_function(node)
+        traced = fn is not None and fn.traced
+        if not traced:
+            continue
+
+        # CT001: numpy usage inside traced code.
+        if isinstance(node, ast.Attribute) and id(node) not in inner_attrs:
+            root = node
+            while isinstance(root.value, ast.Attribute):
+                root = root.value
+            if isinstance(root.value, ast.Name) and root.value.id in (
+                "np", "numpy"
+            ):
+                add(
+                    "CT001", node,
+                    f"numpy reference `{dotted_name(node)}` inside traced "
+                    f"function {fn.qualname} — host-trip hazard",
+                )
+
+        # CT004: host coercions of (potentially) traced values.
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname in _COERCIONS and node.args:
+                arg = node.args[0]
+                arg_names = {
+                    n.id for n in ast.walk(arg) if isinstance(n, ast.Name)
+                }
+                static_ok = (
+                    _is_constant_expr(arg)
+                    or arg_names <= fn.static_params
+                    or any(
+                        isinstance(a, ast.Attribute)
+                        and a.attr in _STATIC_ATTRS
+                        for a in ast.walk(arg)
+                    )
+                )
+                if not static_ok:
+                    add(
+                        "CT004", node,
+                        f"`{fname}(...)` coercion inside traced function "
+                        f"{fn.qualname} — forces a device sync (or "
+                        "TracerConversion error) per call",
+                    )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _COERCION_METHODS
+            ):
+                add(
+                    "CT004", node,
+                    f"`.{node.func.attr}()` inside traced function "
+                    f"{fn.qualname} — forces a device sync per call",
+                )
+
+        # CT005: Python branch on a traced parameter (explicit traced
+        # functions only — the presumption would false-positive on
+        # host-config branches).
+        if isinstance(node, (ast.If, ast.While)) and fn.explicit_traced:
+            if not _static_only_test(node.test, fn):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                add(
+                    "CT005", node,
+                    f"Python `{kind}` on traced value(s) in "
+                    f"{fn.qualname} ({fn.traced_why}); use lax.cond/"
+                    "lax.select or mark the argument static",
+                )
+    return out

@@ -1,0 +1,105 @@
+"""Lint orchestration: discover files, run every rule, apply
+suppressions, aggregate a LintResult.
+
+Pure stdlib — importable and runnable without jax. The canonical
+telemetry keys come from a static extraction of sim/telemetry.py
+(schema.extract_canonical); pass ``telemetry_path`` to lint fixture
+trees against a different schema source (the tests do).
+"""
+
+from __future__ import annotations
+
+import os
+
+from corrosion_tpu.analysis import concurrency, purity, schema
+from corrosion_tpu.analysis.findings import Finding, LintResult
+from corrosion_tpu.analysis.source import SourceModule
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def default_telemetry_path() -> str:
+    import corrosion_tpu
+
+    return os.path.join(
+        os.path.dirname(corrosion_tpu.__file__), "sim", "telemetry.py"
+    )
+
+
+def discover(paths: list[str]) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            files.extend(
+                os.path.join(root, n) for n in sorted(names)
+                if n.endswith(".py")
+            )
+    return files
+
+
+def lint_paths(
+    paths: list[str],
+    rules: set[str] | None = None,
+    telemetry_path: str | None = None,
+) -> LintResult:
+    """Run every static rule over ``paths`` (files or trees).
+
+    ``rules`` filters to a subset of CT0xx ids; suppressed findings are
+    reported separately (they never gate) and CT000 fires on malformed
+    suppressions — a suppression without a reason is ignored, loudly.
+    """
+    result = LintResult()
+    tpath = telemetry_path or default_telemetry_path()
+    try:
+        canonical = schema.extract_canonical(tpath)
+    except OSError:
+        canonical = {}
+    if "ROUND_CURVE_KEYS" not in canonical:
+        result.findings.append(Finding(
+            rule="CT010", path=tpath, line=1,
+            message="static extraction of ROUND_CURVE_KEYS failed — the "
+            "schema-parity lint is blind; keep the canonical tuples "
+            "statically evaluable",
+        ))
+    result.canonical_keys = tuple(canonical.get("ROUND_CURVE_KEYS", ()))
+
+    for path in discover(paths):
+        try:
+            mod = SourceModule(path)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            result.findings.append(Finding(
+                rule="CT000", path=path,
+                line=getattr(e, "lineno", 1) or 1,
+                message=f"unparsable source: {e}",
+            ))
+            result.files += 1
+            continue
+        result.files += 1
+        found: list[Finding] = []
+        found.extend(purity.check_purity(mod))
+        keys, schema_findings = schema.emitted_keys(mod, canonical)
+        found.extend(schema_findings)
+        if mod.is_engine:
+            name = os.path.splitext(os.path.basename(path))[0]
+            result.engines[name] = keys
+        found.extend(concurrency.check_concurrency(mod))
+        for line, msg in mod.bad_suppressions:
+            found.append(Finding(rule="CT000", path=path, line=line,
+                                 message=msg))
+        for f in found:
+            if rules is not None and f.rule not in rules:
+                continue
+            sup = mod.suppression_for(f.rule, f.line)
+            if sup is not None:
+                f.suppressed = True
+                f.suppress_reason = sup.reason
+                result.suppressed.append(f)
+            else:
+                result.findings.append(f)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

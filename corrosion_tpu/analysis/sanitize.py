@@ -1,0 +1,156 @@
+"""Runtime sanitizer: strict-dtype + debug-nans + retrace tripwire.
+
+The static lints can't see promotions synthesized inside jnp internals
+or a retrace caused by a host value leaking into a traced shape. This
+module runs a *tiny* instance of each engine — two same-shape device
+executions, so any shape/const leak forces a second compile — under
+
+- ``jax.numpy_dtype_promotion('strict')``: any implicit promotion
+  between two strongly-typed arrays raises (CT031). On TPU an
+  unintended u32->i64/f32 widening doubles a tensor's HBM traffic.
+- ``jax.debug_nans(True)``: a NaN produced anywhere in the round graph
+  raises at the producing primitive (CT032).
+- a retrace tripwire: after the run, every jitted function in the
+  engine's module must hold at most ONE compile-cache entry (CT030).
+  Chunked runs execute the same scanned round repeatedly; a second
+  entry means something non-hashable-stable (a host float, a fresh
+  tuple of numpy scalars, a closure identity) is being baked into the
+  trace — the silent 100x slowdown class.
+
+Imports jax and the engines lazily: `corrosion lint` without
+``--sanitize`` never pays for them.
+"""
+
+from __future__ import annotations
+
+from corrosion_tpu.analysis.findings import Finding
+
+ENGINES = ("dense", "sparse", "chunk", "mixed")
+
+
+def _run_dense():
+    from corrosion_tpu import models
+    from corrosion_tpu.sim import engine
+
+    cfg, topo, sched = models.merge_10k(n=32, rounds=8, samples=8)
+    engine.simulate(cfg, topo, sched, seed=0, max_chunk=4)
+    return engine
+
+
+def _run_sparse():
+    from corrosion_tpu import models
+    from corrosion_tpu.sim import sparse_engine
+
+    cfg, topo, sched = models.anywrite_sparse(
+        n=96, w_hot=16, n_regions=4, rounds=16, cohort=8, epoch_rounds=8,
+        k_dev=8, samples=16,
+    )
+    sparse_engine.simulate_sparse(cfg, topo, sched, seed=0)
+    return sparse_engine
+
+
+def _run_chunk():
+    from corrosion_tpu.ops.chunks import ChunkConfig
+    from corrosion_tpu.sim import chunk_engine
+
+    cfg = ChunkConfig(
+        n_nodes=16, n_streams=2, chunk_len=64, fanout=3, sync_interval=4,
+        gap_requests=4,
+    )
+    chunk_engine.simulate_chunks(
+        cfg, [0, 5], [511, 255], rounds=8, seed=1, max_chunk=4
+    )
+    return chunk_engine
+
+
+def _run_mixed():
+    from corrosion_tpu.models.baselines import mixed_storm
+    from corrosion_tpu.sim import mixed_engine
+
+    cfg, ccfg, topo, sched, spec = mixed_storm(
+        n=64, streams=2, last_seq=255, rounds=8, samples=8, n_cells=0
+    )
+    mixed_engine.simulate_mixed(cfg, ccfg, topo, sched, spec, seed=0)
+    return mixed_engine
+
+
+_RUNNERS = {
+    "dense": _run_dense,
+    "sparse": _run_sparse,
+    "chunk": _run_chunk,
+    "mixed": _run_mixed,
+}
+
+
+def _jitted_functions(module) -> dict[str, object]:
+    return {
+        name: obj for name in dir(module)
+        if callable(obj := getattr(module, name, None))
+        and hasattr(obj, "_cache_size")
+    }
+
+
+def sanitize_engines(
+    engines: tuple[str, ...] = ENGINES, strict_dtypes: bool = True,
+    check_nans: bool = True,
+) -> list[Finding]:
+    """Run the tiny-instance sanitizer for ``engines``; returns findings
+    (CT030/CT031/CT032), empty when every engine is clean."""
+    import contextlib
+
+    import jax
+
+    findings: list[Finding] = []
+    for name in engines:
+        run = _RUNNERS[name]
+        jax.clear_caches()
+        ctx = contextlib.ExitStack()
+        if strict_dtypes:
+            ctx.enter_context(jax.numpy_dtype_promotion("strict"))
+        if check_nans:
+            ctx.enter_context(jax.debug_nans(True))
+        try:
+            with ctx:
+                module = run()
+        except FloatingPointError as e:
+            findings.append(Finding(
+                rule="CT032", path=f"<engine:{name}>", line=0,
+                message=f"NaN produced in the {name} round graph: {e}",
+            ))
+            continue
+        except Exception as e:
+            # TypePromotionError is matched by name: importing it would
+            # pull jax._src internals, and the class moved across jax
+            # versions. Anything else is a broken tiny-config run, not a
+            # promotion finding — label it honestly (CT033) so triage
+            # doesn't chase phantom dtype issues.
+            rule = (
+                "CT031" if type(e).__name__ == "TypePromotionError"
+                else "CT033"
+            )
+            findings.append(Finding(
+                rule=rule, path=f"<engine:{name}>", line=0,
+                message=f"{name} engine failed under the sanitizer "
+                f"({type(e).__name__}): {e}",
+            ))
+            continue
+        jitted = _jitted_functions(module)
+        sizes = {n: fn._cache_size() for n, fn in jitted.items()}
+        if not any(sizes.values()):
+            # A refactor that renames the scan entry points would turn
+            # the tripwire into a no-op; that must be loud, not green.
+            findings.append(Finding(
+                rule="CT030", path=f"<engine:{name}>", line=0,
+                message=f"{module.__name__} exposes no compiled jitted "
+                "functions after the run — the retrace tripwire is "
+                "watching nothing",
+            ))
+        for fn_name, size in sizes.items():
+            if size > 1:
+                findings.append(Finding(
+                    rule="CT030", path=f"<engine:{name}>", line=0,
+                    message=f"{module.__name__}.{fn_name} compiled "
+                    f"{size} times across same-shape chunks — a host "
+                    "value is leaking into the trace (retrace tripwire)",
+                ))
+    return findings

@@ -1,0 +1,138 @@
+"""Schema-parity lint (CT010): engines' emitted telemetry keys vs the
+canonical ``ROUND_CURVE_KEYS`` — statically.
+
+All four engines' scan bodies must emit exactly the canonical RoundCurves
+key set (sim/telemetry.py zero-fills the rest, so the *final* dict is
+always canonical — what can drift is an engine passing an unknown key,
+which today raises only at trace time, i.e. after a run was launched,
+possibly hours into a queue slot). This module turns that runtime
+ValueError into a lint: it extracts the canonical tuples from
+telemetry.py without importing it (no jax), finds every
+``round_curves(...)`` call site, resolves its keywords — including
+``**delivery_latency_hist(...)`` expansions through one local-assignment
+hop — and diffs.
+
+The restricted evaluator executes only top-level ``NAME = <expr>``
+assignments from telemetry.py against a tuple/range/len-only builtin
+namespace; anything it can't evaluate is skipped, and a telemetry.py
+refactor that breaks extraction fails loudly (CT010 on the runner).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from corrosion_tpu.analysis.findings import Finding
+from corrosion_tpu.analysis.source import SourceModule, dotted_name
+
+_EVAL_BUILTINS = {"tuple": tuple, "range": range, "len": len,
+                  "sorted": sorted, "set": set, "frozenset": frozenset}
+
+
+def extract_canonical(telemetry_path: str) -> dict[str, tuple]:
+    """Evaluate telemetry.py's top-level key tuples without importing it.
+
+    Returns the module-level constants that evaluated cleanly (expected:
+    VIS_LAT_EDGES, VIS_LAT_KEYS, HEALTH_CURVE_KEYS, ROUND_CURVE_KEYS,
+    LEVEL_CURVE_KEYS). tests/test_analysis.py pins this against the
+    imported module so the evaluator can never silently drift.
+    """
+    with open(telemetry_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=telemetry_path)
+    env: dict[str, object] = {}
+    for node in tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            continue
+        name = node.targets[0].id
+        try:
+            code = compile(ast.Expression(node.value), telemetry_path, "eval")
+            env[name] = eval(  # noqa: S307 - restricted namespace
+                code, {"__builtins__": _EVAL_BUILTINS, **env}
+            )
+        except Exception:
+            continue
+    return {
+        k: v for k, v in env.items()
+        if isinstance(v, tuple) and all(isinstance(e, (str, int)) for e in v)
+    }
+
+
+def _resolve_star(mod: SourceModule, call: ast.Call, star: ast.AST,
+                  vis_keys: tuple) -> tuple | None:
+    """Keys contributed by a ``**expr`` in a round_curves call: a direct
+    ``**delivery_latency_hist(...)`` or one hop through a local
+    ``name = delivery_latency_hist(...)`` assignment in the enclosing
+    function. None = statically unresolvable."""
+    def hist_call(expr: ast.AST) -> bool:
+        return isinstance(expr, ast.Call) and dotted_name(
+            expr.func
+        ).split(".")[-1] == "delivery_latency_hist"
+
+    if hist_call(star):
+        return vis_keys
+    if isinstance(star, ast.Name):
+        fn = mod.enclosing_function(call)
+        scope = fn.node if fn is not None else mod.tree
+        for node in ast.walk(scope):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == star.id
+                and hist_call(node.value)
+            ):
+                return vis_keys
+    return None
+
+
+def emitted_keys(
+    mod: SourceModule, canonical: dict[str, tuple]
+) -> tuple[list[str], list[Finding]]:
+    """(sorted emitted key set, findings) for one module's
+    ``round_curves(...)`` call sites."""
+    keys: set[str] = set()
+    findings: list[Finding] = []
+    canon = set(canonical.get("ROUND_CURVE_KEYS", ()))
+    vis_keys = tuple(canonical.get("VIS_LAT_KEYS", ()))
+    calls = [
+        node for node in ast.walk(mod.tree)
+        if isinstance(node, ast.Call)
+        and dotted_name(node.func).split(".")[-1] == "round_curves"
+    ]
+    for call in calls:
+        for kw in call.keywords:
+            if kw.arg is None:
+                got = _resolve_star(mod, call, kw.value, vis_keys)
+                if got is None:
+                    findings.append(Finding(
+                        rule="CT010", path=mod.path, line=kw.value.lineno,
+                        col=kw.value.col_offset,
+                        message="`**` expansion in round_curves(...) is "
+                        "not statically resolvable; emit "
+                        "delivery_latency_hist directly (or via one "
+                        "local assignment) so parity stays checkable",
+                    ))
+                else:
+                    keys.update(got)
+                continue
+            keys.add(kw.arg)
+            if canon and kw.arg not in canon:
+                findings.append(Finding(
+                    rule="CT010", path=mod.path, line=call.lineno,
+                    col=call.col_offset,
+                    message=f"round_curves key '{kw.arg}' is not in the "
+                    "canonical ROUND_CURVE_KEYS set (runtime would "
+                    "ValueError at trace time)",
+                ))
+    if mod.is_engine and not calls:
+        findings.append(Finding(
+            rule="CT010", path=mod.path, line=1, col=0,
+            message="engine module never builds its per-round stats "
+            "through telemetry.round_curves(...) — the schema parity "
+            "contract is unenforceable here",
+        ))
+    return sorted(keys), findings

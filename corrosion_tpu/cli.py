@@ -138,6 +138,30 @@ def _build_parser() -> argparse.ArgumentParser:
     orc.add_argument("--churn", action="store_true")
     orc.add_argument("--seed", type=int, default=0)
 
+    # Static-analysis plane (corrosion_tpu/analysis, docs/ANALYSIS.md):
+    # kernel-purity + schema-parity + concurrency lints, and the
+    # strict-dtype/debug-nans/retrace sanitizer.
+    ln = add("lint", help="static analysis: kernel purity, telemetry "
+             "schema parity, lock discipline")
+    ln.add_argument("paths", nargs="*", default=None,
+                    help="files or trees to lint (default: the "
+                    "corrosion_tpu package)")
+    ln.add_argument("--format", choices=["text", "json"], default="text")
+    ln.add_argument("--rules", default=None,
+                    help="comma-separated CT0xx ids to run (default all)")
+    ln.add_argument("--sanitize", action="store_true",
+                    help="also run tiny engine instances under strict "
+                    "dtype promotion + debug_nans + retrace tripwire")
+    ln.add_argument("--engines", default="dense,sparse,chunk,mixed",
+                    help="engines for --sanitize")
+    ln.add_argument("--no-static", action="store_true",
+                    help="skip the static rules (with --sanitize: "
+                    "sanitizer only)")
+    ln.add_argument("--show-suppressed", action="store_true",
+                    help="list reason-suppressed findings in text output "
+                    "(JSON always carries them)")
+    ln.add_argument("--list-rules", action="store_true")
+
     # command/tls.rs:1-94: `corrosion tls {ca,server,client} generate`
     tl = add("tls", help="certificate generation")
     tl.add_argument("tls_kind", choices=["ca", "server", "client"])
@@ -165,6 +189,8 @@ def main(argv: list[str] | None = None) -> int:
 
 
 async def _dispatch(args, cfg: Config) -> int:
+    if args.command == "lint":
+        return _lint(args)
     if args.command == "obs":
         return _obs(args)
     if args.command == "agent":
@@ -238,6 +264,48 @@ async def _dispatch(args, cfg: Config) -> int:
         await run_consul_sync(cfg)
         return 0
     return 2
+
+
+def _lint(args) -> int:
+    """`corrosion lint [paths] [--sanitize]` — the static-analysis plane
+    (corrosion_tpu/analysis, rules in docs/ANALYSIS.md). Pure lint never
+    imports jax; --sanitize pulls in the engines lazily. Exit 0 = clean,
+    1 = findings, 2 = usage."""
+    from corrosion_tpu.analysis import RULES, lint_paths
+    from corrosion_tpu.analysis.findings import LintResult
+
+    if args.list_rules:
+        for rid, (title, why) in sorted(RULES.items()):
+            print(f"{rid}  {title}: {why}")
+        return 0
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+    paths = args.paths or [os.path.dirname(os.path.abspath(__file__))]
+    if args.no_static:
+        result = LintResult()
+    else:
+        result = lint_paths(paths, rules=rules)
+    if args.sanitize:
+        from corrosion_tpu.analysis.sanitize import ENGINES, sanitize_engines
+
+        engines = tuple(
+            e.strip() for e in args.engines.split(",") if e.strip()
+        )
+        unknown = set(engines) - set(ENGINES)
+        if unknown:
+            print(f"unknown engine(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        result.findings.extend(sanitize_engines(engines))
+    if args.format == "json":
+        print(result.to_json())
+    else:
+        print(result.render_text(show_suppressed=args.show_suppressed))
+    return 0 if result.ok else 1
 
 
 def _obs(args) -> int:

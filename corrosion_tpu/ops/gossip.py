@@ -158,6 +158,7 @@ class Topology(NamedTuple):
     writer_ids: jax.Array | None = None
 
 
+# corro-lint: disable=CT001,CT002,CT004 reason=host-side topology builder
 def make_topology(
     region_sizes: list[int], writer_nodes, seed: int = 0, region_rtt=None,
     sync_interval: int | None = None,
@@ -247,7 +248,7 @@ def init_data(cfg: GossipConfig) -> DataState:
         contig=jnp.zeros((n, w), jnp.uint32),
         seen=jnp.zeros((n, w), jnp.uint32),
         oo=jnp.zeros((cfg.window_k // 32, n, w), jnp.uint32),
-        oo_any=jnp.array(False),
+        oo_any=jnp.array(False, dtype=bool),
         q_writer=jnp.full((n, q), -1, jnp.int32),
         q_ver=jnp.zeros((n, q), jnp.uint32),
         q_tx=jnp.zeros((n, q), jnp.int32),
@@ -484,7 +485,11 @@ def broadcast_round(
         0,
     )
     new_ver = head_old_n[:, None] + 1 + jnp.arange(mw, dtype=jnp.uint32)[None, :]
-    new_valid = (jnp.arange(mw)[None, :] < nw[:, None]) & alive[:, None]
+    # u32 arange: nw is u32 and strict dtype promotion (the corro lint
+    # sanitizer) rejects an implicit i32/u32 comparison.
+    new_valid = (
+        jnp.arange(mw, dtype=jnp.uint32)[None, :] < nw[:, None]
+    ) & alive[:, None]
     new_writer = jnp.broadcast_to(topo.writer_of_node[:, None], (n, mw))
     track = cfg.track_writer_ids
     if track and topo.writer_ids is None:
@@ -720,7 +725,8 @@ def broadcast_round(
 
                 def _no_window(oo):
                     return (
-                        contig_pre + adv, oo, fresh_run, jnp.array(False),
+                        contig_pre + adv, oo, fresh_run,
+                        jnp.array(False, dtype=bool),
                         jnp.uint32(0),
                     )
 
@@ -863,7 +869,8 @@ def broadcast_round(
                 def _no_window(oo):
                     return (
                         contig_run, oo,
-                        jnp.zeros_like(valid2), jnp.array(False),
+                        jnp.zeros_like(valid2),
+                        jnp.array(False, dtype=bool),
                         jnp.uint32(0),
                     )
 
@@ -1471,6 +1478,7 @@ def cells_agree(data: DataState, cfg: GossipConfig) -> jax.Array:
     )
 
 
+# corro-lint: disable=CT001,CT002,CT004 reason=host ground-truth reference
 def serial_merge_reference(
     head, cfg: GossipConfig
 ) -> crdt.CellState:

@@ -240,6 +240,7 @@ def contiguous_watermark(iv: IntervalSet, base: jax.Array) -> jax.Array:
     return wm
 
 
+# corro-lint: disable=CT004 reason=host materialization; device_get first
 def to_host(iv: IntervalSet) -> list[tuple[int, int]]:
     """Materialize as a python list (testing/debug)."""
     starts = jax.device_get(iv.starts)

@@ -45,7 +45,11 @@ def bounded_intake(
     s_payloads = sorted_ops[1:]
     idxs = jnp.arange(m)
     run_first = jnp.where(
-        jnp.concatenate([jnp.array([True]), s_key[1:] != s_key[:-1]]), idxs, 0
+        jnp.concatenate(
+            [jnp.array([True], dtype=bool), s_key[1:] != s_key[:-1]]
+        ),
+        idxs,
+        0,
     )
     # lax.cummax, not associative_scan: the latter's recursive odd/even
     # decomposition makes XLA:TPU compile time explode at multi-million

@@ -91,7 +91,7 @@ def init_sparse(cfg: GossipConfig, sp: SparseConfig) -> SparseState:
         slot_writer=jnp.full((cfg.n_writers,), -1, jnp.int32),
         dev_writer=jnp.full((n, sp.k_dev), -1, jnp.int32),
         dev_contig=jnp.zeros((n, sp.k_dev), jnp.uint32),
-        dev_any=jnp.array(False),
+        dev_any=jnp.array(False, dtype=bool),
     )
 
 
@@ -258,7 +258,7 @@ def rotate(
     # matmul (u16 halves; a [N, P]→[N, W] column scatter serializes).
     sel = (
         ps[:, None] == jnp.arange(w_hot)[None, :]
-    ).astype(jnp.float32) * promote_ok[:, None]  # [P, W]
+    ).astype(jnp.float32) * promote_ok[:, None].astype(jnp.float32)  # [P, W]
 
     def _cols(vals):  # u32[N, P] -> u32[N, W] (zeros off promoted cols)
         def dot(x):
@@ -483,6 +483,7 @@ def cold_need(state: SparseState) -> jax.Array:
     return jnp.sum(lag, dtype=jnp.uint32)
 
 
+# corro-lint: disable=CT001,CT002,CT004 reason=host ground-truth reference
 def serial_merge_reference_sparse(
     head_full, cfg: GossipConfig
 ) -> crdt.CellState:

@@ -219,11 +219,21 @@ class Tracer:
         obj = span.to_json_obj() if (
             self._export_path is not None or self._otlp_q is not None
         ) else None
+        # Open the export file OUTSIDE the lock (first record only): disk
+        # I/O under the tracer lock would stall every span-finishing
+        # thread behind one slow open (corro lint CT020). Double-checked:
+        # a losing racer closes its handle.
+        opened = None
+        if self._export_path is not None and self._export_f is None:
+            opened = open(self._export_path, "a")
         with self._lock:
             self.finished.append(span)
-            if self._export_path is not None:
+            if opened is not None:
                 if self._export_f is None:
-                    self._export_f = open(self._export_path, "a")
+                    self._export_f = opened
+                else:
+                    opened.close()
+            if self._export_f is not None:
                 self._export_f.write(json.dumps(obj, default=str) + "\n")
                 self._export_f.flush()
         if self._otlp_q is not None:
