@@ -138,6 +138,55 @@ def _build_parser() -> argparse.ArgumentParser:
     orc.add_argument("--churn", action="store_true")
     orc.add_argument("--seed", type=int, default=0)
 
+    # Chaos plane (sim/faults.py + sim/invariants.py, docs/CHAOS.md):
+    # declarative fault injection, post-heal invariant checking, and a
+    # seeded fuzzer that shrinks failing plans to minimal JSON repros.
+    ch = add("chaos", help="fault injection + post-heal invariant suite")
+    ch_sub = ch.add_subparsers(dest="chaos_cmd", required=True)
+
+    cls_ = ch_sub.add_parser(
+        "list", parents=[common], help="list the named fault scenarios"
+    )
+    cls_.add_argument("--rounds", type=int, default=64)
+
+    crn = ch_sub.add_parser(
+        "run", parents=[common],
+        help="run a named scenario (or a fault-plan JSON) through the "
+        "invariant suite",
+    )
+    crn.add_argument("scenario",
+                     help="scenario name (chaos list) or plan JSON path")
+    crn.add_argument("--engines", default="dense,sparse,chunk,mixed")
+    crn.add_argument("--rounds", type=int, default=64,
+                     help="run length for named scenarios")
+    crn.add_argument("--seed", type=int, default=0)
+    crn.add_argument("--json", action="store_true")
+
+    cfz = ch_sub.add_parser(
+        "fuzz", parents=[common],
+        help="seeded random fault plans through the invariant suite, "
+        "shrinking failures to minimal repros",
+    )
+    cfz.add_argument("--seed", type=int, default=0)
+    cfz.add_argument("--plans", type=int, default=4)
+    cfz.add_argument("--engines", default="dense,sparse,chunk,mixed")
+    cfz.add_argument("--rounds", type=int, default=64)
+    cfz.add_argument("--out", default=None,
+                     help="directory for minimal-repro JSON artifacts")
+    cfz.add_argument("--broken", action="store_true",
+                     help="generate deliberately NON-healing plans (the "
+                     "suite must fail and shrink them — chaos self-test)")
+    cfz.add_argument("--no-wipe", action="store_true",
+                     help="churn components use pause-resume only")
+    cfz.add_argument("--shrink-evals", type=int, default=24)
+    cfz.add_argument("--json", action="store_true")
+
+    crp = ch_sub.add_parser(
+        "replay", parents=[common],
+        help="re-run a shrunk repro artifact's plan on its engine",
+    )
+    crp.add_argument("repro", help="chaos repro JSON path")
+
     # Static-analysis plane (corrosion_tpu/analysis, docs/ANALYSIS.md):
     # kernel-purity + schema-parity + concurrency lints, and the
     # strict-dtype/debug-nans/retrace sanitizer.
@@ -193,6 +242,8 @@ async def _dispatch(args, cfg: Config) -> int:
         return _lint(args)
     if args.command == "obs":
         return _obs(args)
+    if args.command == "chaos":
+        return _chaos(args)
     if args.command == "agent":
         return await _run_agent(cfg)
     if args.command == "query":
@@ -306,6 +357,115 @@ def _lint(args) -> int:
     else:
         print(result.render_text(show_suppressed=args.show_suppressed))
     return 0 if result.ok else 1
+
+
+def _chaos(args) -> int:
+    """`corrosion chaos {list,run,fuzz,replay}` — the chaos plane's CLI
+    (docs/CHAOS.md). Exit 0 = every invariant held, 1 = violations (a
+    shrunk repro is written/printed), 2 = usage."""
+    from corrosion_tpu.sim import faults as faults_mod
+    from corrosion_tpu.sim import invariants as inv
+
+    if args.chaos_cmd == "list":
+        try:
+            plans = faults_mod.named_scenarios(
+                args.rounds, inv.STD_REGIONS, inv.STD_NODES,
+                protect=inv.PROTECTED,
+            )
+        except ValueError as e:
+            print(f"chaos list: {e}", file=sys.stderr)
+            return 2
+        for name in sorted(plans):
+            print(f"{name:18} {plans[name].describe()}")
+        return 0
+
+    if args.chaos_cmd in ("run", "fuzz"):
+        engines = tuple(
+            e.strip() for e in args.engines.split(",") if e.strip()
+        )
+        unknown = set(engines) - set(inv.ENGINES)
+        if unknown:
+            print(f"unknown engine(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    if args.chaos_cmd == "run":
+        # Bad inputs are usage errors (exit 2), not tracebacks: a
+        # malformed plan file, a plan exceeding the standard scenario's
+        # shape, or rounds the scenario catalog rejects.
+        try:
+            if os.path.exists(args.scenario):
+                with open(args.scenario) as f:
+                    d = json.load(f)
+                # A repro artifact carries its plan; a plan file IS one.
+                plan = faults_mod.FaultPlan.from_dict(d.get("plan", d))
+            else:
+                plans = faults_mod.named_scenarios(
+                    args.rounds, inv.STD_REGIONS, inv.STD_NODES,
+                    protect=inv.PROTECTED,
+                )
+                if args.scenario not in plans:
+                    print(
+                        f"unknown scenario {args.scenario!r}; `chaos list` "
+                        f"names them", file=sys.stderr,
+                    )
+                    return 2
+                plan = plans[args.scenario]
+            if plan.max_region() >= inv.STD_REGIONS:
+                raise ValueError(
+                    f"plan references region {plan.max_region()} but the "
+                    f"standard scenario has {inv.STD_REGIONS} regions"
+                )
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            print(f"chaos run: invalid plan/scenario: {e!r}", file=sys.stderr)
+            return 2
+        reports = inv.run_suite(
+            plan, engines, seed=args.seed, progress=sys.stderr
+        )
+        if args.json:
+            print(json.dumps([r.to_dict() for r in reports]))
+        else:
+            for r in reports:
+                print(r.render())
+        return 0 if all(r.ok for r in reports) else 1
+
+    if args.chaos_cmd == "fuzz":
+        out = inv.fuzz(
+            seed=args.seed, plans=args.plans, engines=engines,
+            rounds=args.rounds, out_dir=args.out,
+            break_heal=args.broken, allow_wipe=not args.no_wipe,
+            shrink_evals=args.shrink_evals, progress=sys.stderr,
+        )
+        if args.json:
+            print(json.dumps(out))
+        else:
+            for i, entry in enumerate(out["plans"]):
+                mark = "ok" if entry["ok"] else "FAIL"
+                print(f"plan {i}: [{mark}] {entry['describe']}")
+                if not entry["ok"]:
+                    repro = entry.get("repro", {})
+                    mini = faults_mod.FaultPlan.from_dict(
+                        repro.get("plan", entry["plan"])
+                    )
+                    print(f"  shrunk repro: {mini.describe()}")
+                    for v in repro.get("violations", []):
+                        print(f"  violation: {v}")
+                    if "repro_path" in entry:
+                        print(f"  artifact: {entry['repro_path']}")
+            print(
+                f"{args.plans - out['failures']}/{args.plans} plans passed "
+                f"on engines {','.join(engines)}"
+            )
+        return 1 if out["failures"] else 0
+
+    if args.chaos_cmd == "replay":
+        try:
+            rep = inv.replay_repro(args.repro, progress=sys.stderr)
+        except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+            print(f"chaos replay: {e!r}", file=sys.stderr)
+            return 2
+        print(rep.render())
+        return 0 if rep.ok else 1
+    return 2
 
 
 def _obs(args) -> int:

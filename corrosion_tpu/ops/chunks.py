@@ -31,7 +31,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from corrosion_tpu.ops import intervals, routing
+from corrosion_tpu.ops import faulting, intervals, routing
 from corrosion_tpu.ops.intervals import IntervalSet
 
 
@@ -89,6 +89,7 @@ def chunk_round(
     round_idx: jax.Array,
     rng: jax.Array,
     cfg: ChunkConfig,
+    loss: jax.Array | None = None,  # f32[] injected chunk-loss prob
 ) -> tuple[ChunkState, dict]:
     n, s_count, f = cfg.n_nodes, cfg.n_streams, cfg.fanout
     rows = cfg.rows
@@ -112,14 +113,16 @@ def chunk_round(
         span = jnp.maximum(se - ss + 1, 1)
         pos = ss + jax.random.randint(k_pos, (rows, f), 0, 1 << 30) % span
         ce = jnp.minimum(pos + cfg.chunk_len - 1, se)
-        lost = jax.random.uniform(k_loss, (rows, f)) < cfg.loss_prob
         ok = (
             has_any[:, None]
             & alive[row_node][:, None]
             & alive[tgt]
             & (tgt != row_node[:, None])
-            & ~lost
         )
+        # Shared static-skip loss (ops/faulting.py): the chunk plane has
+        # no region structure, so the chaos plan's loss arrives as one
+        # per-round scalar (its worst-region value).
+        ok, n_lost = faulting.apply_loss(k_loss, ok, cfg.loss_prob, loss)
 
         m_row = (tgt * s_count + row_stream[:, None]).reshape(-1)
         in_mask, (in_s, in_e) = routing.bounded_intake(
@@ -201,8 +204,32 @@ def chunk_round(
         "applied_nodes": jnp.sum(
             applied_mask(new_state, last_seq, cfg), dtype=jnp.uint32
         ),
+        "lost_msgs": n_lost,
     }
     return new_state, stats
+
+
+def wipe_coverage(
+    state: ChunkState, wipe: jax.Array, cfg: ChunkConfig
+) -> ChunkState:
+    """Crash-with-state-wipe on the chunk plane: a wiped node's partial
+    buffers are gone — every interval slot of its (node, stream) rows
+    resets to empty (the restart-from-empty-disk twin of
+    faulting.wipe_nodes). Re-gossip and partial-need sync must then
+    reassemble the streams from the surviving holders; wiping a stream's
+    LAST full holder makes its content unrecoverable, which is why the
+    chaos plan generator protects origin nodes."""
+    mask = jnp.repeat(wipe, cfg.n_streams)[:, None]  # bool[rows, 1]
+    return ChunkState(
+        have=IntervalSet(
+            starts=jnp.where(
+                mask, jnp.int32(intervals.EMPTY), state.have.starts
+            ),
+            ends=jnp.where(
+                mask, jnp.int32(intervals.EMPTY - 1), state.have.ends
+            ),
+        )
+    )
 
 
 def applied_mask(state: ChunkState, last_seq: jax.Array, cfg: ChunkConfig) -> jax.Array:

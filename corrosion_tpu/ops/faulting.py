@@ -1,0 +1,96 @@
+"""Shared fault-injection primitives for the kernel planes.
+
+The chaos plane (sim/faults.py) compiles a declarative FaultPlan into
+per-round device arrays; this module is the ONE place the kernels turn
+those arrays (plus their static ``cfg.loss_prob``) into dropped messages
+and wiped state. Before this module, gossip, SWIM, and the chunk plane
+each carried their own ``if cfg.loss_prob > 0.0`` static-skip branch — a
+fault plan threaded through one kernel could silently miss another.
+Now every plane calls :func:`apply_loss`, so the static zero-cost skip
+and the loss semantics can never diverge per plane.
+
+Loss model: receiver-side independent drop. The static config
+probability and the dynamic per-round probability compose as independent
+loss processes (``p = a + b - a*b``), so a plan's loss burst stacks on
+top of a config's ambient loss instead of replacing it.
+
+Wipe model (crash-with-state-wipe, vs the default pause-resume kill):
+:func:`wipe_nodes` resets a node's REPLICA state — watermarks, heard-of
+heads, the out-of-order window, pending queues, and its CRDT cell shard
+— while the writers' committed ``head`` ledger survives (the cluster,
+not the node, is the ledger of acknowledged writes). The membership
+twin lives in ``swim.apply_churn(..., wipe=...)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def apply_loss(
+    key: jax.Array,
+    ok: jax.Array,  # bool[...] deliverable-message mask
+    static_prob: float,
+    dyn_prob: jax.Array | None = None,  # f32 broadcastable to ok.shape
+) -> tuple[jax.Array, jax.Array]:
+    """Drop each deliverable message independently with the combined
+    loss probability. Returns ``(ok', lost_count u32)``.
+
+    The static zero-cost skip shared by every plane: when the config
+    probability is zero AND no dynamic schedule is threaded
+    (``dyn_prob is None`` — a trace-time property), the mask passes
+    through untouched and no randoms are sampled, so fault-free traces
+    are bit-identical to the pre-chaos kernels.
+    """
+    if static_prob <= 0.0 and dyn_prob is None:
+        return ok, jnp.uint32(0)
+    u = jax.random.uniform(key, ok.shape)
+    p = jnp.float32(static_prob)
+    if dyn_prob is not None:
+        d = dyn_prob.astype(jnp.float32)
+        p = p + d - p * d  # independent loss processes compose
+    lost = ok & (u < p)
+    return ok & ~lost, jnp.sum(lost, dtype=jnp.uint32)
+
+
+def wipe_nodes(data, wipe: jax.Array, cfg):
+    """Crash-with-state-wipe on the data plane: reset the wiped nodes'
+    replica state as a real restart-from-empty-disk would.
+
+    ``data`` is a gossip.DataState, ``wipe`` bool[N]. Resets per wiped
+    node: ``contig``/``seen`` rows to 0, out-of-order window words to 0
+    (``oo_any`` recomputed), pending-broadcast queue entries cleared,
+    and its CRDT cell shard zeroed. ``head`` is untouched — committed
+    versions are the cluster's ledger; whether the wiped node can ever
+    recover them is exactly what anti-entropy (and the chaos invariant
+    suite) must prove. Returns the new DataState.
+    """
+    not_w = ~wipe
+    zero_u32 = jnp.uint32(0)
+    contig = jnp.where(wipe[:, None], zero_u32, data.contig)
+    seen = jnp.where(wipe[:, None], zero_u32, data.seen)
+    oo = data.oo
+    oo_any = data.oo_any
+    if oo.shape[0] > 0:
+        oo = jnp.where(wipe[None, :, None], zero_u32, oo)
+        # Cheap exact recompute, gated on the flag: window-free runs
+        # never touch the words.
+        oo_any = jax.lax.cond(
+            data.oo_any, lambda o: jnp.any(o), lambda o: data.oo_any, oo
+        )
+    q_writer = jnp.where(wipe[:, None], jnp.int32(-1), data.q_writer)
+    q_tx = jnp.where(wipe[:, None], jnp.int32(0), data.q_tx)
+    cells = data.cells
+    if cfg.n_cells > 0:
+        n, k = cfg.n_nodes, cfg.n_cells
+        keep = jnp.repeat(not_w, k)  # bool[N*K]
+        cells = type(cells)(
+            cl=jnp.where(keep, cells.cl, zero_u32),
+            col_version=jnp.where(keep, cells.col_version, zero_u32),
+            value_rank=jnp.where(keep, cells.value_rank, zero_u32),
+        )
+    return data._replace(
+        contig=contig, seen=seen, oo=oo, oo_any=oo_any,
+        q_writer=q_writer, q_tx=q_tx, cells=cells,
+    )

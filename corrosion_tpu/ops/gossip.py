@@ -45,7 +45,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from corrosion_tpu.ops import crdt, onehot, routing
+from corrosion_tpu.ops import crdt, faulting, onehot, routing
 
 
 @dataclass(frozen=True)
@@ -451,10 +451,11 @@ def broadcast_round(
     data: DataState,
     topo: Topology,
     alive: jax.Array,
-    partition: jax.Array,  # bool[R, R] True = link cut between regions
+    partition: jax.Array,  # bool[R, R] True = receiver row can't hear col
     writes: jax.Array,  # u32[W] versions committed by each writer this round
     rng: jax.Array,
     cfg: GossipConfig,
+    loss: jax.Array | None = None,  # f32[R] injected per-region loss prob
 ) -> tuple[DataState, dict]:
     n, w_count, q_cap = cfg.n_nodes, cfg.n_writers, cfg.queue
     nodes = jnp.arange(n)
@@ -555,9 +556,13 @@ def broadcast_round(
             jnp.repeat(link_ok[:, :, None], q_cap, axis=2).reshape(n, kk)
             & (m_w >= 0)
         )
-        if cfg.loss_prob > 0.0:  # static: skip 14M randoms/round otherwise
-            lost = jax.random.uniform(k_loss, (n, f, q_cap)) < cfg.loss_prob
-            m_ok &= ~lost.reshape(n, kk)
+        # Shared static-skip loss (ops/faulting.py): config loss and the
+        # chaos plane's per-region schedule compose here; receiver-side,
+        # so a region's loss burst degrades what IT hears.
+        dyn_loss = None if loss is None else loss[topo.region][:, None]
+        m_ok, n_lost = faulting.apply_loss(
+            k_loss, m_ok, cfg.loss_prob, dyn_loss
+        )
         n_msgs = jnp.sum(m_ok)
         k_in = cfg.rebroadcast_intake or cfg.fanout * 2
 
@@ -942,6 +947,7 @@ def broadcast_round(
         sent_any = jnp.zeros((n,), dtype=bool)
         oo_new, oo_any_new = data.oo, data.oo_any
         n_degraded = jnp.uint32(0)
+        n_lost = jnp.uint32(0)
 
     # ---- 5. queue rebuild (oldest versions first, like the FIFO buffer) ----
     # An entry's tx budget burns only when the sender actually reached at
@@ -999,6 +1005,9 @@ def broadcast_round(
         # seen-only tracking and are healed by sync. Nonzero sustained
         # values mean window_k is undersized for the loss/outage pattern.
         "window_degraded": n_degraded,
+        # Messages dropped by loss injection (config ambient + chaos
+        # plan) this round — the chaos plane's ground-truth drop count.
+        "lost_msgs": n_lost,
     }
     return (
         DataState(
@@ -1437,7 +1446,23 @@ def revive_sync(
     """Immediate anti-entropy for nodes that just rejoined, instead of
     waiting out their cohort slot — the reference syncs on rejoin
     (agent.rs:2383-2423 peer choice fires as soon as the member is back).
-    Wrapped in lax.cond so churn-free rounds skip the full-N session."""
+    Wrapped in lax.cond so churn-free rounds skip the full-N session.
+
+    Churn semantics served by this session (docs/CHAOS.md):
+
+    - **pause-resume** (the default kill): the killed node RETAINS its
+      DataState; on revive this session only covers the versions that
+      committed while it was down. The dense, sparse, and mixed engines
+      all use pause-resume unless a fault plan says otherwise.
+    - **crash-with-state-wipe** (``FaultPlan`` churn with ``wipe=True``,
+      applied via ops/faulting.wipe_nodes): the node restarts from an
+      EMPTY replica state and this same session is its bootstrap
+      catch-up — budgeted, so full recovery may take further cohort
+      sessions. Supported by the dense and mixed engines; the sparse
+      engine degrades wipe to pause-resume (a total wipe exceeds its
+      bounded deviation tables) and sim/faults.py documents that
+      loudly. The chunk plane wipes coverage directly in its own round
+      (ops/chunks.wipe_coverage) — it has no version-plane sync."""
     nodes = jnp.arange(cfg.n_nodes)
     row_ok = revived & alive
 

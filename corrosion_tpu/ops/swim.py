@@ -36,7 +36,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from corrosion_tpu.ops import routing
+from corrosion_tpu.ops import faulting, routing
 
 SEV_ALIVE = 0
 SEV_SUSPECT = 1
@@ -139,8 +139,13 @@ def _merge_scatter(view: jax.Array, recv: jax.Array, tgt: jax.Array,
 
 @partial(jax.jit, static_argnames=("cfg",))
 def swim_round(state: SwimState, rng: jax.Array, round_idx: jax.Array,
-               cfg: SwimConfig) -> SwimState:
-    """One bulk-synchronous SWIM protocol period for all N nodes."""
+               cfg: SwimConfig,
+               probe_loss: jax.Array | None = None) -> SwimState:
+    """One bulk-synchronous SWIM protocol period for all N nodes.
+
+    ``probe_loss`` (f32[], chaos plane) drops probe/ack exchanges ONLY —
+    the data plane is untouched, isolating membership-protocol stress
+    (false suspicions, refutation storms) from delivery loss."""
     n = cfg.n_nodes
     nodes = jnp.arange(n)
     k_probe, k_loss, k_goss = jax.random.split(rng, 3)
@@ -170,8 +175,11 @@ def swim_round(state: SwimState, rng: jax.Array, round_idx: jax.Array,
     probe_tgt, _ = jax.lax.scan(pick, jnp.full((n,), -1, jnp.int32), tries)
     has_probe = (probe_tgt >= 0) & alive
     pt = jnp.maximum(probe_tgt, 0)
-    lost = jax.random.uniform(k_loss, (n,)) < cfg.loss_prob
-    ack = has_probe & alive[pt] & ~lost
+    # Shared static-skip loss (ops/faulting.py): ambient config loss and
+    # the chaos plane's probe/ack-only schedule compose here.
+    ack, _ = faulting.apply_loss(
+        k_loss, has_probe & alive[pt], cfg.loss_prob, probe_loss
+    )
     # Ack carries the target's current incarnation → learn alive@inc.
     ack_pkd = pack(inc_self[pt], SEV_ALIVE)
     # Failure → suspect at the incarnation we currently believe.
@@ -306,6 +314,7 @@ def apply_churn(
     revive: jax.Array,
     rng: jax.Array | None = None,
     max_transmissions: int = 6,
+    wipe: jax.Array | None = None,
 ) -> SwimState:
     """Ground-truth churn between rounds.
 
@@ -316,7 +325,28 @@ def apply_churn(
     one random alive peer, modeling the state transfer a SWIM announce gets
     from its seed (foca feeds joiners the member list; without this a
     rejoiner would have to re-probe every dead peer itself).
+
+    ``wipe`` (bool[N], chaos plane) marks kills as crash-with-state-wipe:
+    the process forgets every belief it held (its view row resets to the
+    fresh-joiner prior), its suspicion timers, and its update queue.
+    Its own INCARNATION is kept — and bumped on revive as usual —
+    because identity must stay monotonic: restarting at incarnation 0
+    would let stale suspect beliefs outrank the rejoin announce forever,
+    the "resurrected zombie" failure the chaos invariants check for.
+    Other nodes' beliefs ABOUT the wiped node are untouched; detecting
+    the death is their job.
     """
+    if wipe is not None:
+        state = state._replace(
+            view=jnp.where(wipe[:, None], jnp.uint32(0), state.view),
+            susp_target=jnp.where(
+                wipe[:, None], jnp.int32(-1), state.susp_target
+            ),
+            upd_target=jnp.where(
+                wipe[:, None], jnp.int32(-1), state.upd_target
+            ),
+            upd_tx=jnp.where(wipe[:, None], jnp.int32(0), state.upd_tx),
+        )
     alive = (state.alive & ~kill) | revive
     inc = jnp.where(revive, state.incarnation + 1, state.incarnation)
     n = state.view.shape[0]

@@ -44,7 +44,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from corrosion_tpu.ops import routing
+from corrosion_tpu.ops import faulting, routing
 from corrosion_tpu.ops.swim import (
     SEV_ALIVE,
     SEV_DOWN,
@@ -254,9 +254,13 @@ def _merge_scan(
 
 @partial(jax.jit, static_argnames=("cfg",))
 def swim_round(
-    state: SparseSwimState, rng: jax.Array, round_idx: jax.Array, cfg: SwimConfig
+    state: SparseSwimState, rng: jax.Array, round_idx: jax.Array, cfg: SwimConfig,
+    probe_loss: jax.Array | None = None,
 ) -> SparseSwimState:
-    """One bulk-synchronous SWIM protocol period for all N nodes."""
+    """One bulk-synchronous SWIM protocol period for all N nodes.
+
+    ``probe_loss`` (f32[], chaos plane) drops probe/ack exchanges only,
+    like the dense kernel."""
     n = cfg.n_nodes
     nodes = jnp.arange(n)
     k_probe, k_loss, k_goss = jax.random.split(rng, 3)
@@ -281,9 +285,12 @@ def swim_round(
     probe_tgt, _ = jax.lax.scan(pick, jnp.full((n,), -1, jnp.int32), tries)
     has_probe = (probe_tgt >= 0) & alive
     pt = jnp.maximum(probe_tgt, 0)
-    lost = jax.random.uniform(k_loss, (n,)) < cfg.loss_prob
-    # i32 gather (bool gathers serialize on TPU).
-    ack = has_probe & (alive.astype(jnp.int32)[pt] > 0) & ~lost
+    # Shared static-skip loss (ops/faulting.py); i32 gather (bool
+    # gathers serialize on TPU).
+    ack, _ = faulting.apply_loss(
+        k_loss, has_probe & (alive.astype(jnp.int32)[pt] > 0),
+        cfg.loss_prob, probe_loss,
+    )
     ack_pkd = pack(inc_self[pt], SEV_ALIVE)
     known = _lookup(exc_tgt, exc_pkd, pt)
     susp_pkd = pack(packed_inc(known), SEV_SUSPECT)
@@ -447,6 +454,7 @@ def apply_churn(
     revive: jax.Array,
     rng: jax.Array | None = None,
     max_transmissions: int = 6,
+    wipe: jax.Array | None = None,
 ) -> SparseSwimState:
     """Ground-truth churn between rounds (identity renewal on revive).
 
@@ -454,7 +462,26 @@ def apply_churn(
     its self-belief, queues a self-announce, and — when ``rng`` is given —
     bootstrap-pulls one random alive peer's exception table (the member-list
     transfer a SWIM announce gets from its seed).
+
+    ``wipe`` marks kills as crash-with-state-wipe (see the dense
+    kernel's docstring): the wiped node's exception table, timers, and
+    update queue reset; its incarnation is kept so identity stays
+    monotonic. NOTE: only the MEMBERSHIP plane supports wipe here — the
+    sparse DATA plane degrades wipe to pause-resume (bounded deviation
+    tables, see gossip.revive_sync).
     """
+    if wipe is not None:
+        state = state._replace(
+            exc_tgt=jnp.where(wipe[:, None], jnp.int32(-1), state.exc_tgt),
+            exc_pkd=jnp.where(wipe[:, None], jnp.uint32(0), state.exc_pkd),
+            susp_target=jnp.where(
+                wipe[:, None], jnp.int32(-1), state.susp_target
+            ),
+            upd_target=jnp.where(
+                wipe[:, None], jnp.int32(-1), state.upd_target
+            ),
+            upd_tx=jnp.where(wipe[:, None], jnp.int32(0), state.upd_tx),
+        )
     alive = (state.alive & ~kill) | revive
     inc = jnp.where(revive, state.incarnation + 1, state.incarnation)
     n = state.exc_tgt.shape[0]

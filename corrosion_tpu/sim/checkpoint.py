@@ -75,7 +75,10 @@ def load_state(path: str, cfg, n_samples: int) -> ClusterState:
 
 def save_schedule(path: str, schedule: Schedule) -> None:
     arrays = {"writes": schedule.writes}
-    for name in ("kill", "revive", "partition"):
+    # Chaos axes (loss/probe_loss/wipe, sim/faults.py) persist alongside
+    # the churn/partition masks: a resumed run replays its fault plan.
+    for name in ("kill", "revive", "partition", "loss", "probe_loss",
+                 "wipe"):
         v = getattr(schedule, name)
         if v is not None:
             arrays[name] = v
@@ -95,6 +98,11 @@ def load_schedule(path: str) -> Schedule:
             sample_writer=data["sample_writer"],
             sample_ver=data["sample_ver"],
             sample_round=data["sample_round"],
+            loss=data["loss"] if "loss" in data else None,
+            probe_loss=(
+                data["probe_loss"] if "probe_loss" in data else None
+            ),
+            wipe=data["wipe"] if "wipe" in data else None,
         )
 
 

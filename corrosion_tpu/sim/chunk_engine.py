@@ -36,11 +36,24 @@ from corrosion_tpu.sim.telemetry import KernelTelemetry
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def _scan(state, vis, last_seq, alive, base_key, ridx, cfg):
-    def body(carry, r):
+def _scan(state, vis, last_seq, alive, base_key, xs, cfg):
+    """xs = (round_idx [E], alive_t [E, N] | None, loss [E] | None,
+    wipe [E, N] | None); ``alive`` is the churn-free constant used when
+    ``alive_t`` is absent (the chaos axes are trace-time optional, like
+    every engine)."""
+
+    def body(carry, x):
         st, vis = carry
+        r, alive_t, lo, wp = x
+        a = alive if alive_t is None else alive_t
         key = jax.random.fold_in(base_key, r)
-        st, stats = chunk_ops.chunk_round(st, last_seq, alive, r, key, cfg)
+        if wp is not None:
+            # Crash-with-state-wipe: partial buffers are gone before the
+            # round's gossip (ops/chunks.wipe_coverage).
+            st = chunk_ops.wipe_coverage(st, wp, cfg)
+        st, stats = chunk_ops.chunk_round(
+            st, last_seq, a, r, key, cfg, loss=lo
+        )
         with jax.named_scope("corro_track"):
             applied = chunk_ops.applied_mask(st, last_seq, cfg)
             newly = (vis < 0) & applied
@@ -60,13 +73,18 @@ def _scan(state, vis, last_seq, alive, base_key, ridx, cfg):
             streams_applied=stats["applied_nodes"],
             chunks_sent=stats["chunks_sent"],
             seqs_granted=stats["seqs_granted"],
+            chaos_lost_msgs=stats["lost_msgs"],
+            chaos_wiped=(
+                jnp.uint32(0) if wp is None
+                else jnp.sum(wp, dtype=jnp.uint32)
+            ),
             **telemetry_mod.delivery_latency_hist(
                 jnp.broadcast_to(r, newly.shape), newly
             ),
         )
         return (st, vis), curves
 
-    return jax.lax.scan(body, (state, vis), ridx)
+    return jax.lax.scan(body, (state, vis), xs)
 
 
 def simulate_chunks(
@@ -78,6 +96,7 @@ def simulate_chunks(
     round_ms: float = 500.0,
     max_chunk: int | None = None,
     telemetry: KernelTelemetry | None = None,
+    faults=None,
 ):
     """Run ``rounds`` chunk-plane rounds; returns (state, metrics dict).
 
@@ -91,6 +110,14 @@ def simulate_chunks(
     absolute round index, so results are identical either way), and
     ``telemetry`` instruments each execution as a chunk — timed, spanned,
     and flushed to the flight recorder.
+
+    ``faults`` (sim.faults.FaultPlan or CompiledFaults) injects chunk
+    loss (the plan's worst-region scalar — this plane has no region
+    structure), kill/revive churn (dead nodes neither gossip nor sync),
+    and crash-with-state-wipe (coverage reset; wiping a stream's last
+    full holder makes it unrecoverable, so plans protect origins).
+    Partition components are rejected loudly — there is no region
+    topology to cut.
     """
     origin = jnp.asarray(origin, jnp.int32)
     last_seq = jnp.asarray(last_seq, jnp.int32)
@@ -98,6 +125,31 @@ def simulate_chunks(
     alive = jnp.ones((cfg.n_nodes,), bool)
     vis = jnp.full((cfg.n_nodes, cfg.n_streams), -1, jnp.int32)
     base_key = jax.random.PRNGKey(seed)
+
+    alive_np = loss_np = wipe_np = None
+    if faults is not None:
+        from corrosion_tpu.sim import faults as faults_mod
+
+        # A FaultPlan compiles at whatever region count its components
+        # reference (region-targeted loss degrades to the worst-region
+        # scalar below); CompiledFaults pass through as-is.
+        c = (
+            faults.compile(cfg.n_nodes, max(1, faults.max_region() + 1))
+            if isinstance(faults, faults_mod.FaultPlan) else faults
+        )
+        if c.rounds != rounds:
+            raise ValueError(
+                f"fault plan rounds {c.rounds} != run rounds {rounds}"
+            )
+        if c.partition is not None:
+            raise ValueError(
+                "the chunk plane has no region topology; partition/flap "
+                "components cannot apply here (use loss or churn)"
+            )
+        loss_np = c.loss_scalar
+        if c.kill is not None or c.revive is not None:
+            alive_np = c.alive_curve(cfg.n_nodes)
+        wipe_np = c.wipe
 
     step = max_chunk if max_chunk is not None else max(rounds, 1)
     # rounds == 0 is a valid degenerate run: empty canonical curves.
@@ -107,15 +159,23 @@ def simulate_chunks(
     )
     for r0 in range(0, rounds, step):
         nr = min(step, rounds - r0)
-        ridx = jnp.arange(r0, r0 + nr, dtype=jnp.int32)
+        sl = slice(r0, r0 + nr)
+        xs = (
+            jnp.arange(r0, r0 + nr, dtype=jnp.int32),
+            None if alive_np is None else jnp.asarray(alive_np[sl]),
+            None if loss_np is None else jnp.asarray(
+                loss_np[sl], jnp.float32
+            ),
+            None if wipe_np is None else jnp.asarray(wipe_np[sl]),
+        )
         if telemetry is None:
             (state, vis), curves = _scan(
-                state, vis, last_seq, alive, base_key, ridx, cfg
+                state, vis, last_seq, alive, base_key, xs, cfg
             )
         else:
-            def _run(state=state, vis=vis, ridx=ridx):
+            def _run(state=state, vis=vis, xs=xs):
                 (st, vi), curves = _scan(
-                    state, vis, last_seq, alive, base_key, ridx, cfg
+                    state, vis, last_seq, alive, base_key, xs, cfg
                 )
                 return (st, vi), curves
 

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from corrosion_tpu.ops import faulting
 from corrosion_tpu.ops import gossip as gossip_ops
 from corrosion_tpu.ops import swim as swim_ops
 from corrosion_tpu.ops.gossip import DataState, GossipConfig, Topology
@@ -62,9 +63,21 @@ class Schedule:
 
     writes: u8/u32[rounds, W] versions committed per writer per round.
     kill/revive: optional bool[rounds, N] churn masks.
-    partition: optional bool[rounds, R, R] region link cuts.
+    partition: optional bool[rounds, R, R] region link cuts — DIRECTIONAL:
+      ``partition[t, i, j]`` True means receivers in region i cannot hear
+      sources in region j at round t (a symmetric matrix gives the
+      classic two-way cut; the chaos plane emits one-way cuts too).
     samples: (writer[S], version[S], round[S]) — writes whose visibility is
       tracked. ``make_samples`` derives them from ``writes``.
+
+    Chaos-plane axes (sim/faults.apply_plan attaches them; ``None`` keeps
+    the engines' static zero-cost fault-free trace):
+
+    loss: optional f32[rounds, R] injected receiver-region loss prob.
+    probe_loss: optional f32[rounds] SWIM probe/ack-only loss prob.
+    wipe: optional bool[rounds, N] crash-with-state-wipe mask (applies at
+      the kill round; see ops/faulting.wipe_nodes and gossip.revive_sync
+      for the per-engine semantics).
     """
 
     writes: np.ndarray
@@ -74,6 +87,9 @@ class Schedule:
     sample_writer: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
     sample_ver: np.ndarray = field(default_factory=lambda: np.zeros(0, np.uint32))
     sample_round: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    loss: np.ndarray | None = None
+    probe_loss: np.ndarray | None = None
+    wipe: np.ndarray | None = None
 
     @property
     def rounds(self) -> int:
@@ -122,9 +138,15 @@ def cluster_round(
     rng: jax.Array,
     cfg: ClusterConfig,
     has_churn: bool,
+    loss: jax.Array | None = None,  # f32[R] chaos receiver-region loss
+    probe_loss: jax.Array | None = None,  # f32[] chaos probe/ack loss
+    wipe: jax.Array | None = None,  # bool[N] crash-with-state-wipe
 ) -> tuple[ClusterState, dict]:
     # The rejoin key exists only for churn configs, so churn-free runs
-    # keep bit-identical RNG streams with earlier measurements.
+    # keep bit-identical RNG streams with earlier measurements. The
+    # chaos axes (loss/probe_loss/wipe) are trace-time optional the same
+    # way: a fault-free plan leaves them None and this trace is the
+    # pre-chaos one.
     if has_churn:
         k_churn, k_bcast, k_swim, k_sync, k_rejoin = jax.random.split(rng, 5)
     else:
@@ -132,21 +154,33 @@ def cluster_round(
         k_rejoin = None
     swim_impl = swim_ops.impl(cfg.swim)
     sw = state.swim
+    data_pre = state.data
+    if wipe is not None:
+        if not has_churn:
+            raise ValueError("wipe masks require a churn schedule")
+        # Crash-with-state-wipe at the kill round: replica state resets
+        # BEFORE this round's protocol work, so the restarted node
+        # participates from empty like a real rejoining process.
+        data_pre = faulting.wipe_nodes(data_pre, wipe, cfg.gossip)
     if has_churn:
         sw = swim_impl.apply_churn(
-            sw, kill, revive, k_churn, cfg.swim.max_transmissions
+            sw, kill, revive, k_churn, cfg.swim.max_transmissions,
+            wipe=wipe,
         )
     alive = sw.alive
 
     with jax.named_scope("corro_broadcast"):
         data, bstats = gossip_ops.broadcast_round(
-            state.data, topo, alive, partition, writes, k_bcast, cfg.gossip
+            data_pre, topo, alive, partition, writes, k_bcast, cfg.gossip,
+            loss=loss,
         )
     with jax.named_scope("corro_swim"):
         # Snapshot incarnations AFTER churn (revive bumps are rejoins,
         # not flaps) so swim_flaps counts only refutation-driven bumps.
         inc_pre = sw.incarnation
-        sw = swim_impl.swim_round(sw, k_swim, state.round, cfg.swim)
+        sw = swim_impl.swim_round(
+            sw, k_swim, state.round, cfg.swim, probe_loss=probe_loss
+        )
     with jax.named_scope("corro_sync"):
         data, sstats = gossip_ops.sync_round(
             data, topo, alive, partition, state.round, k_sync, cfg.gossip
@@ -198,6 +232,11 @@ def cluster_round(
         swim_undetected_deaths=undetected,
         swim_flaps=jnp.sum(sw.incarnation != inc_pre, dtype=jnp.uint32),
         queue_backlog=gossip_ops.queue_backlog(data),
+        chaos_lost_msgs=bstats["lost_msgs"],
+        chaos_wiped=(
+            jnp.uint32(0) if wipe is None
+            else jnp.sum(wipe, dtype=jnp.uint32)
+        ),
         **lat_hist,
     )
     return (
@@ -264,6 +303,18 @@ def simulate(
                 sample_writer=schedule.sample_writer,
                 sample_ver=schedule.sample_ver,
                 sample_round=schedule.sample_round,
+                loss=(
+                    None if schedule.loss is None
+                    else schedule.loss[start:stop]
+                ),
+                probe_loss=(
+                    None if schedule.probe_loss is None
+                    else schedule.probe_loss[start:stop]
+                ),
+                wipe=(
+                    None if schedule.wipe is None
+                    else schedule.wipe[start:stop]
+                ),
             )
             if telemetry is None:
                 cur, curves = simulate(cfg, topo, part, seed=seed, state=cur)
@@ -287,7 +338,12 @@ def simulate(
         return cur, merged
     n = cfg.n_nodes
     n_regions = int(np.asarray(topo.region).max()) + 1
-    has_churn = schedule.kill is not None or schedule.revive is not None
+    # A wipe mask implies churn (the wipe applies at the kill round).
+    has_churn = (
+        schedule.kill is not None
+        or schedule.revive is not None
+        or schedule.wipe is not None
+    )
     rounds = schedule.rounds
 
     writes = jnp.asarray(schedule.writes, dtype=jnp.uint32)
@@ -307,6 +363,17 @@ def simulate(
         partition = jnp.asarray(schedule.partition)
     else:
         partition = jnp.zeros((rounds, n_regions, n_regions), dtype=bool)
+    # Chaos axes: None stays None (trace-time absent — the static
+    # zero-cost skip all the way down to ops/faulting.apply_loss).
+    loss = (
+        None if schedule.loss is None
+        else jnp.asarray(schedule.loss, dtype=jnp.float32)
+    )
+    probe_loss = (
+        None if schedule.probe_loss is None
+        else jnp.asarray(schedule.probe_loss, dtype=jnp.float32)
+    )
+    wipe = None if schedule.wipe is None else jnp.asarray(schedule.wipe)
 
     s_writer = jnp.asarray(schedule.sample_writer)
     s_ver = jnp.asarray(schedule.sample_ver)
@@ -323,6 +390,7 @@ def simulate(
     xs = (
         writes, partition, kill, revive,
         jnp.arange(offset, offset + rounds, dtype=jnp.int32),
+        loss, probe_loss, wipe,
     )
     if telemetry is None:
         final, curves = _scan_rounds(
@@ -353,11 +421,11 @@ def _scan_rounds(
     traced argument, not a constant)."""
 
     def body(carry, x):
-        w, p, kl, rv, r = x
+        w, p, kl, rv, r, lo, pl, wp = x
         key = jax.random.fold_in(base_key, r)
         return cluster_round(
             carry, topo, w, p, kl, rv, s_writer, s_ver, s_round, key, cfg,
-            has_churn,
+            has_churn, loss=lo, probe_loss=pl, wipe=wp,
         )
 
     return jax.lax.scan(body, state, xs)

@@ -156,6 +156,57 @@ def detection_latencies(undetected: np.ndarray,
     return events
 
 
+def recovery_after_heal(
+    curves: dict, heal_round: int, round_ms: float = 500.0,
+    require_membership: bool = False,
+) -> dict:
+    """Recovery-time-after-heal: how long the protocol took to go quiet
+    once the last injected fault cleared (the chaos plane's headline
+    verdict, consumed by sim/invariants.py).
+
+    Quiet means ``need == 0``, ``staleness_sum == 0``, and
+    ``swim_undetected_deaths == 0`` sustained to the end of the record.
+    ``mismatches`` joins the predicate only with
+    ``require_membership=True``: suspect/down beliefs about LIVE nodes
+    are sticky by design until down-GC forgets them (the reference's
+    ``remove_down_after`` is 48 h), so a probe-loss storm legitimately
+    leaves nonzero mismatches long after the data plane recovered.
+
+    Returns ``{"heal_round", "recovered_round", "recovery_rounds",
+    "recovery_s"}`` with Nones when the record never recovers.
+    """
+    need = _arr(curves, "need")
+    rounds = len(need)
+
+    def _get(key):
+        # Zero-fill anchored on the need curve: partial dicts (tests,
+        # pre-health flight replays) must not break the broadcast.
+        if key in curves:
+            return np.asarray(curves[key], dtype=np.float64)
+        return np.zeros(rounds, dtype=np.float64)
+
+    stale = _get("staleness_sum")
+    undet = _get("swim_undetected_deaths")
+    quiet = (need == 0) & (stale == 0) & (undet == 0)
+    if require_membership:
+        quiet &= _get("mismatches") == 0
+    recovered: int | None = None
+    if rounds and quiet[-1]:
+        nonquiet = np.nonzero(~quiet)[0]
+        recovered = int(nonquiet[-1]) + 1 if nonquiet.size else 0
+        recovered = max(recovered, int(heal_round))
+    rec_rounds = None if recovered is None else recovered - int(heal_round)
+    return {
+        "heal_round": int(heal_round),
+        "recovered_round": recovered,
+        "recovery_rounds": rec_rounds,
+        "recovery_s": (
+            None if rec_rounds is None
+            else rec_rounds * round_ms / 1000.0
+        ),
+    }
+
+
 def cdf_quantile(counts: np.ndarray, q: float) -> tuple[int, float]:
     """(bucket index, upper edge in rounds) of quantile ``q`` over the
     fixed delivery-latency buckets; the overflow bucket's edge is inf.

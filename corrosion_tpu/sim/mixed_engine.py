@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from corrosion_tpu.ops import chunks as chunk_ops
+from corrosion_tpu.ops import faulting
 from corrosion_tpu.ops import gossip as gossip_ops
 from corrosion_tpu.ops import intervals, swim as swim_ops
 from corrosion_tpu.ops.chunks import ChunkConfig, ChunkState
@@ -165,12 +166,15 @@ def _backfill_coverage(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "ccfg"))
+@partial(jax.jit, static_argnames=("cfg", "ccfg", "has_churn"))
 def mixed_round(
     state: MixedState,
     topo: Topology,
     writes: jax.Array,  # u32[W] SMALL writes per writer this round
     big_commit: jax.Array,  # bool[S] streams committing this round
+    part: jax.Array,  # bool[R, R] directional region link cuts
+    kill: jax.Array,  # bool[N] (ignored when has_churn=False)
+    revive: jax.Array,
     s_writer: jax.Array,  # i32[S]
     s_version: jax.Array,  # u32[S]
     s_last: jax.Array,  # i32[S]
@@ -180,15 +184,41 @@ def mixed_round(
     rng: jax.Array,
     cfg: ClusterConfig,
     ccfg: ChunkConfig,
+    has_churn: bool = False,
+    loss: jax.Array | None = None,  # f32[R] chaos receiver-region loss
+    probe_loss: jax.Array | None = None,  # f32[]
+    wipe: jax.Array | None = None,  # bool[N] crash-with-state-wipe
 ) -> tuple[MixedState, dict]:
-    k_b, k_sw, k_sy, k_ck = jax.random.split(rng, 4)
+    # Churn/rejoin keys exist only for churn configs so fault-free runs
+    # keep bit-identical RNG streams (same discipline as the dense
+    # engine's cluster_round).
+    if has_churn:
+        k_churn, k_b, k_sw, k_sy, k_ck, k_rejoin = jax.random.split(rng, 6)
+    else:
+        k_b, k_sw, k_sy, k_ck = jax.random.split(rng, 4)
+        k_rejoin = None
     swim_impl = swim_ops.impl(cfg.swim)
     sw = state.swim
+    data = state.data
+    chunks_pre = state.chunks
+    applied_before = state.applied_before
+    if wipe is not None:
+        if not has_churn:
+            raise ValueError("wipe masks require a churn schedule")
+        # Crash-with-state-wipe on BOTH planes: replica state and the
+        # partial-version buffers restart empty, and the completion
+        # latch resets so the rebuilt coverage re-admits the big
+        # versions through the normal path.
+        data = faulting.wipe_nodes(data, wipe, cfg.gossip)
+        chunks_pre = chunk_ops.wipe_coverage(chunks_pre, wipe, ccfg)
+        applied_before = applied_before & ~wipe[:, None]
+    if has_churn:
+        sw = swim_impl.apply_churn(
+            sw, kill, revive, k_churn, cfg.swim.max_transmissions,
+            wipe=wipe,
+        )
     inc_pre = sw.incarnation
     alive = sw.alive
-    n_regions = topo.region_rtt.shape[0]
-    part = jnp.zeros((n_regions, n_regions), bool)
-    data = state.data
 
     # Big-version commit: head/contig/seen bump at the writer WITHOUT a
     # broadcast-queue entry (the chunk plane carries the content; the
@@ -210,16 +240,19 @@ def mixed_round(
     for s in range(s_writer.shape[0]):
         data = commit_one(data, s)
 
-    # Chunk plane round (content dissemination + partial-need sync).
+    # Chunk plane round (content dissemination + partial-need sync). The
+    # chunk plane has no region structure, so a regional loss schedule
+    # degrades to its worst-region scalar here.
     chunks, cstats = chunk_ops.chunk_round(
-        state.chunks, s_last, alive, state.round, k_ck, ccfg
+        chunks_pre, s_last, alive, state.round, k_ck, ccfg,
+        loss=None if loss is None else jnp.max(loss),
     )
     applied_now = chunk_ops.applied_mask(chunks, s_last, ccfg)  # [N, S]
     committed = big_commit | (
         data.head[jnp.maximum(s_writer, 0)] >= s_version
     )
     applied_now = applied_now & committed[None, :]
-    newly = applied_now & ~state.applied_before
+    newly = applied_now & ~applied_before
 
     # Version-plane admission of freshly reassembled big versions.
     data, admit_merges = _admit_big(
@@ -228,13 +261,23 @@ def mixed_round(
 
     # Ordinary broadcast + SWIM + sync.
     data, bstats = gossip_ops.broadcast_round(
-        data, topo, alive, part, writes, k_b, cfg.gossip
+        data, topo, alive, part, writes, k_b, cfg.gossip, loss=loss
     )
-    sw = swim_impl.swim_round(sw, k_sw, state.round, cfg.swim)
+    sw = swim_impl.swim_round(
+        sw, k_sw, state.round, cfg.swim, probe_loss=probe_loss
+    )
     contig_pre = data.contig
     data, sstats = gossip_ops.sync_round(
         data, topo, alive, part, state.round, k_sy, cfg.gossip
     )
+    if has_churn:
+        # Rejoining nodes pull immediately instead of waiting out their
+        # cohort slot (the reference syncs on rejoin) — same semantics
+        # as the dense engine; wiped rejoiners bootstrap from empty.
+        data, rstats = gossip_ops.revive_sync(
+            data, topo, alive, part, revive, k_rejoin, cfg.gossip
+        )
+        sstats = {k: sstats[k] + rstats[k] for k in sstats}
     # Sync crossings: nodes granted the whole big version back-fill their
     # chunk coverage (the content came through the sync stream).
     crossed = (
@@ -291,6 +334,11 @@ def mixed_round(
         swim_undetected_deaths=undetected,
         swim_flaps=jnp.sum(sw.incarnation != inc_pre, dtype=jnp.uint32),
         queue_backlog=gossip_ops.queue_backlog(data),
+        chaos_lost_msgs=bstats["lost_msgs"] + cstats["lost_msgs"],
+        chaos_wiped=(
+            jnp.uint32(0) if wipe is None
+            else jnp.sum(wipe, dtype=jnp.uint32)
+        ),
         **telemetry_mod.delivery_latency_hist(
             state.round - sample_round[:, None], newly
         ),
@@ -305,20 +353,21 @@ def mixed_round(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "ccfg"))
+@partial(jax.jit, static_argnames=("cfg", "ccfg", "has_churn"))
 def _scan_mixed(
     state, topo, xs, s_writer, s_version, s_last, s_w, s_v, s_r,
-    base_key, cfg, ccfg,
+    base_key, cfg, ccfg, has_churn,
 ):
     """Whole-chunk scan, jitted once per (cfg, shapes) — chunked runs
     with equal chunk lengths hit the compile cache."""
 
     def body(carry, x):
-        w, c, r = x
+        w, c, p, kl, rv, r, lo, pl, wp = x
         key = jax.random.fold_in(base_key, r)
         return mixed_round(
-            carry, topo, w, c, s_writer, s_version, s_last,
-            s_w, s_v, s_r, key, cfg, ccfg,
+            carry, topo, w, c, p, kl, rv, s_writer, s_version, s_last,
+            s_w, s_v, s_r, key, cfg, ccfg, has_churn,
+            loss=lo, probe_loss=pl, wipe=wp,
         )
 
     return jax.lax.scan(body, state, xs)
@@ -375,6 +424,39 @@ def simulate_mixed(
     s_r = jnp.asarray(schedule.sample_round)
     base_key = jax.random.PRNGKey(seed)
 
+    # Chaos axes (sim/faults.apply_plan): same dummy-mask discipline as
+    # the dense engine — churn-free runs keep 1-wide placeholders and
+    # the bit-identical fault-free trace.
+    n_regions = topo.region_rtt.shape[0]
+    has_churn = (
+        schedule.kill is not None
+        or schedule.revive is not None
+        or schedule.wipe is not None
+    )
+    if has_churn:
+        zeros_n = np.zeros((rounds, n), dtype=bool)
+        kill = jnp.asarray(
+            schedule.kill if schedule.kill is not None else zeros_n
+        )
+        revive = jnp.asarray(
+            schedule.revive if schedule.revive is not None else zeros_n
+        )
+    else:
+        kill = revive = jnp.zeros((rounds, 1), dtype=bool)
+    if schedule.partition is not None:
+        partition = jnp.asarray(schedule.partition)
+    else:
+        partition = jnp.zeros((rounds, n_regions, n_regions), dtype=bool)
+    loss = (
+        None if schedule.loss is None
+        else jnp.asarray(schedule.loss, jnp.float32)
+    )
+    probe_loss = (
+        None if schedule.probe_loss is None
+        else jnp.asarray(schedule.probe_loss, jnp.float32)
+    )
+    wipe = None if schedule.wipe is None else jnp.asarray(schedule.wipe)
+
     step = max_chunk if max_chunk is not None else max(rounds, 1)
     curve_parts: list[dict] = (
         [] if rounds > 0
@@ -383,19 +465,23 @@ def simulate_mixed(
     for r0 in range(0, rounds, step):
         r1 = min(r0 + step, rounds)
         xs = (
-            writes[r0:r1], commit[r0:r1],
+            writes[r0:r1], commit[r0:r1], partition[r0:r1],
+            kill[r0:r1], revive[r0:r1],
             jnp.arange(r0, r1, dtype=jnp.int32),
+            None if loss is None else loss[r0:r1],
+            None if probe_loss is None else probe_loss[r0:r1],
+            None if wipe is None else wipe[r0:r1],
         )
         if telemetry is None:
             state, curves = _scan_mixed(
                 state, topo, xs, s_writer, s_version, s_last,
-                s_w, s_v, s_r, base_key, cfg, ccfg,
+                s_w, s_v, s_r, base_key, cfg, ccfg, has_churn,
             )
         else:
             def _run(state=state, xs=xs):
                 return _scan_mixed(
                     state, topo, xs, s_writer, s_version, s_last,
-                    s_w, s_v, s_r, base_key, cfg, ccfg,
+                    s_w, s_v, s_r, base_key, cfg, ccfg, has_churn,
                 )
 
             state, curves = telemetry.run_chunk(r0, _run)

@@ -223,7 +223,8 @@ def _epoch_scan(
     swim_state,
     vis_round: jax.Array,  # i32[S, N]
     topo: Topology,
-    xs,  # (writes_slots [E, W], kill [E, ?], revive [E, ?], round_idx [E])
+    xs,  # (writes_slots [E, W], kill [E, ?], revive [E, ?], round_idx [E],
+    #      loss [E, R] | None, probe_loss [E] | None)
     partition: jax.Array,  # bool[E, R, R]
     s_slot: jax.Array,  # i32[S] sample slot this epoch (-1 = cold)
     s_ver: jax.Array,  # u32[S]
@@ -238,10 +239,13 @@ def _epoch_scan(
 
     def body(carry, x):
         st, sw, vr = carry
-        w_slots, part, kl, rv, r = x
+        w_slots, part, kl, rv, r, lo, pl = x
         key = jax.random.fold_in(base_key, r)
         if has_churn:
             k_churn, k_b, k_sw, k_sy, k_rejoin = jax.random.split(key, 5)
+            # Pause-resume churn only: the sparse engine degrades
+            # crash-with-state-wipe (see gossip.revive_sync's semantics
+            # note; simulate_sparse rejects wipe schedules loudly).
             sw = swim_impl.apply_churn(
                 sw, kl, rv, k_churn, cfg.swim.max_transmissions
             )
@@ -251,12 +255,13 @@ def _epoch_scan(
 
         with jax.named_scope("corro_broadcast"):
             data, bstats = gossip_ops.broadcast_round(
-                st.data, topo, alive, part, w_slots, k_b, cfg.gossip
+                st.data, topo, alive, part, w_slots, k_b, cfg.gossip,
+                loss=lo,
             )
         with jax.named_scope("corro_swim"):
             # After churn: revive bumps are rejoins, not flaps.
             inc_pre = sw.incarnation
-            sw = swim_impl.swim_round(sw, k_sw, r, cfg.swim)
+            sw = swim_impl.swim_round(sw, k_sw, r, cfg.swim, probe_loss=pl)
         with jax.named_scope("corro_sync"):
             data, ssta = gossip_ops.sync_round(
                 data, topo, alive, part, r, k_sy, cfg.gossip
@@ -322,6 +327,7 @@ def _epoch_scan(
                 sw.incarnation != inc_pre, dtype=jnp.uint32
             ),
             queue_backlog=gossip_ops.queue_backlog(st.data),
+            chaos_lost_msgs=bstats["lost_msgs"],
             **lat_hist,
         )
         return (st, sw, vr_new), stats
@@ -329,7 +335,7 @@ def _epoch_scan(
     (sstate, swim_state, vis_round), curves = jax.lax.scan(
         body,
         (sstate, swim_state, vis_round),
-        (xs[0], partition, xs[1], xs[2], xs[3]),
+        (xs[0], partition, xs[1], xs[2], xs[3], xs[4], xs[5]),
     )
     return sstate, swim_state, vis_round, curves
 
@@ -391,6 +397,13 @@ def simulate_sparse(
         raise ValueError(
             f"sparse schedule writes must be [rounds, n_nodes], got "
             f"{schedule.writes.shape}"
+        )
+    if schedule.wipe is not None:
+        raise ValueError(
+            "the sparse engine does not support crash-with-state-wipe: a "
+            "total wipe exceeds its bounded deviation tables (see "
+            "gossip.revive_sync). Compile the fault plan with "
+            "allow_wipe=False to degrade wipe to pause-resume churn."
         )
     has_churn = schedule.kill is not None or schedule.revive is not None
     n_regions = int(np.asarray(topo_base.region).max()) + 1
@@ -472,6 +485,14 @@ def simulate_sparse(
             part = jnp.asarray(schedule.partition[e0:e1])
         else:
             part = jnp.zeros((el, n_regions, n_regions), bool)
+        loss_e = (
+            None if schedule.loss is None
+            else jnp.asarray(schedule.loss[e0:e1], jnp.float32)
+        )
+        probe_e = (
+            None if schedule.probe_loss is None
+            else jnp.asarray(schedule.probe_loss[e0:e1], jnp.float32)
+        )
         s_slot = jnp.asarray(
             planner.slot_of[np.asarray(schedule.sample_writer)]
             if n_samples else np.zeros(0, np.int32)
@@ -481,7 +502,7 @@ def simulate_sparse(
         if telemetry is None:
             sstate, swim_state, vis_round, curves = _epoch_scan(
                 sstate, swim_state, vis_round, topo,
-                (writes_slots, kill, revive, ridx), part,
+                (writes_slots, kill, revive, ridx, loss_e, probe_e), part,
                 s_slot, s_ver, s_round, base_key, cfg, sp, has_churn,
             )
         else:
@@ -489,10 +510,12 @@ def simulate_sparse(
             def _run(sstate=sstate, swim_state=swim_state,
                      vis_round=vis_round, topo=topo,
                      writes_slots=writes_slots, kill=kill, revive=revive,
-                     ridx=ridx, part=part, s_slot=s_slot):
+                     ridx=ridx, part=part, s_slot=s_slot,
+                     loss_e=loss_e, probe_e=probe_e):
                 out = _epoch_scan(
                     sstate, swim_state, vis_round, topo,
-                    (writes_slots, kill, revive, ridx), part,
+                    (writes_slots, kill, revive, ridx, loss_e, probe_e),
+                    part,
                     s_slot, s_ver, s_round, base_key, cfg, sp, has_churn,
                 )
                 return out[:3], out[3]

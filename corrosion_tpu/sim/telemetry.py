@@ -56,6 +56,14 @@ import numpy as np
 VIS_LAT_EDGES = (1, 2, 4, 8, 16, 32, 64)
 VIS_LAT_KEYS = tuple(f"vis_lat_b{i}" for i in range(len(VIS_LAT_EDGES) + 1))
 
+# Chaos plane (sim/faults.py): ground-truth fault-injection observables
+# emitted from inside the scan bodies so a flight record carries the
+# adversary's actions next to the protocol's reactions (docs/CHAOS.md).
+CHAOS_CURVE_KEYS = (
+    "chaos_lost_msgs",  # messages dropped by injected/ambient loss
+    "chaos_wiped",  # nodes crash-wiped this round
+)
+
 # Convergence health plane (PR 2): protocol-level observables computed
 # on-device inside every engine's scan body. Published under the
 # ``corro_kernel_health_*`` prefix (see ``series_name``); semantics per
@@ -70,7 +78,7 @@ HEALTH_CURVE_KEYS = (
     "streams_applied",  # (node, stream) pairs fully reassembled, level
     "chunks_sent",  # chunk-plane chunks gossiped this round
     "seqs_granted",  # chunk-plane seqs granted by partial-need sync
-) + VIS_LAT_KEYS
+) + CHAOS_CURVE_KEYS + VIS_LAT_KEYS
 
 # Canonical per-round curve keys. Every engine's scan body emits exactly
 # this set (superset of the former ad-hoc dicts); semantics per key are

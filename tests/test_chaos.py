@@ -1,0 +1,461 @@
+"""Chaos plane tests: declarative fault injection (sim/faults.py),
+post-heal invariant checking (sim/invariants.py), and the seeded
+fuzzer's shrink-to-minimal-repro loop.
+
+Engine-level runs share two shapes on purpose — a 24-node/20-round tiny
+cluster and the invariant suite's standard 48-node scenarios — so the
+module pays a handful of compiles, not one per test.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim import faults as F
+from corrosion_tpu.sim import health as H
+from corrosion_tpu.sim import invariants as I
+from corrosion_tpu.sim import telemetry as T
+from corrosion_tpu.sim.engine import Schedule, simulate
+from corrosion_tpu.sim.faults import Fault, FaultPlan
+
+SUITE_ROUNDS = 48  # one length for every standard-scenario run
+
+
+# ---------------------------------------------------------------------------
+# Plan schema: pure host, no engine.
+
+
+def test_fault_plan_json_roundtrip():
+    plan = FaultPlan(64, (
+        Fault("loss", 8, 24, prob=0.35, regions=(1, 3)),
+        Fault("partition", 10, 30, a=(0,), b=(2,), one_way=True),
+        Fault("flap", 6, 26, a=(1,), period=4),
+        Fault("churn", 9, 10, nodes=(7, 21), revive_at=28, wipe=True),
+        Fault("probe_loss", 8, 24, prob=0.5),
+    ), name="rt")
+    back = FaultPlan.from_json(plan.to_json())
+    assert back == plan
+    assert back.heal_round == 30
+    assert back.heals
+    assert back.wipes() == (7, 21)
+    # Validation bites: bad windows, probs, kinds, wipe on non-churn.
+    with pytest.raises(ValueError):
+        Fault("loss", 10, 10, prob=0.5)
+    with pytest.raises(ValueError):
+        Fault("loss", 0, 5, prob=0.0)
+    with pytest.raises(ValueError):
+        Fault("partition", 0, 5)
+    with pytest.raises(ValueError):
+        Fault("loss", 0, 5, prob=0.3, wipe=True)
+    with pytest.raises(ValueError):
+        FaultPlan(16, (Fault("loss", 8, 24, prob=0.5),))
+
+
+def test_compile_semantics_oneway_flap_loss_churn():
+    plan = FaultPlan(12, (
+        Fault("partition", 2, 6, a=(0,), b=(1,), one_way=True),
+        Fault("flap", 4, 10, a=(2,), b=(3,), period=2),
+        Fault("loss", 3, 7, prob=0.5, regions=(1,)),
+        Fault("loss", 5, 9, prob=0.2),
+        Fault("churn", 4, 5, nodes=(6,), revive_at=8, wipe=True),
+    ))
+    c = plan.compile(n_nodes=10, n_regions=4)
+    # One-way: region 1 can't hear region 0; the reverse stays open.
+    assert c.partition[3, 1, 0] and not c.partition[3, 0, 1]
+    assert not c.partition[1, 1, 0] and not c.partition[6, 1, 0]
+    # Flap duty cycle: on for [4,6), off [6,8), on [8,10) — symmetric.
+    assert c.partition[4, 3, 2] and c.partition[4, 2, 3]
+    assert not c.partition[6, 3, 2]
+    assert c.partition[8, 3, 2]
+    # Loss: component max per (round, region); scalar view is row max.
+    assert c.loss[4, 1] == np.float32(0.5)
+    assert c.loss[6, 1] == np.float32(0.5)  # max(0.5, 0.2)
+    assert c.loss[8, 1] == np.float32(0.2)
+    assert c.loss[4, 0] == 0.0
+    assert c.loss_scalar[4] == np.float32(0.5)
+    # Churn + wipe masks and the liveness fold.
+    assert c.kill[4, 6] and c.wipe[4, 6] and c.revive[8, 6]
+    alive = c.alive_curve(10)
+    assert not alive[4:8, 6].any() and alive[8, 6] and alive[3, 6]
+    assert alive[:, 0].all()
+    # Degrading wipe (sparse engine) drops only the wipe axis.
+    assert plan.compile(10, 4, allow_wipe=False).wipe is None
+
+
+def test_shrink_plan_greedy_drop_and_bisect():
+    plan = FaultPlan(64, (
+        Fault("loss", 4, 20, prob=0.3),
+        Fault("partition", 8, 40, a=(0,)),
+        Fault("probe_loss", 4, 20, prob=0.5),
+    ))
+
+    # Synthetic oracle: fails iff some partition component with side A
+    # region 0 covers round 30.
+    def still_fails(p):
+        return any(
+            f.kind == "partition" and 0 in f.a and f.start <= 30 < f.stop
+            for f in p.faults
+        )
+
+    mini, evals = F.shrink_plan(plan, still_fails, max_evals=32)
+    assert len(mini.faults) == 1
+    (f,) = mini.faults
+    assert f.kind == "partition" and f.start <= 30 < f.stop
+    assert f.stop - f.start < 32  # bisection narrowed the window
+    assert evals <= 32
+
+
+def test_recovery_after_heal_helper():
+    curves = {
+        "need": np.asarray([5, 5, 3, 0, 0, 0]),
+        "staleness_sum": np.asarray([2, 2, 0, 1, 0, 0]),
+        "swim_undetected_deaths": np.asarray([0, 1, 1, 0, 0, 0]),
+        "mismatches": np.asarray([0, 0, 0, 0, 9, 9]),
+    }
+    rec = H.recovery_after_heal(curves, heal_round=2, round_ms=500.0)
+    assert rec["recovered_round"] == 4 and rec["recovery_rounds"] == 2
+    assert rec["recovery_s"] == 1.0
+    # Sticky mismatches only gate with require_membership.
+    rec = H.recovery_after_heal(curves, 2, require_membership=True)
+    assert rec["recovered_round"] is None
+    # Never-quiet record.
+    rec = H.recovery_after_heal({"need": np.asarray([1, 1])}, 0)
+    assert rec["recovery_rounds"] is None
+
+
+# ---------------------------------------------------------------------------
+# Tiny engine runs (24 nodes, 2 regions, 20 rounds — one shared shape).
+
+
+def _tiny(rounds=20, n_cells=16):
+    from corrosion_tpu.models.baselines import _cfg
+
+    cfg, topo = _cfg(
+        24, writers=[0, 12], regions=[12, 12], sync_interval=4,
+        sync_budget=256, sync_chunk=64, n_cells=n_cells,
+    )
+    writes = np.zeros((rounds, 2), np.uint32)
+    writes[:10] = 1
+    sched = Schedule(writes=writes).make_samples(8)
+    return cfg, topo, sched
+
+
+def _densified(plan, n=24, r=2):
+    return I._densify(plan.compile(n, r), n, r)
+
+
+def test_fault_free_plan_is_bit_identical():
+    """The chaos plane's zero-cost contract: an EMPTY plan threads no
+    fault axes and the run is bit-identical to one without a plan."""
+    import jax
+
+    cfg, topo, sched = _tiny()
+    plain_final, plain_curves = simulate(cfg, topo, sched, seed=5)
+    merged = F.apply_plan(sched, FaultPlan(20), n_nodes=24, n_regions=2)
+    assert merged.loss is None and merged.wipe is None
+    fp_final, fp_curves = simulate(cfg, topo, merged, seed=5)
+    for a, b in zip(jax.tree.leaves(plain_final), jax.tree.leaves(fp_final)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in T.ROUND_CURVE_KEYS:
+        np.testing.assert_array_equal(plain_curves[k], fp_curves[k], err_msg=k)
+    assert fp_curves["chaos_lost_msgs"].sum() == 0
+
+
+def test_one_way_partition_is_asymmetric():
+    """A one-way cut a->b starves b of a's writes while a keeps
+    receiving b's — the failure mode a symmetric mask cannot model."""
+    cfg, topo, sched = _tiny()
+    plan = FaultPlan(20, (
+        Fault("partition", 2, 20, a=(0,), b=(1,), one_way=True),
+    ))
+    merged = F.apply_plan(sched, _densified(plan), n_nodes=24, n_regions=2)
+    final, curves = simulate(cfg, topo, merged, seed=5)
+    head = np.asarray(final.data.head)
+    contig = np.asarray(final.data.contig)
+    assert head[0] == 10 and head[1] == 10
+    # Region 1 (nodes 12..23) never hears region 0's writer again...
+    assert (contig[12:, 0] < head[0]).all()
+    # ...while region 0 still converges on region 1's writer.
+    assert (contig[:12, 1] == head[1]).all()
+
+
+def test_loss_burst_drops_messages_only_in_window():
+    cfg, topo, sched = _tiny()
+    plan = FaultPlan(20, (Fault("loss", 4, 9, prob=0.6),))
+    merged = F.apply_plan(sched, _densified(plan), n_nodes=24, n_regions=2)
+    final, curves = simulate(cfg, topo, merged, seed=5)
+    lost = np.asarray(curves["chaos_lost_msgs"])
+    assert lost[4:9].sum() > 0
+    assert lost[:4].sum() == 0 and lost[9:].sum() == 0
+    # Loss delays but must not prevent convergence (sync heals).
+    assert np.asarray(curves["need"])[-1] == 0
+
+
+def test_probe_loss_hits_membership_not_data():
+    cfg, topo, sched = _tiny()
+    plan = FaultPlan(20, (Fault("probe_loss", 2, 12, prob=0.7),))
+    merged = F.apply_plan(sched, _densified(plan), n_nodes=24, n_regions=2)
+    final, curves = simulate(cfg, topo, merged, seed=5)
+    assert curves["chaos_lost_msgs"].sum() == 0, "data plane untouched"
+    assert curves["swim_false_alarms"].max() > 0, (
+        "a probe/ack storm must raise false suspicions"
+    )
+    assert np.asarray(curves["need"])[-1] == 0
+
+
+def test_wipe_vs_pause_kill_semantics():
+    """Satellite: wipe-on-kill resets replica state (watermarks, queue,
+    cells); the default pause-resume kill retains it."""
+    cfg, topo, sched = _tiny()
+    plan = FaultPlan(20, (
+        Fault("churn", 12, 13, nodes=(5,), revive_at=None, wipe=True),
+        Fault("churn", 12, 13, nodes=(7,), revive_at=None, wipe=False),
+    ))
+    merged = F.apply_plan(sched, _densified(plan), n_nodes=24, n_regions=2)
+    final, curves = simulate(cfg, topo, merged, seed=5)
+    contig = np.asarray(final.data.contig)
+    # The wiped node restarted empty and, dead, never recovered anything.
+    assert (contig[5] == 0).all()
+    assert (np.asarray(final.data.q_writer)[5] == -1).all()
+    cells_cl = np.asarray(final.data.cells.cl).reshape(24, -1)
+    assert (cells_cl[5] == 0).all()
+    # The paused node kept the replica state it died with.
+    assert contig[7].sum() > 0
+    assert cells_cl[7].sum() > 0
+    assert int(curves["chaos_wiped"].sum()) == 1
+
+
+def test_swim_wipe_units_dense_and_sparse():
+    """apply_churn(wipe=...) clears the wiped node's beliefs/queues but
+    keeps its incarnation monotonic, in both membership kernels."""
+    import jax.numpy as jnp
+
+    from corrosion_tpu.ops import swim, swim_sparse
+
+    n = 8
+    cfg = swim.SwimConfig(n_nodes=n)
+    st = swim.init_state(cfg)
+    st = st._replace(
+        view=st.view.at[3, 1].set(swim.pack(jnp.uint32(2), swim.SEV_DOWN)),
+        incarnation=st.incarnation.at[3].set(4),
+        upd_target=st.upd_target.at[3, 0].set(1),
+    )
+    wipe = jnp.zeros(n, bool).at[3].set(True)
+    out = swim.apply_churn(
+        st, wipe, jnp.zeros(n, bool), wipe=wipe
+    )
+    assert int(np.asarray(out.view)[3].sum()) == 0
+    assert (np.asarray(out.upd_target)[3] == -1).all()
+    assert int(np.asarray(out.incarnation)[3]) == 4  # kept, not reset
+    assert not bool(np.asarray(out.alive)[3])
+
+    scfg = swim.SwimConfig(n_nodes=n, view_capacity=4)
+    ss = swim_sparse.init_state(scfg)
+    ss = ss._replace(
+        exc_tgt=ss.exc_tgt.at[3, 0].set(1),
+        exc_pkd=ss.exc_pkd.at[3, 0].set(9),
+        incarnation=ss.incarnation.at[3].set(2),
+    )
+    out = swim_sparse.apply_churn(
+        ss, wipe, jnp.zeros(n, bool), wipe=wipe
+    )
+    assert (np.asarray(out.exc_tgt)[3] == -1).all()
+    assert int(np.asarray(out.incarnation)[3]) == 2
+
+
+def test_determinism_identical_flight_records(tmp_path):
+    """Satellite: identical seed + identical FaultPlan => identical
+    flight records across two runs. Every protocol datum matches; only
+    the wall-clock fields (header t_unix, chunk wall_s) may differ —
+    this is what makes the fuzzer's JSON repros replayable."""
+    cfg, topo, sched = _tiny()
+    plan = FaultPlan(20, (
+        Fault("loss", 3, 9, prob=0.4),
+        Fault("churn", 5, 6, nodes=(9,), revive_at=12, wipe=True),
+    ))
+    merged = F.apply_plan(sched, _densified(plan), n_nodes=24, n_regions=2)
+
+    def fly(path):
+        tele = T.KernelTelemetry(
+            engine="dense",
+            recorder=T.FlightRecorder(path, engine="dense", mode="w"),
+        )
+        simulate(cfg, topo, merged, seed=7, telemetry=tele)
+        tele.recorder.close()
+        out = []
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                rec.pop("t_unix", None)
+                rec.pop("wall_s", None)
+                out.append(rec)
+        return out
+
+    a = fly(str(tmp_path / "a.jsonl"))
+    b = fly(str(tmp_path / "b.jsonl"))
+    assert a == b
+    assert sum(1 for r in a if r["kind"] == "round") == 20
+
+
+# ---------------------------------------------------------------------------
+# Invariant suite on the standard scenarios (48 rounds shared).
+
+
+def test_invariant_suite_dense_crash_wipe_recovers():
+    plans = F.named_scenarios(
+        SUITE_ROUNDS, I.STD_REGIONS, I.STD_NODES, protect=I.PROTECTED
+    )
+    rep = I.run_dense(plans["crash-wipe"], seed=0)
+    assert rep.ok, rep.violations
+    assert rep.recovery["recovery_rounds"] is not None
+    assert rep.facts["chaos_wiped"] > 0
+
+
+def test_partition_heal_sparse_engine():
+    """Satellite: partition-heal convergence on the SPARSE engine is
+    checked against the sparse serial-merge reference (previously only
+    the dense plane was verified after a heal)."""
+    plans = F.named_scenarios(
+        SUITE_ROUNDS, I.STD_REGIONS, I.STD_NODES, protect=I.PROTECTED
+    )
+    rep = I.run_sparse(plans["partition-heal"], seed=0)
+    assert rep.ok, rep.violations
+    assert rep.recovery["recovery_rounds"] is not None
+
+
+def test_partition_heal_mixed_engine():
+    """Satellite: partition-heal convergence on the MIXED engine —
+    watermarks, CRDT cells (big versions included), and stream
+    reassembly all verified after the cut clears."""
+    plans = F.named_scenarios(
+        SUITE_ROUNDS, I.STD_REGIONS, I.STD_NODES, protect=I.PROTECTED
+    )
+    rep = I.run_mixed(plans["partition-heal"], seed=0)
+    assert rep.ok, rep.violations
+
+
+def test_chunk_engine_loss_and_wipe_recovers():
+    plans = F.named_scenarios(
+        SUITE_ROUNDS, I.STD_REGIONS, I.STD_NODES, protect=I.PROTECTED
+    )
+    rep = I.run_chunks(plans["crash-wipe"], seed=0)
+    assert rep.ok, rep.violations
+    rep = I.run_chunks(plans["loss-burst"], seed=0)
+    assert rep.ok, rep.violations
+    assert rep.facts["chaos_lost_msgs"] > 0
+
+
+def test_broken_plan_fails_and_shrinks_to_repro(tmp_path):
+    """Acceptance: a deliberately non-healing plan fails the invariant
+    suite, shrinks to a minimal JSON repro artifact, and the artifact
+    replays to the same failure."""
+    out = I.fuzz(
+        seed=1, plans=1, engines=("dense",), rounds=SUITE_ROUNDS,
+        out_dir=str(tmp_path), break_heal=True, shrink_evals=6,
+    )
+    assert out["failures"] == 1
+    assert len(out["repros"]) == 1
+    path = out["repros"][0]
+    with open(path) as f:
+        repro = json.load(f)
+    assert repro["schema"] == I.REPRO_SCHEMA
+    mini = FaultPlan.from_dict(repro["plan"])
+    orig = FaultPlan.from_dict(repro["original_plan"])
+    assert len(mini.faults) <= len(orig.faults)
+    assert repro["violations"], "the minimal plan still states violations"
+    # Round-trip: the artifact reproduces the failure.
+    rep = I.replay_repro(path)
+    assert not rep.ok
+
+
+def test_chaos_cli_run_and_fuzz(tmp_path, capsys):
+    from corrosion_tpu import cli
+
+    assert cli.main(["chaos", "list"]) == 0
+    text = capsys.readouterr().out
+    assert "partition-heal" in text and "crash-wipe" in text
+
+    # Named scenario on one engine (shares the suite's jit cache).
+    rc = cli.main([
+        "chaos", "run", "partition-heal", "--engines", "dense",
+        "--rounds", str(SUITE_ROUNDS),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[dense] OK" in out
+
+    # Broken fuzz: exit 1 + artifact (same shapes as the test above).
+    rc = cli.main([
+        "chaos", "fuzz", "--seed", "1", "--plans", "1", "--engines",
+        "dense", "--rounds", str(SUITE_ROUNDS), "--broken",
+        "--out", str(tmp_path), "--shrink-evals", "4",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "shrunk repro" in out
+    repros = list(tmp_path.glob("chaos_repro_*.json"))
+    assert len(repros) == 1
+    # The artifact replays through the CLI too.
+    assert cli.main(["chaos", "replay", str(repros[0])]) == 1
+
+
+def test_schedule_checkpoint_roundtrips_fault_axes(tmp_path):
+    """save_schedule/load_schedule must persist the chaos axes — a
+    resumed run replays its fault plan, not a defanged one."""
+    from corrosion_tpu.sim import checkpoint
+
+    cfg, topo, sched = _tiny()
+    plan = FaultPlan(20, (
+        Fault("loss", 3, 9, prob=0.4, regions=(1,)),
+        Fault("probe_loss", 2, 8, prob=0.5),
+        Fault("churn", 5, 6, nodes=(9,), revive_at=12, wipe=True),
+    ))
+    merged = F.apply_plan(
+        sched, plan.compile(24, 2), n_nodes=24, n_regions=2
+    )
+    path = str(tmp_path / "sched.npz")
+    checkpoint.save_schedule(path, merged)
+    back = checkpoint.load_schedule(path)
+    for name in ("writes", "kill", "revive", "loss", "probe_loss", "wipe"):
+        np.testing.assert_array_equal(
+            getattr(back, name), getattr(merged, name), err_msg=name
+        )
+    # Fault-free schedules still round-trip with absent axes.
+    checkpoint.save_schedule(path, sched)
+    assert checkpoint.load_schedule(path).loss is None
+
+
+def test_chaos_cli_usage_errors_exit_2(tmp_path, capsys):
+    from corrosion_tpu import cli
+
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"not_a_plan": true}')
+    assert cli.main(["chaos", "run", str(bad), "--engines", "dense"]) == 2
+    capsys.readouterr()
+    assert cli.main(["chaos", "list", "--rounds", "20"]) == 2
+    capsys.readouterr()
+    # A plan addressing regions past the standard scenario's shape is a
+    # usage error, not a traceback.
+    oob = tmp_path / "oob.json"
+    oob.write_text(FaultPlan(48, (
+        Fault("loss", 2, 8, prob=0.3, regions=(7,)),
+    )).to_json())
+    assert cli.main(["chaos", "run", str(oob), "--engines", "dense"]) == 2
+    capsys.readouterr()
+    assert cli.main(["chaos", "replay", str(bad)]) == 2
+
+
+def test_sparse_engine_rejects_wipe_loudly():
+    from corrosion_tpu.sim.sparse_engine import simulate_sparse
+
+    cfg, topo, sched = I._sparse_scenario(FaultPlan(16), seed=0)
+    plan = FaultPlan(16, (
+        Fault("churn", 2, 3, nodes=(40,), revive_at=8, wipe=True),
+    ))
+    bad = F.apply_plan(
+        sched, plan.compile(I.STD_NODES, I.STD_REGIONS),
+        I.STD_NODES, I.STD_REGIONS,
+    )
+    with pytest.raises(ValueError, match="wipe"):
+        simulate_sparse(cfg, topo, bad, seed=0)
