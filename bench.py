@@ -38,7 +38,6 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 
@@ -54,8 +53,6 @@ def main() -> None:
     on_accel = platform not in ("cpu",)
     from corrosion_tpu import models
     from corrosion_tpu.ops import gossip as gossip_ops
-    from corrosion_tpu.ops import swim as swim_ops
-    from corrosion_tpu.sim import engine as sim_engine
     from corrosion_tpu.sim import simulate, telemetry, visibility_latencies
     from corrosion_tpu.utils.metrics import MetricsRegistry
 
@@ -133,53 +130,16 @@ def main() -> None:
     # state), so only its FRACTIONS are used — scaled onto the measured
     # step_ms, keeping sum(plane_ms) + residual_ms == step_ms exact.
     # (Isolated plane timings under-counted in-context costs by ~35%;
-    # ablation timings over-counted overlap by ~20%.)
-    # NOTE: the big arrays ride the CARRY, never closures — a closed-over
-    # DataState would be embedded as compile-payload constants (hundreds
-    # of MB at 10k; the axon compile tunnel rejects it outright).
-    data = final.data
-    swim_impl = swim_ops.impl(cfg.swim)
-    n_regions = int(np.asarray(topo.region).max()) + 1
-    part = jnp.zeros((n_regions, n_regions), bool)
-    writes = jnp.asarray(sched.writes[0], jnp.uint32)
-    key = jax.random.PRNGKey(0)
-    s_writer = jnp.asarray(sched.sample_writer)
-    s_ver = jnp.asarray(sched.sample_ver)
-    s_round = jnp.asarray(sched.sample_round)
-    stages = ("broadcast", "swim", "sync", "track")  # execution order
+    # ablation timings over-counted overlap by ~20%.) The composite
+    # builder is shared with the CI bench-smoke gate (sim/benchlib.py)
+    # so the headline bench and the regression gate measure identically.
+    from corrosion_tpu.sim import benchlib
 
-    def composite(enabled):
-        def step(carry, i):
-            d, sw, vr = carry
-            k = jax.random.fold_in(key, i)
-            k_b, k_sw, k_sy = jax.random.split(k, 3)
-            if "broadcast" in enabled:
-                d, _ = gossip_ops.broadcast_round(
-                    d, topo, sw.alive, part, writes, k_b, cfg.gossip
-                )
-            if "swim" in enabled:
-                sw = swim_impl.swim_round(sw, k_sw, i, cfg.swim)
-            if "sync" in enabled:
-                d, _ = gossip_ops.sync_round(
-                    d, topo, sw.alive, part, i, k_sy, cfg.gossip
-                )
-            if "track" in enabled:
-                vis_now = gossip_ops.visibility(d, s_writer, s_ver)
-                active = i >= s_round
-                vr = jnp.where(
-                    (vr < 0) & vis_now & active[:, None], i, vr
-                )
-                need = gossip_ops.total_need(d)
-                vr = vr + (need * jnp.uint32(0)).astype(vr.dtype)
-            return d, sw, vr
-
-        return step
-
-    carry0 = (data, final.swim, final.vis_round)
+    composite, stages, carry0 = benchlib.plane_composite(
+        cfg, topo, sched, final
+    )
     attr = telemetry.attribute_planes(composite, stages, carry0)
     plane, residual_ms = attr.scale(step_ms)
-    swim_ms, bcast_ms = plane["swim"], plane["broadcast"]
-    sync_ms, track_ms = plane["sync"], plane["track"]
 
     state_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(final.data)
@@ -263,54 +223,51 @@ def main() -> None:
         print(f"[bench] 100k: {json.dumps(extra_100k)}", file=sys.stderr)
 
     p99 = lat["p99_s"]
-    print(
-        json.dumps(
-            {
-                "metric": "p99_change_visibility_10k",
-                "value": round(p99, 2),
-                "unit": "s",
-                # North-star target is p99 < 10 s (BASELINE.md); ratio > 1
-                # beats it. The reference publishes no comparable number —
-                # its only throughput figure is a 2-node log excerpt.
-                "vs_baseline": round(10.0 / p99, 2) if p99 > 0 else None,
-                "converged": converged,
-                "cells_converged": cells_ok,
-                "unseen_pairs": lat["unseen"],
-                "p50_s": round(lat["p50_s"], 2),
-                "throughput_changes_per_s": round(applied / wall, 1),
-                "step_ms": round(step_ms, 1),
-                # Device chunk executions only (telemetry chunk timer) —
-                # a subset of step_ms's wall, so <= step_ms always.
-                "step_inner_ms": round(step_inner_ms, 1),
-                # step_ms attributed by measured stage fractions;
-                # sum(plane_ms) + residual_ms == step_ms (residual =
-                # scan overhead + host dispatch + fusion slack, kept
-                # visible so regressions can't hide in unattributed time).
-                "plane_ms": {
-                    "swim": round(swim_ms, 1),
-                    "broadcast": round(bcast_ms, 1),
-                    "sync": round(sync_ms, 1),
-                    "track": round(track_ms, 1),
-                },
-                "residual_ms": round(
-                    round(step_ms, 1) - round(swim_ms, 1) - round(bcast_ms, 1)
-                    - round(sync_ms, 1) - round(track_ms, 1), 1
-                ),
-                # Convergence health plane (derived from the flight
-                # curves alone; bucket-edge seconds, so >= the exact
-                # percentiles above by construction).
-                "converged_round": rep.converged_round,
-                "staleness_p99": round(rep.staleness_p99, 1),
-                "staleness_peak_node": rep.staleness_max_peak,
-                # Through the report's JSON-safe serializer: overflow
-                # percentiles become "inf", never a bare Infinity token.
-                "vis_hist_p50_s": rep.to_dict()["vis_p50_s"],
-                "vis_hist_p99_s": rep.to_dict()["vis_p99_s"],
-                "queue_backlog_peak": rep.queue_backlog_peak,
-                **extra_100k,
-            }
-        )
-    )
+    report = {
+        "metric": "p99_change_visibility_10k",
+        "value": round(p99, 2),
+        "unit": "s",
+        # North-star target is p99 < 10 s (BASELINE.md); ratio > 1
+        # beats it. The reference publishes no comparable number —
+        # its only throughput figure is a 2-node log excerpt.
+        "vs_baseline": round(10.0 / p99, 2) if p99 > 0 else None,
+        "converged": converged,
+        "cells_converged": cells_ok,
+        "unseen_pairs": lat["unseen"],
+        "p50_s": round(lat["p50_s"], 2),
+        "throughput_changes_per_s": round(applied / wall, 1),
+        # Shared emit-site rounding (benchlib.rounded_step_report):
+        # step_ms, plane_ms (step_ms attributed by measured stage
+        # fractions), and a residual derived from the ROUNDED values so
+        # sum(plane_ms) + residual_ms == step_ms holds exactly on the
+        # published numbers (residual = scan overhead + host dispatch +
+        # fusion slack, kept visible so regressions can't hide in
+        # unattributed time). One implementation shared with the CI
+        # bench-smoke gate.
+        **benchlib.rounded_step_report(step_ms, plane),
+        # Device chunk executions only (telemetry chunk timer) —
+        # a subset of step_ms's wall, so <= step_ms always.
+        "step_inner_ms": round(step_inner_ms, 1),
+        # Convergence health plane (derived from the flight
+        # curves alone; bucket-edge seconds, so >= the exact
+        # percentiles above by construction).
+        "converged_round": rep.converged_round,
+        "staleness_p99": round(rep.staleness_p99, 1),
+        "staleness_peak_node": rep.staleness_max_peak,
+        # Through the report's JSON-safe serializer: overflow
+        # percentiles become "inf", never a bare Infinity token.
+        "vis_hist_p50_s": rep.to_dict()["vis_p50_s"],
+        "vis_hist_p99_s": rep.to_dict()["vis_p99_s"],
+        "queue_backlog_peak": rep.queue_backlog_peak,
+        **extra_100k,
+    }
+    # Every reporting path funnels through the ONE emit site, and the
+    # emitted dict itself — not intermediate variables — is what the
+    # invariant check sees, so no path can bypass the normalization
+    # again (the BENCH_r05 anomaly: a stale reporting path published the
+    # raw composite microbench as step_inner_ms, violating both
+    # documented invariants).
+    print(json.dumps(telemetry.check_bench_invariants(report)))
 
 
 if __name__ == "__main__":

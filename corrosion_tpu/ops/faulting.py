@@ -41,7 +41,14 @@ def apply_loss(
     probability is zero AND no dynamic schedule is threaded
     (``dyn_prob is None`` — a trace-time property), the mask passes
     through untouched and no randoms are sampled, so fault-free traces
-    are bit-identical to the pre-chaos kernels.
+    are bit-identical to the pre-chaos kernels. This promise was
+    re-verified by measurement when the r04→r05 bench regression was
+    bisected: the fault-axis threading added ZERO fault-free step time
+    (the regression was the bench's platform fallback, not this plane —
+    docs/PERFORMANCE.md "The r04→r05 anomaly, dissected"). It also
+    composes with buffer donation: the skip returns ``ok`` unchanged, an
+    alias into a possibly-donated pytree, which is safe because donation
+    binds at the jitted entry point, never mid-trace.
     """
     if static_prob <= 0.0 and dyn_prob is None:
         return ok, jnp.uint32(0)

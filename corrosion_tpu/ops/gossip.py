@@ -385,6 +385,19 @@ _FAST_MAX_WRITERS = 2048
 # Writer-axis width at which the sync grant enumeration switches to the
 # two-level block decomposition (same test-override convention).
 _BLOCK_ENUM_MIN_WRITERS = 2048
+# Anti-entropy candidate pipeline form. True (default) scores all C
+# candidates and pulls all S+1 selected peers with single tiled
+# [R, C, W] / [R, S+1, W] gathers + reductions; False keeps the original
+# per-candidate Python loop (C sequential [R, W] gathers that bloat the
+# trace and serialize on device) as the bit-identical reference —
+# selection and post-sync state are pinned equal in
+# tests/test_perf_plane.py. Flip BEFORE tracing (clear_cache() on
+# sync_round, the convention test_data_plane_crdt already uses).
+_BATCHED_SYNC = True
+# Row×writer×candidate volume above which candidate scoring falls back
+# from the exact per-writer deficit to the total-progress digest
+# (module-level so tests can force digest mode at small sizes).
+_EXACT_SCORE_MAX = 1 << 25
 
 
 def _merge_versions_dense(
@@ -446,8 +459,7 @@ def _merge_versions_dense(
     return out, n_merges
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def broadcast_round(
+def _broadcast_round(
     data: DataState,
     topo: Topology,
     alive: jax.Array,
@@ -1026,8 +1038,24 @@ def broadcast_round(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def sync_round(
+# Public entry points. The ``_donated`` twins alias the DataState argument
+# into the output (donate_argnums) so XLA reuses the round-trip state
+# buffers in place — ~10 MiB/round at 512 nodes, two orders more at the
+# 100k configs — instead of allocating a fresh copy. Donation only takes
+# effect on TOP-LEVEL calls (inside a jitted scan body the call inlines
+# and the outer entry point's donation governs); after a donated call the
+# caller's input DataState is dead and must not be read again, which is
+# why the plain entry stays the default for tests and ad-hoc stepping.
+# docs/PERFORMANCE.md ("Donation invariants") has the contract.
+broadcast_round = partial(jax.jit, static_argnames=("cfg",))(
+    _broadcast_round
+)
+broadcast_round_donated = partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(0,)
+)(_broadcast_round)
+
+
+def _sync_round(
     data: DataState,
     topo: Topology,
     alive: jax.Array,
@@ -1067,6 +1095,12 @@ def sync_round(
         (round_idx + topo.sync_phase) % jnp.int32(cfg.sync_interval) == 0
     )
     return _sync_rows(data, topo, alive, partition, nodes, due, rng, cfg)
+
+
+sync_round = partial(jax.jit, static_argnames=("cfg",))(_sync_round)
+sync_round_donated = partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(0,)
+)(_sync_round)
 
 
 def _sync_rows(
@@ -1111,52 +1145,90 @@ def _sync_rows(
     )
 
     # Candidate need scoring. Exact mode computes, per candidate, the count
-    # of versions the candidate holds that we lack — an [R, W] transient per
-    # candidate — while very large row counts fall back to a total-progress
-    # digest (ranking peers by advertised heads). Selection is heuristic
-    # either way; the grant loop below recomputes the exact deficit for the
-    # chosen peers. Cohorts keep R = N / sync_interval, so even the 100k
-    # config scores exactly.
+    # of versions the candidate holds that we lack, while very large
+    # row counts fall back to a total-progress digest (ranking peers by
+    # advertised heads). Selection is heuristic either way; the grant
+    # pass below recomputes the exact deficit for the chosen peers.
+    # Cohorts keep R = N / sync_interval, so even the 100k config scores
+    # exactly. The batched form (default) issues ONE tiled [R, C, W]
+    # gather + reduction; the looped form is the bit-identical reference
+    # (max/sum over candidates commute, so the two orders agree exactly).
     c_count = cfg.sync_candidates
-    exact = r * cfg.n_writers * c_count <= (1 << 25)
-    need_cols = []
+    exact = r * cfg.n_writers * c_count <= _EXACT_SCORE_MAX
     total = None
     if not exact:
         total = jnp.sum(data.contig, axis=1, dtype=jnp.uint32)
         total_r = total[rows]
-    for c in range(c_count):
+    if _BATCHED_SYNC:
         if exact:
-            cc = data.contig[cand[:, c]]  # [R, W]
-            need_cols.append(
-                jnp.sum(
-                    (cc - jnp.minimum(cc, contig0)).astype(jnp.uint32),
-                    axis=-1,
-                    dtype=jnp.int32,
-                )
-            )
-            # Scoring reads the candidate's state — that digest also carries
-            # its heads, so adopt them (the reference learns heads from every
-            # SyncState exchange, not only from peers it pulls from).
+            cc = data.contig[cand]  # u32[R, C, W] one tiled gather
+            defc = jnp.sum(
+                (cc - jnp.minimum(cc, contig0[:, None, :])).astype(
+                    jnp.uint32
+                ),
+                axis=-1,
+                dtype=jnp.int32,
+            )  # i32[R, C]
+            # Scoring reads the candidate's state — that digest also
+            # carries its heads, so adopt them (the reference learns heads
+            # from every SyncState exchange, not only from pulled peers).
             seen_r = jnp.maximum(
-                seen_r, jnp.where(ok_c[:, c, None], data.seen[cand[:, c]], 0)
+                seen_r,
+                jnp.max(
+                    jnp.where(ok_c[:, :, None], data.seen[cand], 0), axis=1
+                ),
             )
         else:
-            tc = total[cand[:, c]]
-            need_cols.append(
-                jnp.maximum(tc - jnp.minimum(tc, total_r), 0).astype(jnp.int32)
-            )
-    defc = jnp.stack(need_cols, axis=1)  # i32[R, C]
+            tc = total[cand]  # u32[R, C]
+            defc = jnp.maximum(
+                tc - jnp.minimum(tc, total_r[:, None]), 0
+            ).astype(jnp.int32)
+    else:
+        need_cols = []
+        for c in range(c_count):
+            if exact:
+                cc = data.contig[cand[:, c]]  # [R, W]
+                need_cols.append(
+                    jnp.sum(
+                        (cc - jnp.minimum(cc, contig0)).astype(jnp.uint32),
+                        axis=-1,
+                        dtype=jnp.int32,
+                    )
+                )
+                seen_r = jnp.maximum(
+                    seen_r,
+                    jnp.where(ok_c[:, c, None], data.seen[cand[:, c]], 0),
+                )
+            else:
+                tc = total[cand[:, c]]
+                need_cols.append(
+                    jnp.maximum(tc - jnp.minimum(tc, total_r), 0).astype(
+                        jnp.int32
+                    )
+                )
+        defc = jnp.stack(need_cols, axis=1)  # i32[R, C]
 
     # RTT ring of each candidate (members.rs:33 buckets via region pairs).
     ring = topo.region_rtt[region_r[:, None], topo.region[cand]]
     # Candidates are sampled with replacement; mask duplicate columns so a
     # single peer cannot occupy several of the top slots (and soak up
-    # sync_peers x chunk from one source).
-    dup = jnp.zeros_like(ok_c)
-    for i in range(1, c_count):
-        dup = dup.at[:, i].set(
-            jnp.any(cand[:, :i] == cand[:, i : i + 1], axis=1)
+    # sync_peers x chunk from one source). dup[r, i] = any earlier column
+    # j < i holding the same peer — one [R, C, C] compare instead of C
+    # unrolled scatter updates.
+    if _BATCHED_SYNC:
+        tri = (
+            jnp.arange(c_count)[None, :] < jnp.arange(c_count)[:, None]
+        )  # tri[i, j] = j strictly before i
+        dup = jnp.any(
+            (cand[:, :, None] == cand[:, None, :]) & tri[None, :, :],
+            axis=2,
         )
+    else:
+        dup = jnp.zeros_like(ok_c)
+        for i in range(1, c_count):
+            dup = dup.at[:, i].set(
+                jnp.any(cand[:, :i] == cand[:, i : i + 1], axis=1)
+            )
     # need desc, ring asc (agent.rs:2383-2423): scale need so the ring
     # ordering only breaks need ties.
     score = jnp.where(ok_c & ~dup & (defc > 0), defc * 8 + (5 - ring), -1)
@@ -1179,8 +1251,6 @@ def _sync_rows(
         & (origin != rows)
         & (part_i[region_r, topo.region[origin]] == 0)
     )
-    pulls = [(sel[:, s], sel_ok[:, s]) for s in range(cfg.sync_peers)]
-    pulls.append((origin, origin_ok))
     # Union pull: the session pulls from the UNION of what its chosen
     # peers hold — one elementwise max over the peers' watermark rows,
     # then a single budgeted grant pass, instead of a deficit + cumsum
@@ -1189,16 +1259,38 @@ def _sync_rows(
     # Versions teleport within a round in this model, so which peer a
     # granted version "came from" is unobservable; the only semantic
     # shift is that sync_chunk caps a writer's grant once per session
-    # rather than once per peer.
-    avail = contig0
-    for p, ok_s in pulls:
+    # rather than once per peer. Batched (default): ONE [R, S+1, W]
+    # gather + max-reduce over the peer axis; looped: the per-peer
+    # reference (elementwise max commutes, so both orders agree exactly).
+    if _BATCHED_SYNC:
+        peers = jnp.concatenate([sel, origin[:, None]], axis=1)
+        ok_p = jnp.concatenate([sel_ok, origin_ok[:, None]], axis=1)
         avail = jnp.maximum(
-            avail, jnp.where(ok_s[:, None], data.contig[p], 0)
+            contig0,
+            jnp.max(
+                jnp.where(ok_p[:, :, None], data.contig[peers], 0), axis=1
+            ),
         )
         if not exact:
             seen_r = jnp.maximum(
-                seen_r, jnp.where(ok_s[:, None], data.seen[p], 0)
+                seen_r,
+                jnp.max(
+                    jnp.where(ok_p[:, :, None], data.seen[peers], 0),
+                    axis=1,
+                ),
             )
+    else:
+        pulls = [(sel[:, s], sel_ok[:, s]) for s in range(cfg.sync_peers)]
+        pulls.append((origin, origin_ok))
+        avail = contig0
+        for p, ok_s in pulls:
+            avail = jnp.maximum(
+                avail, jnp.where(ok_s[:, None], data.contig[p], 0)
+            )
+            if not exact:
+                seen_r = jnp.maximum(
+                    seen_r, jnp.where(ok_s[:, None], data.seen[p], 0)
+                )
     deficit = (avail - jnp.minimum(avail, contig0)).astype(jnp.uint32)
     per_w = jnp.minimum(deficit, jnp.uint32(cfg.sync_chunk)).astype(
         jnp.int32
@@ -1285,16 +1377,23 @@ def _sync_rows(
             w_count_ = cfg.n_writers
             if w_count_ < _BLOCK_ENUM_MIN_WRITERS:
                 # Writer owning granted unit e: the count of inclusive
-                # span ends at or before e — a dense counting reduce over
-                # the writer axis. Zero-grant writers (cum equal to their
-                # predecessor's) count too, which is exactly the index
-                # shift they cause. The prior scatter-marks + cummax
-                # formulation serialized an [R·B] scatter (~120 ms at the
-                # 100k cohort); this streams.
-                w_idx = jnp.sum(
-                    cum[:, None, :] <= e[None, :, None], axis=2,
-                    dtype=jnp.int32,
-                )
+                # span ends at or before e. Zero-grant writers (cum equal
+                # to their predecessor's) count too, which is exactly the
+                # index shift they cause. On CPU a batched binary search
+                # over the sorted cum rows (O(B log W)); on accelerators
+                # a dense counting reduce over the writer axis (the prior
+                # scatter-marks + cummax formulation serialized an [R·B]
+                # scatter, ~120 ms at the 100k cohort). Identical counts:
+                # side="right" on a non-decreasing row IS the <= count.
+                if onehot._use_native():
+                    w_idx = jax.vmap(
+                        lambda c: jnp.searchsorted(c, e, side="right")
+                    )(cum).astype(jnp.int32)
+                else:
+                    w_idx = jnp.sum(
+                        cum[:, None, :] <= e[None, :, None], axis=2,
+                        dtype=jnp.int32,
+                    )
                 w_idx = jnp.minimum(w_idx, w_count_ - 1)
                 # One-hot rowgathers (fused) — take_along_axis at
                 # [R, B]←[R, W] lowers as a serialized dynamic gather.
@@ -1588,37 +1687,51 @@ def visibility(data: DataState, sample_writer: jax.Array, sample_ver: jax.Array)
     window (the reference applies complete versions in any order —
     agent.rs:1809-2060 — so an applied version is queryable immediately).
 
-    The column gather contig[:, sample_writer] is strided and lowers
-    poorly at [100k, 512]→[100k, S]; a one-hot f32 matmul rides the MXU
-    instead (exact: one nonzero per output column, values < 2^24 in f32
-    with HIGHEST precision). Window words split into u16 halves for the
-    same exactness."""
+    On accelerators the column gather contig[:, sample_writer] is strided
+    and lowers poorly at [100k, 512]→[100k, S]; a one-hot f32 matmul
+    rides the MXU instead (exact: one nonzero per output column, values
+    < 2^24 in f32 with HIGHEST precision; window words split into u16
+    halves for the same exactness). On CPU the plain column gather is a
+    tight loop and the matmul is pure overhead — same u32 compares, same
+    bits, chosen at trace time."""
     w = data.contig.shape[1]
-    onehot = (
-        jnp.arange(w, dtype=sample_writer.dtype)[:, None]
-        == sample_writer[None, :]
-    ).astype(jnp.float32)
+    native = onehot._use_native()
+    if native:
+        cols = jnp.clip(sample_writer.astype(jnp.int32), 0, w - 1)
 
-    def _dot(x):
-        return jax.lax.dot(
-            x.astype(jnp.float32), onehot,
-            precision=jax.lax.Precision.HIGHEST,
-        )  # [N, S]
+        def _cols(x):  # u32[N, W] -> u32[N, S]
+            return x[:, cols]
 
-    c = _dot(data.contig)
-    sv = sample_ver[None, :].astype(jnp.float32)
-    vis = c >= sv  # [N, S]
+        c_int = _cols(data.contig)
+        vis = c_int >= sample_ver[None, :]  # [N, S]
+    else:
+        oh = (
+            jnp.arange(w, dtype=sample_writer.dtype)[:, None]
+            == sample_writer[None, :]
+        ).astype(jnp.float32)
+
+        def _dot(x):
+            return jax.lax.dot(
+                x.astype(jnp.float32), oh,
+                precision=jax.lax.Precision.HIGHEST,
+            )  # [N, S]
+
+        c = _dot(data.contig)
+        c_int = c.astype(jnp.uint32)
+        vis = c >= sample_ver[None, :].astype(jnp.float32)  # [N, S]
     if data.oo.shape[0] == 0:
         return vis.T
 
     def _with_window(oo):
         out = vis
-        c_int = c.astype(jnp.uint32)
         bit = sample_ver[None, :] - c_int - 1  # u32, wraps when visible
         for b in range(oo.shape[0]):
-            lo = _dot(oo[b] & jnp.uint32(0xFFFF)).astype(jnp.uint32)
-            hi = _dot(oo[b] >> 16).astype(jnp.uint32)
-            word = (hi << 16) | lo  # [N, S]
+            if native:
+                word = _cols(oo[b])  # [N, S]
+            else:
+                lo = _dot(oo[b] & jnp.uint32(0xFFFF)).astype(jnp.uint32)
+                hi = _dot(oo[b] >> 16).astype(jnp.uint32)
+                word = (hi << 16) | lo  # [N, S]
             sh = jnp.minimum(bit - jnp.uint32(32 * b), jnp.uint32(31))
             inb = (bit >= 32 * b) & (bit < 32 * (b + 1))
             out = out | (inb & (((word >> sh) & 1) == 1))

@@ -18,7 +18,18 @@ bandwidth.
 The Pallas kernels below block rows into VMEM tiles and loop over the
 small axis, so the [tile, W] accumulator lives in registers/VMEM and HBM
 traffic is exactly inputs + outputs (a few hundred MB per round). The jnp
-fallback (CPU tests, small shapes, non-TPU backends) is the same math.
+fallback (small shapes, non-TPU accelerator backends) is the same math.
+
+On **CPU** the trade inverts completely: XLA:CPU lowers scatter/gather to
+tight serial loops (no per-element device round-trip), while the dense
+one-hot broadcast does O(R·M·W) compare+select lanes of real work.
+Measured at the 512-node bench shapes: ``rowmax`` 318 ms dense vs 9.5 ms
+native scatter-max, ``rowgather`` 305 ms dense vs 0.9 ms
+``take_along_axis`` — the whole r05 CPU-fallback bench regression in two
+primitives. Every primitive below therefore dispatches on backend at
+trace time: native scatter/gather on CPU, one-hot/MXU forms elsewhere.
+Results are bit-identical either way (all-integer max/add/select), which
+``tests/test_perf_plane.py`` pins by running both paths.
 
 Reference anchor: these implement the batched merge/delivery promotions of
 corro-agent's broadcast plane (broadcast/mod.rs:356-567) and the CRDT
@@ -57,6 +68,20 @@ def _use_pallas(lanes: int) -> bool:
     if os.environ.get("CORRO_ONEHOT_PALLAS", "0") != "1":
         return False
     return jax.default_backend() == "tpu" and lanes >= _PALLAS_MIN_LANES
+
+
+# Backend dispatch for the native scatter/gather forms. None = auto
+# (native on CPU, dense one-hot elsewhere); tests force either path via
+# the module global (the _FAST_MAX_WRITERS override convention) — flip it
+# BEFORE tracing, or clear_cache() the jitted callers, since the choice
+# is baked in at trace time.
+_NATIVE_SCATTER: bool | None = None
+
+
+def _use_native() -> bool:
+    if _NATIVE_SCATTER is not None:
+        return _NATIVE_SCATTER
+    return jax.default_backend() == "cpu"
 
 
 def _pad_rows(x: jax.Array, rows_p: int):
@@ -114,6 +139,17 @@ def rowmax(
     if mask is not None:
         idx = jnp.where(mask, idx, -1)
         val = jnp.where(mask, val, 0)
+    if _use_native():
+        # Native row-local scatter-max. Out-of-range/masked entries route
+        # to a dropped sentinel column (scatter mode="drop" ignores them
+        # — same contribution as the dense form's missed compare).
+        rows = jnp.arange(r, dtype=jnp.int32)[:, None]
+        safe = jnp.where((idx >= 0) & (idx < width), idx, width)
+        return (
+            jnp.zeros((r, width), jnp.uint32)
+            .at[rows, safe]
+            .max(val, mode="drop")
+        )
     if not _use_pallas(r * m * width):
         # Reduce over the MINOR-MOST axis: [R, W, M] with the M messages
         # last lets XLA fuse the compare+select straight into a row
@@ -150,9 +186,13 @@ def rowgather_wide(table: jax.Array, idx: jax.Array, blk: int = 128) -> jax.Arra
     matmul on the MXU (u16 halves keep all of u32 exact), then select
     within the block. idx must be in [0, W)."""
     r, w = table.shape
+    table = table.astype(jnp.uint32)
+    if _use_native():
+        return jnp.take_along_axis(
+            table, jnp.clip(idx.astype(jnp.int32), 0, w - 1), axis=1
+        )
     nb = -(-w // blk)
     wp = nb * blk
-    table = table.astype(jnp.uint32)
     if wp != w:
         table = jnp.pad(table, ((0, 0), (0, wp - w)))
     b_idx = jnp.minimum(idx.astype(jnp.int32) // blk, nb - 1)
@@ -207,6 +247,10 @@ def table_gather_u32(
     a [..., NB] one-hot against the shared [NB, blk] table — no broadcast
     materialization."""
     w = table.shape[0]
+    if _use_native():
+        return jnp.take(
+            table.astype(jnp.uint32), idx.astype(jnp.int32), mode="clip"
+        )
     nb = -(-w // blk)
     wp = nb * blk
     tp = table.astype(jnp.uint32)
@@ -269,6 +313,17 @@ def rowsum(
     if mask is not None:
         idx = jnp.where(mask, idx, -1)
         val = jnp.where(mask, val, 0)
+    if _use_native():
+        # Native row-local scatter-add (u32 add is mod 2^32 like the
+        # dense sum); out-of-range entries drop, matching the dense
+        # form's missed compares.
+        rows = jnp.arange(r, dtype=jnp.int32)[:, None]
+        safe = jnp.where((idx >= 0) & (idx < width), idx, width)
+        return (
+            jnp.zeros((r, width), jnp.uint32)
+            .at[rows, safe]
+            .add(val, mode="drop")
+        )
     if not _use_pallas(r * m * width):
         ids = jnp.arange(width, dtype=idx.dtype)
         hit = idx[:, None, :] == ids[None, :, None]
@@ -313,6 +368,14 @@ def rowgather(table: jax.Array, idx: jax.Array) -> jax.Array:
     r, width = table.shape
     m = idx.shape[1]
     table = table.astype(jnp.uint32)
+    if _use_native():
+        # Native row-local gather; out-of-range indices yield 0 like the
+        # dense form's missed compare (negatives routed to the fill
+        # sentinel — take_along_axis would otherwise wrap them).
+        safe = jnp.where(idx < 0, width, idx.astype(jnp.int32))
+        return jnp.take_along_axis(
+            table, safe, axis=1, mode="fill", fill_value=0
+        )
     if not _use_pallas(r * m * width):
         ids = jnp.arange(width, dtype=idx.dtype)
         hit = idx[:, :, None] == ids[None, None, :]

@@ -1,0 +1,137 @@
+"""Shared bench harness pieces for bench.py and scripts/bench_smoke.py.
+
+One place owns the plane-attribution composite (the cumulative-prefix
+stage timing bench.py documents) and the budget-gate arithmetic the CI
+bench-smoke job applies, so the headline bench and the regression gate
+can never drift onto different measurement paths — the r04→r05 class of
+silent regression slipped through exactly because nothing in CI measured
+step time at all (docs/PERFORMANCE.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Execution order of the composite's stages — must mirror cluster_round.
+PLANE_STAGES = ("broadcast", "swim", "sync", "track")
+# Gate tolerance applied when a budget file omits the key — the same
+# default --update writes, so a hand-edited budget never silently gates
+# tighter than the documented workflow.
+DEFAULT_TOLERANCE = 1.5
+
+
+def rounded_step_report(step_ms: float, plane: dict) -> dict:
+    """The ONE emit-site rounding: round step and planes to 0.1 ms and
+    derive the residual from the ROUNDED values, so
+    ``sum(plane_ms) + residual_ms == step_ms`` holds exactly on the
+    published numbers (telemetry.check_bench_invariants re-asserts it).
+    Shared by bench.py and scripts/bench_smoke.py — two hand-rolled
+    copies of this arithmetic is how the emitted invariants drift."""
+    step_r = round(step_ms, 1)
+    plane_r = {k: round(v, 1) for k, v in plane.items()}
+    return {
+        "step_ms": step_r,
+        "plane_ms": plane_r,
+        "residual_ms": round(step_r - sum(plane_r.values()), 1),
+    }
+
+
+def plane_composite(cfg, topo, sched, final):
+    """Build the cumulative-prefix attribution inputs for a finished run.
+
+    Returns ``(make_step, stages, carry0)`` for
+    ``telemetry.attribute_planes``: a composite round step over the run's
+    FINAL state (fresh state would flatter sync — no deficits to score or
+    grant) whose stages enable one at a time in execution order.
+
+    NOTE: the big arrays ride the CARRY, never closures — a closed-over
+    DataState would be embedded as compile-payload constants (hundreds of
+    MB at 10k; the axon compile tunnel rejects it outright).
+    """
+    from corrosion_tpu.ops import gossip as gossip_ops
+    from corrosion_tpu.ops import swim as swim_ops
+
+    swim_impl = swim_ops.impl(cfg.swim)
+    n_regions = int(np.asarray(topo.region).max()) + 1
+    part = jnp.zeros((n_regions, n_regions), bool)
+    writes = jnp.asarray(sched.writes[0], jnp.uint32)
+    key = jax.random.PRNGKey(0)
+    s_writer = jnp.asarray(sched.sample_writer)
+    s_ver = jnp.asarray(sched.sample_ver)
+    s_round = jnp.asarray(sched.sample_round)
+
+    def composite(enabled):
+        def step(carry, i):
+            d, sw, vr = carry
+            k = jax.random.fold_in(key, i)
+            k_b, k_sw, k_sy = jax.random.split(k, 3)
+            if "broadcast" in enabled:
+                d, _ = gossip_ops.broadcast_round(
+                    d, topo, sw.alive, part, writes, k_b, cfg.gossip
+                )
+            if "swim" in enabled:
+                sw = swim_impl.swim_round(sw, k_sw, i, cfg.swim)
+            if "sync" in enabled:
+                d, _ = gossip_ops.sync_round(
+                    d, topo, sw.alive, part, i, k_sy, cfg.gossip
+                )
+            if "track" in enabled:
+                vis_now = gossip_ops.visibility(d, s_writer, s_ver)
+                active = i >= s_round
+                vr = jnp.where(
+                    (vr < 0) & vis_now & active[:, None], i, vr
+                )
+                need = gossip_ops.total_need(d)
+                vr = vr + (need * jnp.uint32(0)).astype(vr.dtype)
+            return d, sw, vr
+
+        return step
+
+    carry0 = (final.data, final.swim, final.vis_round)
+    return composite, PLANE_STAGES, carry0
+
+
+def check_budget(
+    measured: dict, budget: dict
+) -> tuple[bool, list[str]]:
+    """Gate a measured ``{step_ms, plane_ms:{...}}`` report against a
+    committed budget file (bench_budget.json).
+
+    The budget carries per-key millisecond ceilings plus a ``tolerance``
+    multiplier absorbing machine-to-machine variance; a key breaches when
+    ``measured > budget_ms * tolerance``. Returns ``(ok, breaches)`` with
+    one human-readable line per breach. Budget keys absent from the
+    measurement are breaches too (a silently vanished plane is how the
+    r05 regression class hides), and so is a bench-shape mismatch: a
+    measurement taken at different ``nodes``/``rounds`` than the budget
+    was refreshed at must not gate against stale ceilings (shrinking the
+    smoke config without ``--update`` would silently loosen the gate).
+    """
+    tol = float(budget.get("tolerance", DEFAULT_TOLERANCE))
+    breaches: list[str] = []
+    for dim in ("nodes", "rounds"):
+        if dim in budget and measured.get(dim) != budget[dim]:
+            breaches.append(
+                f"{dim}: measured at {measured.get(dim)} but the budget "
+                f"was refreshed at {budget[dim]} — rerun with --update"
+            )
+
+    def gate(name: str, got, limit) -> None:
+        if got is None:
+            breaches.append(f"{name}: missing from measurement")
+        elif float(got) > float(limit) * tol:
+            breaches.append(
+                f"{name}: {float(got):.1f} ms > budget "
+                f"{float(limit):.1f} ms x{tol}"
+            )
+
+    gate("step_ms", measured.get("step_ms"), budget["step_ms"])
+    for plane, limit in budget.get("plane_ms", {}).items():
+        gate(
+            f"plane_ms.{plane}",
+            measured.get("plane_ms", {}).get(plane),
+            limit,
+        )
+    return not breaches, breaches

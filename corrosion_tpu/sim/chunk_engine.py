@@ -35,8 +35,7 @@ from corrosion_tpu.sim import telemetry as telemetry_mod
 from corrosion_tpu.sim.telemetry import KernelTelemetry
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def _scan(state, vis, last_seq, alive, base_key, xs, cfg):
+def _scan_impl(state, vis, last_seq, alive, base_key, xs, cfg):
     """xs = (round_idx [E], alive_t [E, N] | None, loss [E] | None,
     wipe [E, N] | None); ``alive`` is the churn-free constant used when
     ``alive_t`` is absent (the chaos axes are trace-time optional, like
@@ -85,6 +84,20 @@ def _scan(state, vis, last_seq, alive, base_key, xs, cfg):
         return (st, vis), curves
 
     return jax.lax.scan(body, (state, vis), xs)
+
+
+# The donated twin aliases the carried (state, vis) into the outputs so
+# chunked runs round-trip coverage/visibility buffers in place;
+# ``last_seq``/``alive`` are NOT donated (the driver re-feeds them every
+# chunk). It is the driver's only scan entry (a second non-donating
+# compile would double the first chunk's dominant cost); the first
+# chunk's freshly-built carry is made donatable by one deep copy —
+# zero-filled leaves can share one constant buffer, which XLA rejects as
+# a double donation. The plain entry remains for ad-hoc callers.
+_scan = partial(jax.jit, static_argnames=("cfg",))(_scan_impl)
+_scan_donated = partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(0, 1)
+)(_scan_impl)
 
 
 def simulate_chunks(
@@ -157,6 +170,7 @@ def simulate_chunks(
         [] if rounds > 0
         else [{k: np.zeros((0,)) for k in telemetry_mod.ROUND_CURVE_KEYS}]
     )
+    owned = False  # first chunk's carry needs the ownership copy
     for r0 in range(0, rounds, step):
         nr = min(step, rounds - r0)
         sl = slice(r0, r0 + nr)
@@ -168,18 +182,22 @@ def simulate_chunks(
             ),
             None if wipe_np is None else jnp.asarray(wipe_np[sl]),
         )
+        if not owned:
+            state = telemetry_mod.owned_copy(state)
+            vis = telemetry_mod.owned_copy(vis)
         if telemetry is None:
-            (state, vis), curves = _scan(
+            (state, vis), curves = _scan_donated(
                 state, vis, last_seq, alive, base_key, xs, cfg
             )
         else:
             def _run(state=state, vis=vis, xs=xs):
-                (st, vi), curves = _scan(
+                (st, vi), curves = _scan_donated(
                     state, vis, last_seq, alive, base_key, xs, cfg
                 )
                 return (st, vi), curves
 
             (state, vis), curves = telemetry.run_chunk(r0, _run)
+        owned = True
         curve_parts.append({k: np.asarray(v) for k, v in curves.items()})
     merged = {
         k: np.concatenate([p[k] for p in curve_parts])

@@ -124,8 +124,7 @@ def init_cluster(cfg: ClusterConfig, n_samples: int) -> ClusterState:
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "has_churn"))
-def cluster_round(
+def _cluster_round(
     state: ClusterState,
     topo: Topology,
     writes: jax.Array,  # u32[W]
@@ -247,6 +246,20 @@ def cluster_round(
     )
 
 
+# Public entry points. ``cluster_round_donated`` aliases the carried
+# ClusterState into the output (XLA reuses the round-trip buffers in
+# place — the whole data+swim state, ~10 MiB at 512 nodes and ~GiB at the
+# 100k configs). Donation binds at top-level calls only; the plain entry
+# stays the default for ad-hoc stepping where the caller may re-read its
+# input state. See docs/PERFORMANCE.md ("Donation invariants").
+cluster_round = partial(jax.jit, static_argnames=("cfg", "has_churn"))(
+    _cluster_round
+)
+cluster_round_donated = partial(
+    jax.jit, static_argnames=("cfg", "has_churn"), donate_argnums=(0,)
+)(_cluster_round)
+
+
 def simulate(
     cfg: ClusterConfig,
     topo: Topology,
@@ -255,6 +268,7 @@ def simulate(
     state: ClusterState | None = None,
     max_chunk: int | None = None,
     telemetry: KernelTelemetry | None = None,
+    _donate_state: bool = False,
 ) -> tuple[ClusterState, dict]:
     """Scan `cluster_round` over the schedule. Returns final state + per-round
     metric curves (numpy arrays of length schedule.rounds).
@@ -270,6 +284,15 @@ def simulate(
     unchunked) is timed, spanned, and flushed to the flight recorder,
     and the finished curves fold into the metrics registry as
     ``corro_kernel_*`` series. Curves and final state are unchanged.
+
+    Buffer donation: the scan always runs through the donated entry, so
+    the carried state round-trips in place; a run's first carry is made
+    donatable by one deep copy (``telemetry.owned_copy``), amortized across all
+    its chunks. A caller-supplied ``state`` is therefore never consumed
+    — it stays readable after the call (``_donate_state`` is the
+    internal recursion flag marking an already-owned carry; callers
+    leave it False). Results are bit-identical with or without donation
+    (tests/test_perf_plane.py pins this).
     """
     # The CRDT merge packs (cl, col_version) into one u32 (ops/crdt.py
     # apply_changes): versions must stay below 2^24. Bound the reachable
@@ -286,6 +309,11 @@ def simulate(
         )
     if max_chunk is not None and schedule.rounds > max_chunk:
         cur = state
+        # The first chunk takes ownership of the carry (one owned_copy
+        # inside the recursive call unless the recursion already marked
+        # it owned); every later chunk's input is the previous chunk's
+        # output — owned by construction, donated without a copy.
+        owned = _donate_state
         curve_parts: list[dict] = []
         for start in range(0, schedule.rounds, max_chunk):
             stop = min(start + max_chunk, schedule.rounds)
@@ -317,17 +345,22 @@ def simulate(
                 ),
             )
             if telemetry is None:
-                cur, curves = simulate(cfg, topo, part, seed=seed, state=cur)
+                cur, curves = simulate(
+                    cfg, topo, part, seed=seed, state=cur,
+                    _donate_state=owned,
+                )
             else:
                 # Chunk boundary: time the execution, span it, and flush
                 # the chunk's per-round curves to the flight recorder so
                 # long runs stream progress instead of going dark.
                 cur, curves = telemetry.run_chunk(
                     start_round + start,
-                    lambda part=part, cur=cur: simulate(
-                        cfg, topo, part, seed=seed, state=cur
+                    lambda part=part, cur=cur, owned=owned: simulate(
+                        cfg, topo, part, seed=seed, state=cur,
+                        _donate_state=owned,
                     ),
                 )
+            owned = True
             curve_parts.append(curves)
         merged = {
             k: np.concatenate([p[k] for p in curve_parts])
@@ -381,10 +414,16 @@ def simulate(
     if state is None:
         state = init_cluster(cfg, len(schedule.sample_writer))
         offset = 0
+        owned = False
     else:
         # Continue from the carried round counter so chunked/chained runs
         # fold distinct per-round RNG keys.
         offset = int(np.asarray(state.round))
+        owned = _donate_state
+    if not owned:
+        # One copy makes the carry donatable (see _scan_rounds_donated);
+        # chunked runs pay it on the first chunk only.
+        state = telemetry_mod.owned_copy(state)
     base_key = jax.random.PRNGKey(seed)
 
     xs = (
@@ -393,7 +432,7 @@ def simulate(
         loss, probe_loss, wipe,
     )
     if telemetry is None:
-        final, curves = _scan_rounds(
+        final, curves = _scan_rounds_donated(
             state, topo, xs, s_writer, s_ver, s_round, base_key, cfg,
             has_churn,
         )
@@ -401,7 +440,7 @@ def simulate(
         # Unchunked run with telemetry: the whole execution is one chunk.
         final, curves = telemetry.run_chunk(
             offset,
-            lambda: _scan_rounds(
+            lambda: _scan_rounds_donated(
                 state, topo, xs, s_writer, s_ver, s_round, base_key, cfg,
                 has_churn,
             ),
@@ -412,8 +451,7 @@ def simulate(
     return final, curves
 
 
-@partial(jax.jit, static_argnames=("cfg", "has_churn"))
-def _scan_rounds(
+def _scan_rounds_impl(
     state, topo, xs, s_writer, s_ver, s_round, base_key, cfg, has_churn
 ):
     """Whole-run scan, jitted once per (cfg, shapes): repeat calls — e.g. a
@@ -429,6 +467,25 @@ def _scan_rounds(
         )
 
     return jax.lax.scan(body, state, xs)
+
+
+# The donated twin is the driver's ONLY scan entry (one compiled
+# executable per config — a second non-donating twin would double the
+# dominant compile cost of every chunked first call): each chunk's carry
+# aliases into its output, so the ~state-sized copy per chunk collapses
+# to an in-place round-trip. Carries the driver does not own — a
+# caller-supplied resume state (which must stay readable; checkpoint
+# flows and tests re-read it) or a freshly-built init (identical
+# zero-filled leaves can share one constant buffer, which XLA rejects as
+# a double donation) — are made owned by ONE `telemetry.owned_copy` per run,
+# amortized across all chunks. The plain entry remains for ad-hoc
+# callers that want non-consuming semantics without a copy.
+_scan_rounds = partial(jax.jit, static_argnames=("cfg", "has_churn"))(
+    _scan_rounds_impl
+)
+_scan_rounds_donated = partial(
+    jax.jit, static_argnames=("cfg", "has_churn"), donate_argnums=(0,)
+)(_scan_rounds_impl)
 
 
 def visibility_latencies(

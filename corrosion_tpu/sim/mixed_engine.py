@@ -353,8 +353,7 @@ def mixed_round(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "ccfg", "has_churn"))
-def _scan_mixed(
+def _scan_mixed_impl(
     state, topo, xs, s_writer, s_version, s_last, s_w, s_v, s_r,
     base_key, cfg, ccfg, has_churn,
 ):
@@ -371,6 +370,22 @@ def _scan_mixed(
         )
 
     return jax.lax.scan(body, state, xs)
+
+
+# Donated twin: the carried MixedState aliases into the output so chunked
+# runs round-trip the data+swim+chunk-coverage buffers in place. It is
+# the driver's only scan entry (a second non-donating compile would
+# double the first chunk's dominant cost); the first chunk's
+# freshly-built carry is made donatable by one deep copy — zero-filled
+# leaves can share one constant buffer, which XLA rejects as a double
+# donation. The plain entry remains for ad-hoc callers.
+_scan_mixed = partial(jax.jit, static_argnames=("cfg", "ccfg", "has_churn"))(
+    _scan_mixed_impl
+)
+_scan_mixed_donated = partial(
+    jax.jit, static_argnames=("cfg", "ccfg", "has_churn"),
+    donate_argnums=(0,),
+)(_scan_mixed_impl)
 
 
 def simulate_mixed(
@@ -462,6 +477,7 @@ def simulate_mixed(
         [] if rounds > 0
         else [{k: np.zeros((0,)) for k in telemetry_mod.ROUND_CURVE_KEYS}]
     )
+    owned = False  # first chunk's carry needs the ownership copy
     for r0 in range(0, rounds, step):
         r1 = min(r0 + step, rounds)
         xs = (
@@ -472,19 +488,22 @@ def simulate_mixed(
             None if probe_loss is None else probe_loss[r0:r1],
             None if wipe is None else wipe[r0:r1],
         )
+        if not owned:
+            state = telemetry_mod.owned_copy(state)
         if telemetry is None:
-            state, curves = _scan_mixed(
+            state, curves = _scan_mixed_donated(
                 state, topo, xs, s_writer, s_version, s_last,
                 s_w, s_v, s_r, base_key, cfg, ccfg, has_churn,
             )
         else:
             def _run(state=state, xs=xs):
-                return _scan_mixed(
+                return _scan_mixed_donated(
                     state, topo, xs, s_writer, s_version, s_last,
                     s_w, s_v, s_r, base_key, cfg, ccfg, has_churn,
                 )
 
             state, curves = telemetry.run_chunk(r0, _run)
+        owned = True
         curve_parts.append({k: np.asarray(v) for k, v in curves.items()})
     merged = {
         k: np.concatenate([p[k] for p in curve_parts])

@@ -217,8 +217,7 @@ class _Planner:
         self.free = [int(x) for x in snap["free"]]
 
 
-@partial(jax.jit, static_argnames=("cfg", "sp", "has_churn"))
-def _epoch_scan(
+def _epoch_scan_impl(
     sstate: SparseState,
     swim_state,
     vis_round: jax.Array,  # i32[S, N]
@@ -340,6 +339,23 @@ def _epoch_scan(
     return sstate, swim_state, vis_round, curves
 
 
+# Donated twin: the carried (sstate, swim, vis_round) pytrees alias into
+# the outputs so each epoch's state round-trips in place instead of
+# copying. It is the driver's ONLY scan entry (a second non-donating
+# compile would double the dominant cost of every first epoch); the
+# first epoch's carry is made donatable by one deep copy — init arrays
+# can share constant buffers, and a caller's resume snapshot must stay
+# replayable — amortized over the run. docs/PERFORMANCE.md ("Donation
+# invariants"); the plain entry remains for ad-hoc callers.
+_epoch_scan = partial(jax.jit, static_argnames=("cfg", "sp", "has_churn"))(
+    _epoch_scan_impl
+)
+_epoch_scan_donated = partial(
+    jax.jit, static_argnames=("cfg", "sp", "has_churn"),
+    donate_argnums=(0, 1, 2),
+)(_epoch_scan_impl)
+
+
 @jax.jit
 def _cold_vis_update(
     sstate: SparseState,
@@ -435,6 +451,12 @@ def simulate_sparse(
     curve_parts = []
     info = {"epochs": 0, "retired": 0, "promoted": 0, "dev_dropped": 0,
             "max_dev_entries": 0}
+    # The first epoch's carry is made donatable by one deep copy (init
+    # arrays can share constant buffers — XLA rejects a double donation —
+    # and a resume snapshot must stay replayable: tests resume twice from
+    # one dict). From epoch 1 on the carry is the previous scan's output,
+    # owned by construction.
+    owned = False
     for e0 in range(start_epoch * e_len, rounds, e_len):
         e1 = min(e0 + e_len, rounds)
         epoch = e0 // e_len
@@ -499,8 +521,12 @@ def simulate_sparse(
         )
         ridx = jnp.arange(e0, e1, dtype=jnp.int32)
 
+        if not owned:
+            sstate = telemetry_mod.owned_copy(sstate)
+            swim_state = telemetry_mod.owned_copy(swim_state)
+            vis_round = telemetry_mod.owned_copy(vis_round)
         if telemetry is None:
-            sstate, swim_state, vis_round, curves = _epoch_scan(
+            sstate, swim_state, vis_round, curves = _epoch_scan_donated(
                 sstate, swim_state, vis_round, topo,
                 (writes_slots, kill, revive, ridx, loss_e, probe_e), part,
                 s_slot, s_ver, s_round, base_key, cfg, sp, has_churn,
@@ -512,7 +538,7 @@ def simulate_sparse(
                      writes_slots=writes_slots, kill=kill, revive=revive,
                      ridx=ridx, part=part, s_slot=s_slot,
                      loss_e=loss_e, probe_e=probe_e):
-                out = _epoch_scan(
+                out = _epoch_scan_donated(
                     sstate, swim_state, vis_round, topo,
                     (writes_slots, kill, revive, ridx, loss_e, probe_e),
                     part,
@@ -523,6 +549,7 @@ def simulate_sparse(
             (sstate, swim_state, vis_round), curves = telemetry.run_chunk(
                 e0, _run
             )
+        owned = True
         curve_parts.append({k: np.asarray(v) for k, v in curves.items()})
 
         # Epoch-end cold visibility at epoch granularity (exact for

@@ -399,6 +399,21 @@ class KernelTelemetry:
         return sum(w for _, w in self.chunk_walls) / rounds * 1000.0
 
 
+def owned_copy(tree):
+    """Distinct-buffer deep copy of a state pytree: safe to donate.
+
+    The one implementation of the copy-once-donate-always ownership rule
+    every engine driver applies to its first carry (docs/PERFORMANCE.md
+    "Donation invariants"): freshly-built init states can share one
+    constant buffer between identical zero-filled leaves (XLA rejects
+    donating it twice), and caller-supplied resume states must stay
+    readable after the run — one copy makes the carry donatable, and
+    every later chunk/epoch donates the previous execution's output
+    without copying.
+    """
+    return jax.tree.map(jnp.copy, tree)
+
+
 def flight_path_from_argv(
     argv, default: str = "flight.jsonl"
 ) -> str | None:
@@ -498,6 +513,56 @@ class PlaneAttribution:
             abs(step_ms), 1.0
         )
         return plane, residual
+
+
+def check_bench_invariants(report: dict, tol: float = 1e-6) -> dict:
+    """Assert the documented step-time invariants on an emitted bench
+    report (bench.py module docstring), exactly as they appear in the
+    JSON, and return the report unchanged so the emit site can wrap it.
+
+    Checked for the base fields and every suffixed variant present
+    (``step_ms_100k``, ...):
+
+    - ``step_inner_ms <= step_ms``: the device chunk-execution windows
+      are a subset of the run wall, so the instrumented per-round time
+      can never exceed the end-to-end one. (BENCH_r05 violated this —
+      its reporting path published the raw composite microbench, an
+      end-of-run-state sample, as step_inner_ms.)
+    - ``sum(plane_ms.values()) + residual_ms == step_ms``: plane
+      attribution is a partition of the measured step time; nothing may
+      hide in unattributed time.
+
+    Raises ValueError naming the offending field on violation (a real
+    exception, not ``assert`` — the guarantee must survive ``python -O``);
+    the bench emits nothing rather than publishing a report that
+    contradicts its own documentation.
+    """
+    suffixes = sorted(
+        {
+            k[len("step_ms"):]
+            for k in report
+            if k.startswith("step_ms")
+        }
+    )
+    for sfx in suffixes:
+        step = report[f"step_ms{sfx}"]
+        inner = report.get(f"step_inner_ms{sfx}")
+        if inner is not None and not inner <= step + tol:
+            raise ValueError(
+                f"step_inner_ms{sfx}={inner} > step_ms{sfx}={step}: "
+                f"chunk-execution windows exceed the run wall"
+            )
+        plane = report.get(f"plane_ms{sfx}")
+        if plane is not None:
+            residual = report.get(f"residual_ms{sfx}", 0.0)
+            total = sum(plane.values()) + residual
+            if not abs(total - step) <= tol * max(abs(step), 1.0):
+                raise ValueError(
+                    f"plane_ms{sfx} {plane} + residual_ms{sfx} {residual} "
+                    f"= {total} != step_ms{sfx} {step}: attribution must "
+                    f"partition the measured step time"
+                )
+    return report
 
 
 def attribute_planes(

@@ -1,0 +1,157 @@
+"""CI bench-smoke gate: a small fixed-seed step-time bench vs a budget.
+
+Runs a scaled-down merge-storm config (fixed seed, fixed shapes), measures
+warm per-round step time plus the plane breakdown on the SAME
+cumulative-prefix composite the headline bench uses (sim/benchlib.py),
+writes the full report as a JSON artifact, and exits 1 when ``step_ms``
+or any plane exceeds its committed budget (bench_budget.json) by the
+budget's tolerance — so the r04→r05 class of silent step-time regression
+fails the PR that introduces it instead of surfacing rounds later.
+
+Usage:
+    python scripts/bench_smoke.py [--out report.json] [--budget FILE]
+    python scripts/bench_smoke.py --update   # refresh the budget file
+
+``--update`` rewrites the budget from the current measurement with the
+documented headroom (x3 — absorbs slower CI runners; the gate's job is
+catching multi-x structural regressions, not 10%% noise). How to read and
+refresh the budget: docs/PERFORMANCE.md.
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+# Fixed shape: small enough for a CI runner's 2 vCPUs (compile included),
+# big enough that the broadcast/sync planes dominate like the flagship.
+NODES = 128
+ROUNDS = 48
+SAMPLES = 64
+SEED = 0
+# --update headroom: budget = measured * this.
+UPDATE_HEADROOM = 3.0
+# Per-plane ceiling floor for --update: cumulative-prefix increments at
+# this scale are ~1 ms, can measure 0 under timer noise (a 0 ms ceiling
+# would make ANY later nonzero measurement a breach), and spike to tens
+# of ms on a contended runner. step_ms is the stable primary gate; the
+# plane ceilings are coarse attribution guards, floored high enough that
+# only the multi-x structural class (the r05 sync plane was ~390 ms at
+# the flagship shape) can breach them.
+UPDATE_PLANE_FLOOR_MS = 30.0
+
+
+def measure() -> dict:
+    import jax
+
+    from corrosion_tpu import models
+    from corrosion_tpu.sim import benchlib, simulate, telemetry
+
+    cfg, topo, sched = models.merge_10k(
+        n=NODES, rounds=ROUNDS, samples=SAMPLES
+    )
+    chunk = 24
+    # Warm-up compiles the chunked scan; the timed run re-executes the
+    # SAME seed, so the reported seed is exactly the run that produced
+    # the gated number (reproducible from the artifact alone).
+    final, _ = simulate(cfg, topo, sched, seed=SEED, max_chunk=chunk)
+    jax.block_until_ready(final.data.contig)
+    t0 = time.perf_counter()
+    final, _ = simulate(cfg, topo, sched, seed=SEED, max_chunk=chunk)
+    jax.block_until_ready(final.data.contig)
+    step_ms = (time.perf_counter() - t0) / ROUNDS * 1000.0
+
+    composite, stages, carry0 = benchlib.plane_composite(
+        cfg, topo, sched, final
+    )
+    # More iterations than the headline bench: per-stage increments are
+    # ~1 ms at this scale, so the default 10 leaves the plane split
+    # timer-noise-bound on a loaded runner.
+    attr = telemetry.attribute_planes(composite, stages, carry0, iters=20)
+    plane, _ = attr.scale(step_ms)
+    report = {
+        "platform": jax.devices()[0].platform,
+        "nodes": NODES,
+        "rounds": ROUNDS,
+        "seed": SEED,
+        # Shared emit-site rounding (benchlib) — the headline bench and
+        # this gate must publish invariant-satisfying numbers the same
+        # way or they drift.
+        **benchlib.rounded_step_report(step_ms, plane),
+        "attrib_composite_ms": round(attr.full_ms, 1),
+    }
+    # Same emitted-report invariants as the headline bench.
+    return telemetry.check_bench_invariants(report)
+
+
+def main(argv=None) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", default=str(repo / "bench_budget.json"))
+    ap.add_argument("--out", default="bench_smoke_report.json")
+    ap.add_argument(
+        "--update", action="store_true",
+        help="rewrite the budget file from this measurement "
+        f"(x{UPDATE_HEADROOM} headroom) instead of gating",
+    )
+    args = ap.parse_args(argv)
+
+    from corrosion_tpu.sim import benchlib
+
+    measured = measure()
+    budget_path = Path(args.budget)
+    if args.update:
+        old = (
+            json.loads(budget_path.read_text())
+            if budget_path.exists() else {}
+        )
+        budget = {
+            "_comment": (
+                "Per-round step-time budget for scripts/bench_smoke.py "
+                "(docs/PERFORMANCE.md). Ceilings are measured-on-refresh "
+                f"x{UPDATE_HEADROOM} headroom; the gate additionally "
+                "multiplies by `tolerance`."
+            ),
+            "nodes": NODES,
+            "rounds": ROUNDS,
+            "tolerance": old.get("tolerance", benchlib.DEFAULT_TOLERANCE),
+            "step_ms": round(measured["step_ms"] * UPDATE_HEADROOM, 1),
+            "plane_ms": {
+                k: round(
+                    max(v * UPDATE_HEADROOM, UPDATE_PLANE_FLOOR_MS), 1
+                )
+                for k, v in measured["plane_ms"].items()
+            },
+        }
+        budget_path.write_text(json.dumps(budget, indent=2) + "\n")
+        print(f"[bench-smoke] budget refreshed: {budget_path}")
+        print(json.dumps(measured))
+        return 0
+
+    budget = json.loads(budget_path.read_text())
+    ok, breaches = benchlib.check_budget(measured, budget)
+    report = {
+        **measured,
+        "budget": {k: v for k, v in budget.items() if k != "_comment"},
+        "ok": ok,
+        "breaches": breaches,
+    }
+    Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report))
+    if not ok:
+        for b in breaches:
+            print(f"[bench-smoke] BREACH {b}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,453 @@
+"""Broadcast/sync hot-path overhaul: equivalence + safety pins.
+
+Three families of guarantees from the perf pass (docs/PERFORMANCE.md):
+
+1. **Batched anti-entropy pipeline**: the single tiled [R, C, W]
+   candidate-scoring gather and the [R, S+1, W] union-pull are
+   bit-identical to the original per-candidate/per-peer Python loops —
+   peer selection AND post-sync DataState — in exact and digest scoring
+   modes, on the cohort and non-cohort sync_round paths.
+2. **Backend-native one-hot primitives**: the CPU scatter/gather forms
+   of ops/onehot.py equal the dense one-hot forms bit-for-bit, at the
+   primitive level (including out-of-range index handling) and through
+   whole gossip rounds.
+3. **Donation safety**: donated round/scan entry points return
+   bit-identical results, actually release the donated input buffers,
+   never read a donated buffer after the call in any engine driver, and
+   keep the per-function compile-cache count at <= 1 (the CT031 retrace
+   tripwire's invariant).
+
+Plus the bench-report invariants (step_inner_ms <= step_ms;
+sum(plane_ms) + residual == step_ms) and the bench-smoke budget gate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops import gossip, onehot
+from corrosion_tpu.sim import telemetry
+from corrosion_tpu.sim import benchlib
+
+
+def mk(n, regions=None, writers=None, cohorts=False, **kw):
+    regions = regions or [n]
+    writers = writers if writers is not None else list(range(n))
+    cfg = gossip.GossipConfig(n_nodes=n, n_writers=len(writers), **kw)
+    topo = gossip.make_topology(
+        regions, writers,
+        sync_interval=cfg.sync_interval if cohorts else None,
+    )
+    return cfg, topo, gossip.init_data(cfg)
+
+
+def run_rounds(cfg, topo, data, rounds, writes_fn=None, seed=0):
+    """Broadcast+sync stepping loop; returns (final DataState, stats)."""
+    n = cfg.n_nodes
+    alive = jnp.ones(n, bool)
+    part = jnp.zeros((int(jnp.max(topo.region)) + 1,) * 2, bool)
+    key = jax.random.PRNGKey(seed)
+    stats = []
+    for r in range(rounds):
+        key, k1, k2 = jax.random.split(key, 3)
+        w = (
+            writes_fn(r) if writes_fn
+            else jnp.zeros(cfg.n_writers, jnp.uint32)
+        )
+        data, b = gossip.broadcast_round(data, topo, alive, part, w, k1, cfg)
+        data, s = gossip.sync_round(
+            data, topo, alive, part, jnp.int32(r), k2, cfg
+        )
+        stats.append((b, s))
+    return data, stats
+
+
+def assert_states_equal(a, b, msg=""):
+    for name in a._fields:
+        fa, fb = getattr(a, name), getattr(b, name)
+        if name == "cells":
+            for cn in fa._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(fa, cn)),
+                    np.asarray(getattr(fb, cn)),
+                    err_msg=f"{msg} cells.{cn}",
+                )
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(fa), np.asarray(fb), err_msg=f"{msg} {name}"
+            )
+
+
+def _clear_round_caches():
+    gossip.sync_round.clear_cache()
+    gossip.sync_round_donated.clear_cache()
+    gossip.broadcast_round.clear_cache()
+    gossip.broadcast_round_donated.clear_cache()
+
+
+def _clear_sync_caches():
+    # The scoring flags (_BATCHED_SYNC/_EXACT_SCORE_MAX) only reach
+    # sync_round's trace: broadcast stays cached across flips, which
+    # keeps this module's wall time compile-light.
+    gossip.sync_round.clear_cache()
+    gossip.sync_round_donated.clear_cache()
+
+
+# ---------------------------------------------------------------------------
+# 1. Batched anti-entropy scoring/grants vs the looped reference
+
+
+def _one_sync_run(cohorts, seed=0):
+    cfg, topo, data = mk(
+        24, regions=[6, 6, 6, 6], sync_interval=3, sync_budget=48,
+        sync_chunk=8, sync_peers=3, sync_candidates=6, n_cells=32,
+        cells_per_write=2, cohorts=cohorts,
+    )
+    w = jnp.zeros(24, jnp.uint32).at[3].set(2).at[17].set(1).at[9].set(3)
+    data, stats = run_rounds(
+        cfg, topo, data, 14,
+        writes_fn=lambda r: w if r < 5 else jnp.zeros(24, jnp.uint32),
+        seed=seed,
+    )
+    return data, stats
+
+
+@pytest.mark.parametrize("cohorts", [False, True], ids=["phase", "cohort"])
+@pytest.mark.parametrize("digest", [False, True], ids=["exact", "digest"])
+def test_batched_scoring_bit_identical_to_looped(cohorts, digest):
+    """Batched candidate scoring + grants == the looped reference:
+    identical post-sync DataState (hence identical peer selection — a
+    different selection changes what is granted) and identical per-round
+    applied_sync/sessions stats, in both scoring modes, on both
+    sync_round paths."""
+    old_exact = gossip._EXACT_SCORE_MAX
+    if digest:
+        gossip._EXACT_SCORE_MAX = 0  # force the total-progress digest
+    try:
+        assert gossip._BATCHED_SYNC is True  # default under test
+        _clear_sync_caches()
+        batched, stats_b = _one_sync_run(cohorts)
+        gossip._BATCHED_SYNC = False
+        _clear_sync_caches()
+        looped, stats_l = _one_sync_run(cohorts)
+    finally:
+        gossip._BATCHED_SYNC = True
+        gossip._EXACT_SCORE_MAX = old_exact
+        _clear_sync_caches()
+    assert_states_equal(batched, looped, msg=f"cohorts={cohorts}")
+    for r, ((_, sb), (_, sl)) in enumerate(zip(stats_b, stats_l)):
+        for k in ("applied_sync", "sessions", "cell_merges"):
+            assert int(sb[k]) == int(sl[k]), f"round {r} stat {k}"
+
+
+def test_batched_scoring_converges_with_digest_mode():
+    """Digest-mode selection still heals the cluster (the heuristic only
+    affects which peers are pulled; grants recompute the real deficit)."""
+    old_exact = gossip._EXACT_SCORE_MAX
+    gossip._EXACT_SCORE_MAX = 0
+    try:
+        _clear_sync_caches()
+        data, _ = _one_sync_run(cohorts=True)
+    finally:
+        gossip._EXACT_SCORE_MAX = old_exact
+        _clear_sync_caches()
+    heads = np.asarray(data.head)
+    assert (np.asarray(data.contig) == heads[None, :]).all()
+
+
+# ---------------------------------------------------------------------------
+# 2. Native scatter/gather vs dense one-hot primitives
+
+
+def _both_paths(fn):
+    """Evaluate ``fn()`` under the native and dense onehot paths."""
+    old = onehot._NATIVE_SCATTER
+    try:
+        onehot._NATIVE_SCATTER = True
+        native = fn()
+        onehot._NATIVE_SCATTER = False
+        dense = fn()
+    finally:
+        onehot._NATIVE_SCATTER = old
+    return native, dense
+
+
+def test_onehot_primitives_native_equals_dense():
+    k = jax.random.PRNGKey(0)
+    r, m, w = 17, 23, 41
+    # Indices deliberately include out-of-range values on both sides;
+    # both paths must treat them as contributing nothing / yielding 0.
+    idx = jax.random.randint(k, (r, m), -3, w + 4)
+    val = jax.random.randint(
+        jax.random.fold_in(k, 1), (r, m), 0, 1 << 30
+    ).astype(jnp.uint32)
+    mask = jax.random.bernoulli(jax.random.fold_in(k, 2), 0.7, (r, m))
+    table = jax.random.randint(
+        jax.random.fold_in(k, 3), (r, w), 0, 1 << 30
+    ).astype(jnp.uint32)
+    idx_in = jnp.clip(idx, 0, w - 1)
+
+    for name, fn in {
+        "rowmax": lambda: onehot.rowmax(idx, val, mask, w),
+        "rowmax_nomask": lambda: onehot.rowmax(idx, val, None, w),
+        "rowsum": lambda: onehot.rowsum(idx, val, mask, w),
+        "rowgather": lambda: onehot.rowgather(table, idx),
+        "rowgather_wide": lambda: onehot.rowgather_wide(table, idx_in),
+        "table_gather": lambda: onehot.table_gather_u32(
+            table[0], idx_in
+        ),
+    }.items():
+        native, dense = _both_paths(fn)
+        np.testing.assert_array_equal(
+            np.asarray(native), np.asarray(dense), err_msg=name
+        )
+
+
+def test_gossip_rounds_native_equals_dense():
+    """Whole broadcast+sync rounds (delivery, window, CRDT merge, grant
+    enumeration, visibility) are bit-identical across the backend-native
+    and dense one-hot paths."""
+
+    def one():
+        _clear_round_caches()
+        cfg, topo, data = mk(
+            24, regions=[8, 8, 8], sync_interval=3, n_cells=32,
+            cells_per_write=2, loss_prob=0.2, cohorts=True,
+        )
+        w = jnp.zeros(24, jnp.uint32).at[5].set(3).at[20].set(2)
+        data, _ = run_rounds(
+            cfg, topo, data, 12,
+            writes_fn=lambda r: w if r < 4 else jnp.zeros(24, jnp.uint32),
+        )
+        sw = jnp.asarray([5, 20], jnp.int32)
+        sv = jnp.asarray([2, 1], jnp.uint32)
+        vis = gossip.visibility(data, sw, sv)
+        return data, np.asarray(vis)
+
+    (d_nat, v_nat), (d_den, v_den) = _both_paths(one)
+    _clear_round_caches()
+    assert_states_equal(d_nat, d_den, msg="native vs dense")
+    np.testing.assert_array_equal(v_nat, v_den)
+
+
+# ---------------------------------------------------------------------------
+# 3. Donation safety
+
+
+def _tiny_cluster(rounds=9):
+    """A lean ClusterConfig (small cell plane, default queue) — the
+    donation contract is config-independent, and the flagship builder's
+    1024-cell trace would quadruple this module's compile wall. Chunk
+    length 3 is shared by every donation test below so the scan compiles
+    once for the whole module."""
+    import numpy as np
+
+    from corrosion_tpu.ops.swim import SwimConfig
+    from corrosion_tpu.sim.engine import ClusterConfig, Schedule
+
+    n = 24
+    g = gossip.GossipConfig(
+        n_nodes=n, n_writers=n, sync_interval=3, n_cells=16,
+        cells_per_write=1,
+    )
+    s = SwimConfig(
+        n_nodes=n, max_transmissions=4, suspect_rounds=3, gossip_fanout=3
+    )
+    topo = gossip.make_topology(
+        [n // 2, n // 2], list(range(n)), sync_interval=g.sync_interval
+    )
+    writes = np.zeros((rounds, n), np.uint32)
+    writes[:3, :4] = 2
+    sched = Schedule(writes=writes).make_samples(16)
+    return ClusterConfig(swim=s, gossip=g), topo, sched
+
+
+def test_donation_keeps_compile_cache_count_at_one():
+    """A uniformly-chunked run compiles one donated scan executable (the
+    ownership copy makes chunk 1 donatable too): every jitted entry in
+    the engine module holds <= 1 compile-cache entry — the CT031 retrace
+    tripwire invariant, donation included."""
+    from corrosion_tpu.sim import engine as engine_mod
+    from corrosion_tpu.sim.engine import simulate
+
+    cfg, topo, sched = _tiny_cluster(rounds=9)
+    jax.clear_caches()
+    simulate(cfg, topo, sched, seed=0, max_chunk=3)
+    for name in dir(engine_mod):
+        fn = getattr(engine_mod, name, None)
+        if callable(fn) and hasattr(fn, "_cache_size"):
+            assert fn._cache_size() <= 1, (
+                f"engine.{name} holds {fn._cache_size()} compile-cache "
+                f"entries — donation must not add cache entries"
+            )
+    # The donated scan actually ran and compiled exactly once.
+    assert engine_mod._scan_rounds_donated._cache_size() == 1
+
+
+def test_donated_round_entry_points_bit_identical_and_released():
+    """broadcast/sync/cluster_round donated twins: same results as the
+    plain entries from an identical input, and the donated input's
+    buffers are actually released (reading them afterwards raises)."""
+    from corrosion_tpu.sim import engine as engine_mod
+    from corrosion_tpu.sim.engine import init_cluster
+
+    cfg, topo, sched = _tiny_cluster()
+    n_regions = int(np.asarray(topo.region).max()) + 1
+    part = jnp.zeros((n_regions, n_regions), bool)
+    kill = jnp.zeros((1,), bool)
+    writes = jnp.asarray(sched.writes[0], jnp.uint32)
+    s_w = jnp.asarray(sched.sample_writer)
+    s_v = jnp.asarray(sched.sample_ver)
+    s_r = jnp.asarray(sched.sample_round)
+    key = jax.random.PRNGKey(7)
+
+    # One plain round first: donation requires a device-execution output
+    # (a fresh init may share constant buffers between zero leaves).
+    state0 = init_cluster(cfg, len(sched.sample_writer))
+    state1, _ = engine_mod.cluster_round(
+        state0, topo, writes, part, kill, kill, s_w, s_v, s_r, key, cfg,
+        False,
+    )
+    plain, _ = engine_mod.cluster_round(
+        state1, topo, writes, part, kill, kill, s_w, s_v, s_r, key, cfg,
+        False,
+    )
+    donated, _ = engine_mod.cluster_round_donated(
+        state1, topo, writes, part, kill, kill, s_w, s_v, s_r, key, cfg,
+        False,
+    )
+    for name in ("head", "contig", "seen"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(plain.data, name)),
+            np.asarray(getattr(donated.data, name)),
+            err_msg=name,
+        )
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(state1.data.contig)
+
+    # Leaf ops: same contract.
+    g = cfg.gossip
+    data1 = donated.data
+    alive = donated.swim.alive
+    b_plain, _ = gossip.broadcast_round(
+        data1, topo, alive, part, writes, key, g
+    )
+    b_don, _ = gossip.broadcast_round_donated(
+        data1, topo, alive, part, writes, key, g
+    )
+    assert_states_equal(b_plain, b_don, msg="broadcast donated")
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(data1.contig)
+    s_plain, _ = gossip.sync_round(
+        b_don, topo, alive, part, jnp.int32(3), key, g
+    )
+    s_don, _ = gossip.sync_round_donated(
+        b_don, topo, alive, part, jnp.int32(3), key, g
+    )
+    assert_states_equal(s_plain, s_don, msg="sync donated")
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(b_don.contig)
+
+
+def test_simulate_chunked_donation_bit_identical():
+    """The chunked simulate path (every chunk through the donated scan)
+    equals the unchunked path bit-for-bit — fault-free traces and final
+    state unchanged by donation."""
+    from corrosion_tpu.sim.engine import simulate
+
+    cfg, topo, sched = _tiny_cluster(rounds=9)
+    final_a, curves_a = simulate(cfg, topo, sched, seed=0)
+    final_b, curves_b = simulate(cfg, topo, sched, seed=0, max_chunk=3)
+    assert_states_equal(final_a.data, final_b.data, msg="chunked")
+    np.testing.assert_array_equal(
+        np.asarray(final_a.vis_round), np.asarray(final_b.vis_round)
+    )
+    for k in curves_a:
+        np.testing.assert_array_equal(curves_a[k], curves_b[k], err_msg=k)
+
+
+def test_caller_supplied_state_never_donated():
+    """simulate() must not consume a caller's resume state: the snapshot
+    stays readable and replays to the same result (checkpoint flows and
+    the chaos suite re-read it). Chunk length 3 reuses the module's one
+    compiled scan."""
+    from corrosion_tpu.sim.engine import simulate
+
+    cfg, topo, sched = _tiny_cluster(rounds=9)
+    import dataclasses
+
+    head = dataclasses.replace(sched, writes=sched.writes[:3])
+    tail = dataclasses.replace(sched, writes=sched.writes[3:])
+    snap, _ = simulate(cfg, topo, head, seed=0)
+    out1, _ = simulate(cfg, topo, tail, seed=0, state=snap, max_chunk=3)
+    out2, _ = simulate(cfg, topo, tail, seed=0, state=snap, max_chunk=3)
+    np.asarray(snap.data.contig)  # still alive — never donated
+    assert_states_equal(out1.data, out2.data, msg="resume replay")
+
+
+# ---------------------------------------------------------------------------
+# 4. Bench-report invariants + smoke budget gate
+
+
+def test_check_bench_invariants_accepts_consistent_report():
+    rep = {
+        "step_ms": 100.0,
+        "step_inner_ms": 90.0,
+        "plane_ms": {"swim": 10.0, "broadcast": 50.0, "sync": 30.0},
+        "residual_ms": 10.0,
+        "step_ms_100k": 50.0,
+        "step_inner_ms_100k": 49.0,
+    }
+    assert telemetry.check_bench_invariants(rep) is rep
+
+
+def test_check_bench_invariants_rejects_r05_shape():
+    # The BENCH_r05 anomaly: inner > step, planes summing to the raw
+    # composite instead of partitioning step_ms. ValueError, not assert:
+    # the guarantee must survive `python -O`.
+    with pytest.raises(ValueError, match="step_inner_ms"):
+        telemetry.check_bench_invariants(
+            {"step_ms": 1189.1, "step_inner_ms": 1545.2}
+        )
+    with pytest.raises(ValueError, match="partition"):
+        telemetry.check_bench_invariants(
+            {
+                "step_ms": 1189.1,
+                "plane_ms": {"swim": 53.8, "broadcast": 807.6},
+                "residual_ms": 0.2,
+            }
+        )
+
+
+def test_bench_budget_gate():
+    measured = {
+        "step_ms": 100.0,
+        "plane_ms": {"broadcast": 60.0, "sync": 30.0},
+    }
+    budget = {
+        "tolerance": 1.5,
+        "step_ms": 80.0,
+        "plane_ms": {"broadcast": 50.0, "sync": 5.0, "track": 1.0},
+    }
+    ok, breaches = benchlib.check_budget(measured, budget)
+    assert not ok
+    joined = "\n".join(breaches)
+    # step 100 <= 80*1.5 -> fine; broadcast 60 <= 75 fine; sync 30 > 7.5
+    # breaches; track missing from the measurement breaches.
+    assert "plane_ms.sync" in joined and "plane_ms.track" in joined
+    assert "step_ms" not in joined and "broadcast" not in joined
+    ok2, breaches2 = benchlib.check_budget(
+        {"step_ms": 10.0, "plane_ms": {"broadcast": 1.0, "sync": 1.0,
+                                       "track": 0.5}},
+        budget,
+    )
+    assert ok2 and not breaches2
+    # A bench-shape drift must breach: ceilings measured at one shape
+    # cannot gate a differently-shaped measurement.
+    ok3, breaches3 = benchlib.check_budget(
+        {"nodes": 64, "rounds": 48, "step_ms": 10.0,
+         "plane_ms": {"broadcast": 1.0, "sync": 1.0, "track": 0.5}},
+        {**budget, "nodes": 128, "rounds": 48},
+    )
+    assert not ok3 and "rerun with --update" in "\n".join(breaches3)
