@@ -223,7 +223,18 @@ def main() -> None:
         print(f"[bench] 100k: {json.dumps(extra_100k)}", file=sys.stderr)
 
     p99 = lat["p99_s"]
+    from corrosion_tpu.ops import onehot
+
     report = {
+        # Self-describing provenance (check_bench_invariants asserts the
+        # presence of platform / nodes / device_count /
+        # config_fingerprint): the r05 incident was a CPU-fallback run
+        # published under the TPU metric name — with these fields a
+        # fallback artifact is unmistakable from the JSON alone.
+        **benchlib.bench_context(cfg, n, rounds, chunk),
+        "nodes": n,
+        "rounds": rounds,
+        "kernels": onehot.resolve_backend(cfg.gossip.kernel_backend),
         "metric": "p99_change_visibility_10k",
         "value": round(p99, 2),
         "unit": "s",

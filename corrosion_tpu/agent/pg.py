@@ -175,16 +175,33 @@ def _ready(status: bytes = b"I") -> bytes:
     return _msg(b"Z", status)
 
 
+def _write_verb_tokens(sql: str) -> list:
+    """Write keywords appearing as real statement verbs: identifier
+    tokens (never inside strings/comments) whose next significant token
+    is NOT ``(`` — ``replace(x, 'a', 'b')`` is the SQL function, not the
+    REPLACE statement, and must not drag a read-only query onto the
+    write path (it bypasses the read pool AND mislabels the
+    CommandComplete tag)."""
+    toks = pgsql.tokenize(sql)
+    verbs = []
+    for i, t in enumerate(toks):
+        if t.kind != "ident" or t.text.lower() not in (
+            "insert", "update", "delete", "replace"
+        ):
+            continue
+        j = pgsql._sig(toks, i, 1)
+        if j >= 0 and toks[j].text == "(":
+            continue  # function-call form, e.g. replace(col, 'a', 'b')
+        verbs.append(t)
+    return verbs
+
+
 def _contains_write_tokens(sql: str) -> bool:
-    """Any write keyword as a real token (not inside strings/comments) —
-    the shape check for CTEs feeding writes (WITH ... INSERT ...), which
-    a head-word test misroutes to the read pool, bypassing version
-    assignment."""
-    return any(
-        t.kind == "ident"
-        and t.text.lower() in ("insert", "update", "delete", "replace")
-        for t in pgsql.tokenize(sql)
-    )
+    """Any write keyword as a real statement verb (not inside strings/
+    comments, not a function call) — the shape check for CTEs feeding
+    writes (WITH ... INSERT ...), which a head-word test misroutes to
+    the read pool, bypassing version assignment."""
+    return bool(_write_verb_tokens(sql))
 
 
 def _is_query(sql: str) -> bool:
@@ -779,15 +796,14 @@ def _nominal_insert_count(sql: str) -> int:
 
 def _dml_word(sql: str) -> str:
     """The top-level DML verb for the CommandComplete tag: a WITH-headed
-    write reports its underlying INSERT/UPDATE/DELETE like PostgreSQL."""
+    write reports its underlying INSERT/UPDATE/DELETE like PostgreSQL.
+    Function-call uses of the verb words (``replace(...)`` inside a CTE
+    body) are skipped, so the tag names the real top-level verb."""
     word = sql.split(None, 1)[0].upper() if sql.split(None, 1) else ""
     if word != "WITH":
         return word
-    for t in pgsql.tokenize(sql):
-        if t.kind == "ident" and t.text.lower() in (
-            "insert", "update", "delete", "replace"
-        ):
-            return t.text.upper()
+    for t in _write_verb_tokens(sql):
+        return t.text.upper()
     return word
 
 

@@ -101,6 +101,12 @@ class GossipConfig:
     # derivation keys on identity, not slot index — slot reuse across
     # epochs must never collide cell keys. Requires topo.writer_ids.
     track_writer_ids: bool = False
+    # One-hot/kernel backend for every delivery/sync primitive (see
+    # ops/onehot.resolve_backend): "native" (CPU scatter/gather),
+    # "dense" (one-hot broadcast / MXU), "pallas" (fused VMEM kernels,
+    # interpret-mode off-TPU), or None = auto by platform. Static, so
+    # the choice bakes into the trace like every other config field.
+    kernel_backend: str | None = None
 
     def __post_init__(self):
         if self.window_k < 0 or self.window_k % 32 != 0:
@@ -122,6 +128,13 @@ class GossipConfig:
             raise ValueError(
                 f"queue_priority must be 'version' or 'budget', got "
                 f"{self.queue_priority!r}"
+            )
+        if self.kernel_backend is not None and (
+            self.kernel_backend not in onehot.BACKENDS
+        ):
+            raise ValueError(
+                f"kernel_backend must be one of {onehot.BACKENDS} or "
+                f"None, got {self.kernel_backend!r}"
             )
 
     @property
@@ -325,15 +338,30 @@ def _window_admit(
     d: jax.Array,  # u32[N, K] true delta of each message above contig_pre
     valid: jax.Array,  # bool[N, K] live, deduped messages (sentinels out)
     wk: int,
-    gather_word,  # (u32[N, W]) -> u32[N, K]: per-message word lookup
-    assemble_word,  # (u32[N, K]) -> u32[N, W]: OR contributions by writer
+    gather_word=None,  # (u32[N, W]) -> u32[N, K]: per-message word lookup
+    assemble_word=None,  # (u32[N, K]) -> u32[N, W]: OR contributions
+    fast_idx: jax.Array | None = None,  # i32[N, K] writer column (fast path)
+    width: int | None = None,
+    backend: str | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Shared out-of-order admission for both delivery paths (they differ
     only in gather/scatter primitive): decide which arrivals land in the
     window, assemble their bits, absorb. Each admitted (row, writer, bit)
     is unique — ``valid`` is deduped and already-set bits are masked — so
-    the assemble step's ADD is an exact bitwise OR. Returns
-    (contig', oo', newly_possessed mask)."""
+    the assemble step's ADD is an exact bitwise OR. The fast path passes
+    its writer-column index (``fast_idx``) instead of lambdas, routing
+    through ``onehot.window_delivery`` — under the pallas backend the
+    gathers, the old-bit check, and the bit assembly fuse into one VMEM
+    kernel; elsewhere that helper is the identical rowgather/rowsum
+    composition. Returns (contig', oo', newly_possessed mask)."""
+    if fast_idx is not None:
+        new_poss, words = onehot.window_delivery(
+            oo, fast_idx, d, adv_m, valid, wk, width, backend=backend
+        )
+        contig2, oo2 = window_absorb(
+            contig_pre, oo, adv.astype(jnp.int32), words
+        )
+        return contig2, oo2, new_poss
     d_rel = d - adv_m  # meaningful only when d > adv_m
     in_win = valid & (d > adv_m) & (d_rel <= jnp.uint32(wk))
     # Already possessed in the OLD window (bit d-1 relative to contig_pre)?
@@ -398,6 +426,42 @@ _BATCHED_SYNC = True
 # from the exact per-writer deficit to the total-progress digest
 # (module-level so tests can force digest mode at small sizes).
 _EXACT_SCORE_MAX = 1 << 25
+# Digest-path quantization (the exact path is untouched — it must stay
+# bit-identical). The digest deficit saturates at the largest integer the
+# narrow dtype represents EXACTLY, then casts: below saturation the
+# quantized digest is the identity on the u32 deficit, so peer ranking is
+# provably unchanged (the property tests in tests/test_perf_plane.py pin
+# rank-equality across the exact<->digest threshold); at or above
+# saturation candidates tie on need and the ring tie-break decides.
+# Saturated ties are harmless ONLY while the session budget itself sits
+# at or below the saturation point (every tied candidate fills the pull),
+# so quantization is GATED on cfg.sync_budget <= sat — larger budgets
+# keep the unclamped u32 digest, where ranking among deep deficits still
+# changes what a session can drain. None = legacy unclamped u32 scoring.
+# bf16 default: its 256 exact-integer saturation point covers the default
+# sync_budget (256); "u8" needs sync_budget <= 255 to engage.
+_DIGEST_QUANT: str | None = "bf16"
+_DIGEST_SAT = {"u8": 255, "bf16": 256}
+
+
+def digest_quantize(defc: jax.Array, sync_budget: int) -> jax.Array:
+    """u32 digest deficit -> the quantized wire/score representation
+    (u8 or bf16, saturating), or i32 passthrough when disabled or when
+    ``sync_budget`` exceeds the dtype's exact-integer saturation point
+    (outside the provably-harmless regime)."""
+    if _DIGEST_QUANT is None or sync_budget > _DIGEST_SAT[_DIGEST_QUANT]:
+        return defc.astype(jnp.int32)
+    sat = jnp.uint32(_DIGEST_SAT[_DIGEST_QUANT])
+    q = jnp.minimum(defc, sat)
+    if _DIGEST_QUANT == "u8":
+        return q.astype(jnp.uint8)
+    return q.astype(jnp.bfloat16)
+
+
+def _digest_score(defc: jax.Array, sync_budget: int) -> jax.Array:
+    """Quantize a u32 digest deficit and widen back to i32 for the packed
+    need/ring score. Exact (identity) below the saturation threshold."""
+    return digest_quantize(defc, sync_budget).astype(jnp.int32)
 
 
 def _merge_versions_dense(
@@ -419,6 +483,7 @@ def _merge_versions_dense(
     value_rank among winners."""
     k = cfg.n_cells
     r = writer.shape[0]
+    bk = onehot.resolve_backend(cfg.kernel_backend)
     if rows is None:
         cl2 = cells.cl.reshape(n_nodes, k)
         cv2 = cells.col_version.reshape(n_nodes, k)
@@ -435,11 +500,16 @@ def _merge_versions_dense(
         packed_state = (cl2 << 24) | cv2
         packed_in = (ccl << 24) | ccv
         p1 = jnp.maximum(
-            packed_state, _onehot_rowmax(ckey, packed_in, mask, k)
+            packed_state,
+            _onehot_rowmax(ckey, packed_in, mask, k, backend=bk),
         )
         vr_seed = jnp.where(p1 == packed_state, vr2, 0)
-        in_win = mask & (packed_in == _onehot_rowgather(p1, ckey))
-        vr2 = jnp.maximum(vr_seed, _onehot_rowmax(ckey, cvr, in_win, k))
+        in_win = mask & (
+            packed_in == _onehot_rowgather(p1, ckey, backend=bk)
+        )
+        vr2 = jnp.maximum(
+            vr_seed, _onehot_rowmax(ckey, cvr, in_win, k, backend=bk)
+        )
         cl2 = p1 >> 24
         cv2 = p1 & jnp.uint32((1 << 24) - 1)
     if rows is None:
@@ -471,6 +541,9 @@ def _broadcast_round(
 ) -> tuple[DataState, dict]:
     n, w_count, q_cap = cfg.n_nodes, cfg.n_writers, cfg.queue
     nodes = jnp.arange(n)
+    # One trace-time backend resolution for the whole round: config
+    # override first, then the onehot module's globals/platform auto.
+    bk = onehot.resolve_backend(cfg.kernel_backend)
     k_near, k_far, k_loss = jax.random.split(rng, 3)
 
     # ---- 1. local writes ---------------------------------------------------
@@ -607,7 +680,9 @@ def _broadcast_round(
             #    forms measure <1 ms each.
             mw_safe = jnp.maximum(m_w, 0)
             contig_pre = contig
-            base_m = _onehot_rowgather(contig_pre, mw_safe)  # u32[N, kk]
+            base_m = _onehot_rowgather(
+                contig_pre, mw_safe, backend=bk
+            )  # u32[N, kk]
             lim = max(kk, wk)
             k2 = lim + 3
             assert w_count * k2 < (1 << 32) - 1, "packed delivery key overflow"
@@ -661,14 +736,15 @@ def _broadcast_round(
                 ok_link & valid2, seg_start
             )
             applied = run & valid2
-            # Dense one-hot reductions over the writer axis (VMEM kernels
-            # at scale): the applied watermark advance per (row, writer) is
-            # the max applied delta (runs are 1..len), and `seen` is the
-            # max heard version.
-            adv = _onehot_rowmax(w2, d2, applied, w_count)  # u32[N, W]
-            seen = jnp.maximum(
-                seen, _onehot_rowmax(w2, v2, valid2, w_count)
-            )
+            # One-hot reductions over the writer axis: the applied
+            # watermark advance per (row, writer) is the max applied
+            # delta (runs are 1..len), and `seen` is the max heard
+            # version. Under the pallas backend both reductions fuse
+            # into one VMEM pass (onehot.delivery_reduce); elsewhere it
+            # is the two-rowmax reference composition, bit-identical.
+            adv, seen = onehot.delivery_reduce(
+                w2, d2, v2, applied, valid2, seen, w_count, backend=bk
+            )  # u32[N, W] x2
             # First receipts: one copy per newly possessed version. Stale
             # and duplicate copies re-merge content already merged when the
             # version was first applied/granted — idempotent, so masking
@@ -722,10 +798,9 @@ def _broadcast_round(
                         d2,
                         valid2 & first_copy & (d2 <= jnp.uint32(lim)),
                         wk,
-                        lambda word: _onehot_rowgather(word, w2),
-                        lambda contrib: onehot.rowsum(
-                            w2, contrib, None, w_count
-                        ),
+                        fast_idx=w2,
+                        width=w_count,
+                        backend=bk,
                     )
                     # Near component: within the clamp limit but beyond the
                     # window above the writer's advance.
@@ -755,7 +830,18 @@ def _broadcast_round(
                 contig = contig_pre + adv
                 oo_new, oo_any_new = data.oo, data.oo_any
                 fresh = fresh_run
-                n_degraded = jnp.sum(valid2 & ~applied, dtype=jnp.uint32)
+                # Windowless degraded count, deduped by (writer, version)
+                # adjacency exactly like the windowed branches: duplicate
+                # same-round copies of one arrival degrade ONE version,
+                # not one per copy (v2 rides the sort as the second key,
+                # so same-version copies are adjacent; the v2 check
+                # matters for sentinel-clamped entries, which share d2
+                # across distinct versions).
+                n_degraded = jnp.sum(
+                    valid2 & ~applied
+                    & ~((~seg_start) & (d2 == prev_d) & (v2 == prev_v2)),
+                    dtype=jnp.uint32,
+                )
             if cfg.n_cells > 0:
                 cells, m = _merge_versions_dense(
                     cells, None, gw2 if track else w2, v2, fresh, None, n,
@@ -801,7 +887,7 @@ def _broadcast_round(
             # as a serialized per-element gather (~17 ms + a 40 ms staging
             # copy at the flagship shapes).
             base = onehot.rowgather_wide(
-                contig, jnp.minimum(w2, w_count - 1)
+                contig, jnp.minimum(w2, w_count - 1), backend=bk
             )
             prev_v = jnp.concatenate(
                 [jnp.zeros((n, 1), v2.dtype), v2[:, :-1]], axis=1
@@ -867,7 +953,9 @@ def _broadcast_round(
                         d_m,
                         valid2 & ~prev_same,
                         wk,
-                        lambda word: onehot.rowgather_wide(word, w2c),
+                        lambda word: onehot.rowgather_wide(
+                            word, w2c, backend=bk
+                        ),
                         lambda contrib: (
                             jnp.zeros((n * w_count,), jnp.uint32)
                             .at[rw2.reshape(-1)]
@@ -898,8 +986,12 @@ def _broadcast_round(
                 contig = contig_run
                 oo_new, oo_any_new = data.oo, data.oo_any
                 extra_poss = jnp.zeros_like(valid2)
+                # First copies only (~prev_same), matching the windowed
+                # branch's dedup: same-round duplicate deliveries of one
+                # (writer, version) degrade a single version.
                 n_degraded = jnp.sum(
-                    valid2 & ~run & (v2 > base), dtype=jnp.uint32
+                    valid2 & ~run & (v2 > base) & ~prev_same,
+                    dtype=jnp.uint32,
                 )
 
             if cfg.n_cells > 0:
@@ -1121,6 +1213,7 @@ def _sync_rows(
     session budget — the reference's 3-10 peers ordered by need."""
     n = cfg.n_nodes
     r = rows.shape[0]
+    bk = onehot.resolve_backend(cfg.kernel_backend)
     k_near, k_far = jax.random.split(rng)
     region_r = topo.region[rows]
     contig0 = data.contig[rows]  # u32[R, W]
@@ -1180,9 +1273,9 @@ def _sync_rows(
             )
         else:
             tc = total[cand]  # u32[R, C]
-            defc = jnp.maximum(
-                tc - jnp.minimum(tc, total_r[:, None]), 0
-            ).astype(jnp.int32)
+            defc = _digest_score(
+                tc - jnp.minimum(tc, total_r[:, None]), cfg.sync_budget
+            )
     else:
         need_cols = []
         for c in range(c_count):
@@ -1202,8 +1295,8 @@ def _sync_rows(
             else:
                 tc = total[cand[:, c]]
                 need_cols.append(
-                    jnp.maximum(tc - jnp.minimum(tc, total_r), 0).astype(
-                        jnp.int32
+                    _digest_score(
+                        tc - jnp.minimum(tc, total_r), cfg.sync_budget
                     )
                 )
         defc = jnp.stack(need_cols, axis=1)  # i32[R, C]
@@ -1385,7 +1478,7 @@ def _sync_rows(
                 # scatter-marks + cummax formulation serialized an [R·B]
                 # scatter, ~120 ms at the 100k cohort). Identical counts:
                 # side="right" on a non-decreasing row IS the <= count.
-                if onehot._use_native():
+                if bk == "native":
                     w_idx = jax.vmap(
                         lambda c: jnp.searchsorted(c, e, side="right")
                     )(cum).astype(jnp.int32)
@@ -1400,12 +1493,14 @@ def _sync_rows(
                 prev = jnp.where(
                     w_idx > 0,
                     _onehot_rowgather(
-                        cum.astype(jnp.uint32), jnp.maximum(w_idx - 1, 0)
+                        cum.astype(jnp.uint32),
+                        jnp.maximum(w_idx - 1, 0),
+                        backend=bk,
                     ).astype(jnp.int32),
                     0,
                 )
                 ver = (
-                    _onehot_rowgather(contig0, w_idx)
+                    _onehot_rowgather(contig0, w_idx, backend=bk)
                     + 1
                     + (e[None, :] - prev).astype(jnp.uint32)
                 )
@@ -1490,8 +1585,11 @@ def _sync_rows(
             mask = e[None, :] < total_g[:, None]  # [R, B]
             if cfg.track_writer_ids:
                 # Slot -> global id via the shared-table one-hot gather
-                # (a flat [R, B] fancy-index gather serializes on TPU).
-                w_merge = onehot.table_gather_u32(topo.writer_ids, w_idx)
+                # (a flat [R, B] fancy-index gather serializes on TPU;
+                # the pallas backend accumulates native u32 on chip).
+                w_merge = onehot.table_gather_u32(
+                    topo.writer_ids, w_idx, backend=bk
+                )
             else:
                 w_merge = w_idx
             # Row-dense merge (cohort rows only): gathers the cohort's cell
@@ -1681,27 +1779,49 @@ def queue_backlog(data: DataState) -> jax.Array:
     return jnp.sum(data.q_writer >= 0, dtype=jnp.uint32)
 
 
-def visibility(data: DataState, sample_writer: jax.Array, sample_ver: jax.Array) -> jax.Array:
+def visibility(
+    data: DataState,
+    sample_writer: jax.Array,
+    sample_ver: jax.Array,
+    backend: str | None = None,
+) -> jax.Array:
     """bool[S, N]: is sampled write s visible at each node yet? Visible =
     at or below the contiguous watermark, OR possessed out-of-order in the
     window (the reference applies complete versions in any order —
     agent.rs:1809-2060 — so an applied version is queryable immediately).
 
     On accelerators the column gather contig[:, sample_writer] is strided
-    and lowers poorly at [100k, 512]→[100k, S]; a one-hot f32 matmul
-    rides the MXU instead (exact: one nonzero per output column, values
-    < 2^24 in f32 with HIGHEST precision; window words split into u16
-    halves for the same exactness). On CPU the plain column gather is a
-    tight loop and the matmul is pure overhead — same u32 compares, same
-    bits, chosen at trace time."""
+    and lowers poorly at [100k, 512]→[100k, S]; the dense backend rides a
+    one-hot f32 matmul on the MXU instead (exact: one nonzero per output
+    column, values < 2^24 in f32 with HIGHEST precision; window words
+    split into u16 halves for the same exactness), while the pallas
+    backend gathers native u32 through the rowgather kernel — no halves.
+    On CPU the plain column gather is a tight loop and both kernel forms
+    are pure overhead — same u32 compares, same bits, chosen at trace
+    time. The engine drivers thread ``GossipConfig.kernel_backend`` in
+    via ``backend``."""
     w = data.contig.shape[1]
-    native = onehot._use_native()
-    if native:
+    bk = onehot.resolve_backend(backend)
+    if bk == "native":
         cols = jnp.clip(sample_writer.astype(jnp.int32), 0, w - 1)
 
         def _cols(x):  # u32[N, W] -> u32[N, S]
             return x[:, cols]
 
+    elif bk == "pallas":
+        n = data.contig.shape[0]
+        s = sample_writer.shape[0]
+        cols2d = jnp.broadcast_to(
+            jnp.clip(sample_writer.astype(jnp.int32), 0, w - 1)[None, :],
+            (n, s),
+        )
+
+        def _cols(x):  # u32[N, W] -> u32[N, S]
+            return onehot.rowgather(x, cols2d, backend="pallas")
+
+    else:
+        _cols = None
+    if _cols is not None:
         c_int = _cols(data.contig)
         vis = c_int >= sample_ver[None, :]  # [N, S]
     else:
@@ -1726,7 +1846,7 @@ def visibility(data: DataState, sample_writer: jax.Array, sample_ver: jax.Array)
         out = vis
         bit = sample_ver[None, :] - c_int - 1  # u32, wraps when visible
         for b in range(oo.shape[0]):
-            if native:
+            if _cols is not None:
                 word = _cols(oo[b])  # [N, S]
             else:
                 lo = _dot(oo[b] & jnp.uint32(0xFFFF)).astype(jnp.uint32)

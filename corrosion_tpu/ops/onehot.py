@@ -15,21 +15,33 @@ select intermediates to HBM when they have multiple consumers — measured
 331 GB of HBM traffic per broadcast round at 100k nodes, ~0.5 s of pure
 bandwidth.
 
-The Pallas kernels below block rows into VMEM tiles and loop over the
-small axis, so the [tile, W] accumulator lives in registers/VMEM and HBM
-traffic is exactly inputs + outputs (a few hundred MB per round). The jnp
-fallback (small shapes, non-TPU accelerator backends) is the same math.
-
 On **CPU** the trade inverts completely: XLA:CPU lowers scatter/gather to
 tight serial loops (no per-element device round-trip), while the dense
 one-hot broadcast does O(R·M·W) compare+select lanes of real work.
 Measured at the 512-node bench shapes: ``rowmax`` 318 ms dense vs 9.5 ms
 native scatter-max, ``rowgather`` 305 ms dense vs 0.9 ms
 ``take_along_axis`` — the whole r05 CPU-fallback bench regression in two
-primitives. Every primitive below therefore dispatches on backend at
-trace time: native scatter/gather on CPU, one-hot/MXU forms elsewhere.
-Results are bit-identical either way (all-integer max/add/select), which
-``tests/test_perf_plane.py`` pins by running both paths.
+primitives.
+
+Every primitive therefore dispatches on a **three-way backend** at trace
+time (``resolve_backend``):
+
+- ``native``  — scatter/gather lowerings (auto-selected on CPU);
+- ``dense``   — one-hot broadcast / MXU matmul forms (auto-selected on
+  accelerators);
+- ``pallas``  — hand-written VMEM-tiled kernels with on-chip
+  accumulation. The delivery-chain kernels (``delivery_reduce``,
+  ``window_delivery``) fuse what the dense path runs as 4-6 separate
+  one-hot launches with full [R, W] HBM round-trips between them; the
+  gather kernels (``rowgather_wide``, ``table_gather_u32``) replace the
+  f32-matmul-halves exactness trick with native u32 compare+max
+  accumulation. Off-TPU the kernels run under
+  ``pallas_call(..., interpret=True)``, so tier-1 pins bit-equality
+  against the other two backends without a TPU.
+
+Results are bit-identical across all three backends (all-integer
+max/add/select), which ``tests/test_perf_plane.py`` pins by running every
+primitive and whole gossip rounds on each path.
 
 Reference anchor: these implement the batched merge/delivery promotions of
 corro-agent's broadcast plane (broadcast/mod.rs:356-567) and the CRDT
@@ -38,6 +50,8 @@ at simulator scale.
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -48,21 +62,32 @@ from jax.experimental import pallas as pl
 # W=512).
 _BLOCK_ROWS = 256
 _SUB_ROWS = 8
+# Lane width of the on-chip gather blocks (table_gather_u32 /
+# rowgather_wide walk the table in blocks of this many columns so the
+# per-sub-tile temporary stays register/VMEM-resident at any W).
+_GATHER_BLK = 128
 # Below this many one-hot lanes (rows·M·width) the jnp broadcast form stays
 # in cache/fusion range and beats a kernel launch.
 _PALLAS_MIN_LANES = 1 << 27
 
 
 def _block_rows(m: int, width: int) -> int:
-    return _BLOCK_ROWS
+    # Adaptive: keep each [bn, W] VMEM buffer under ~1 MB so wide writer
+    # axes (the 10k flagship) still fit several live blocks per program.
+    target = (1 << 20) // max(4 * width, 1)
+    bn = (target // _SUB_ROWS) * _SUB_ROWS
+    return max(_SUB_ROWS, min(_BLOCK_ROWS, bn))
 
 
 def _use_pallas(lanes: int) -> bool:
-    # Off by default: measured on v5e at wan_100k shapes, the fused jnp
-    # broadcast form beat these kernels (567 vs 651 ms broadcast plane) —
-    # XLA's materialized one-hot intermediates still stream at near-HBM
-    # bandwidth while the VMEM-tiled kernels are VPU-throughput-bound.
-    # CORRO_ONEHOT_PALLAS=1 re-enables for experiments.
+    # The LEGACY dense-backend experiment (pre-fusion kernels): measured
+    # on v5e at wan_100k shapes, the fused jnp broadcast form beat these
+    # kernels (567 vs 651 ms broadcast plane) — XLA's materialized
+    # one-hot intermediates still stream at near-HBM bandwidth while the
+    # VMEM-tiled kernels are VPU-throughput-bound.
+    # CORRO_ONEHOT_PALLAS=1 re-enables for experiments; the supported
+    # kernel path is the "pallas" BACKEND (resolve_backend), which fuses
+    # the delivery chain instead of launching per-primitive.
     import os
 
     if os.environ.get("CORRO_ONEHOT_PALLAS", "0") != "1":
@@ -70,18 +95,54 @@ def _use_pallas(lanes: int) -> bool:
     return jax.default_backend() == "tpu" and lanes >= _PALLAS_MIN_LANES
 
 
-# Backend dispatch for the native scatter/gather forms. None = auto
-# (native on CPU, dense one-hot elsewhere); tests force either path via
-# the module global (the _FAST_MAX_WRITERS override convention) — flip it
-# BEFORE tracing, or clear_cache() the jitted callers, since the choice
-# is baked in at trace time.
+# -- backend dispatch ---------------------------------------------------------
+#
+# Trace-time three-way dispatch. Resolution order (first set wins):
+# explicit ``backend=`` argument (how GossipConfig.kernel_backend reaches
+# the primitives through the engine drivers), the ``_BACKEND`` module
+# global, the legacy ``_NATIVE_SCATTER`` bool global (True -> "native",
+# False -> "dense" — the PR 5 test convention), the
+# ``CORRO_ONEHOT_BACKEND`` env var, then auto: native on CPU, dense on
+# accelerators. Flip globals BEFORE tracing, or clear_cache() the jitted
+# callers, since the choice is baked in at trace time.
+
+BACKENDS = ("native", "dense", "pallas")
+
 _NATIVE_SCATTER: bool | None = None
+_BACKEND: str | None = None
 
 
-def _use_native() -> bool:
+def resolve_backend(override: str | None = None) -> str:
+    import os
+
+    for choice in (override, _BACKEND):
+        if choice is not None:
+            if choice not in BACKENDS:
+                raise ValueError(
+                    f"unknown onehot backend {choice!r}; expected one of "
+                    f"{BACKENDS}"
+                )
+            return choice
     if _NATIVE_SCATTER is not None:
-        return _NATIVE_SCATTER
-    return jax.default_backend() == "cpu"
+        return "native" if _NATIVE_SCATTER else "dense"
+    env = os.environ.get("CORRO_ONEHOT_BACKEND")
+    if env:
+        if env not in BACKENDS:
+            raise ValueError(
+                f"CORRO_ONEHOT_BACKEND={env!r}; expected one of {BACKENDS}"
+            )
+        return env
+    return "native" if jax.default_backend() == "cpu" else "dense"
+
+
+def _use_native(backend: str | None = None) -> bool:
+    return resolve_backend(backend) == "native"
+
+
+def _interpret() -> bool:
+    # Off-TPU the Mosaic lowering is unavailable; interpret mode runs the
+    # identical kernel math as XLA ops, so CPU CI pins bit-equality.
+    return jax.default_backend() != "tpu"
 
 
 def _pad_rows(x: jax.Array, rows_p: int):
@@ -89,6 +150,14 @@ def _pad_rows(x: jax.Array, rows_p: int):
     if rows_p == r:
         return x
     pad = [(0, rows_p - r)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad)
+
+
+def _pad_axis(x: jax.Array, axis: int, size_p: int):
+    if x.shape[axis] == size_p:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, size_p - x.shape[axis])
     return jnp.pad(x, pad)
 
 
@@ -126,38 +195,8 @@ def _rowmax_kernel(idx_ref, val_ref, out_ref):
     jax.lax.fori_loop(0, bn // _SUB_ROWS, body, 0)
 
 
-def rowmax(
-    idx: jax.Array,  # i32[R, M] column index per entry (any value ok if masked)
-    val: jax.Array,  # u32[R, M]
-    mask: jax.Array | None,  # bool[R, M] live entries (None = all)
-    width: int,
-) -> jax.Array:
-    """out[r, x] = max over masked m with idx[r, m] == x of val[r, m], 0
-    when none. Masked/out-of-range entries contribute nothing."""
+def _rowmax_pallas(idx, val, width: int):
     r, m = idx.shape
-    val = val.astype(jnp.uint32)
-    if mask is not None:
-        idx = jnp.where(mask, idx, -1)
-        val = jnp.where(mask, val, 0)
-    if _use_native():
-        # Native row-local scatter-max. Out-of-range/masked entries route
-        # to a dropped sentinel column (scatter mode="drop" ignores them
-        # — same contribution as the dense form's missed compare).
-        rows = jnp.arange(r, dtype=jnp.int32)[:, None]
-        safe = jnp.where((idx >= 0) & (idx < width), idx, width)
-        return (
-            jnp.zeros((r, width), jnp.uint32)
-            .at[rows, safe]
-            .max(val, mode="drop")
-        )
-    if not _use_pallas(r * m * width):
-        # Reduce over the MINOR-MOST axis: [R, W, M] with the M messages
-        # last lets XLA fuse the compare+select straight into a row
-        # reduction (the [R, M, W] middle-axis form materialized ~30 GB
-        # per call at wan_100k shapes).
-        ids = jnp.arange(width, dtype=idx.dtype)
-        hit = idx[:, None, :] == ids[None, :, None]
-        return jnp.max(jnp.where(hit, val[:, None, :], 0), axis=2)
     bn = _block_rows(m, width)
     rows_p = -(-r // bn) * bn
     out = pl.pallas_call(
@@ -169,28 +208,133 @@ def rowmax(
             pl.BlockSpec((bn, m), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((bn, width), lambda i: (i, 0)),
+        interpret=_interpret(),
     )(_pad_rows(idx.astype(jnp.int32), rows_p), _pad_rows(val, rows_p))
     return out[:r]
+
+
+def rowmax(
+    idx: jax.Array,  # i32[R, M] column index per entry (any value ok if masked)
+    val: jax.Array,  # u32[R, M]
+    mask: jax.Array | None,  # bool[R, M] live entries (None = all)
+    width: int,
+    backend: str | None = None,
+) -> jax.Array:
+    """out[r, x] = max over masked m with idx[r, m] == x of val[r, m], 0
+    when none. Masked/out-of-range entries contribute nothing."""
+    r, m = idx.shape
+    if r == 0 or m == 0 or width == 0:
+        # Degenerate axes: no entry contributes anywhere (what the
+        # native scatter produces; the dense reduce and the kernels
+        # cannot shape an empty reduction).
+        return jnp.zeros((r, width), jnp.uint32)
+    val = val.astype(jnp.uint32)
+    if mask is not None:
+        idx = jnp.where(mask, idx, -1)
+        val = jnp.where(mask, val, 0)
+    bk = resolve_backend(backend)
+    if bk == "native":
+        # Native row-local scatter-max. Out-of-range/masked entries route
+        # to a dropped sentinel column (scatter mode="drop" ignores them
+        # — same contribution as the dense form's missed compare).
+        rows = jnp.arange(r, dtype=jnp.int32)[:, None]
+        safe = jnp.where((idx >= 0) & (idx < width), idx, width)
+        return (
+            jnp.zeros((r, width), jnp.uint32)
+            .at[rows, safe]
+            .max(val, mode="drop")
+        )
+    if bk == "pallas" or _use_pallas(r * m * width):
+        return _rowmax_pallas(idx, val, width)
+    # Reduce over the MINOR-MOST axis: [R, W, M] with the M messages
+    # last lets XLA fuse the compare+select straight into a row
+    # reduction (the [R, M, W] middle-axis form materialized ~30 GB
+    # per call at wan_100k shapes).
+    ids = jnp.arange(width, dtype=idx.dtype)
+    hit = idx[:, None, :] == ids[None, :, None]
+    return jnp.max(jnp.where(hit, val[:, None, :], 0), axis=2)
 
 
 # -- rowgather_wide -----------------------------------------------------------
 
 
-def rowgather_wide(table: jax.Array, idx: jax.Array, blk: int = 128) -> jax.Array:
+def _rowgather_wide_kernel(table_ref, idx_ref, out_ref):
+    # Per-row WIDE table gather with on-chip accumulation: walk the table
+    # in 128-lane blocks so the [sub, M, 128] compare temporary stays in
+    # registers/VMEM at any W (the flat [sub, M, W] form would be ~46 MB
+    # at the 10k-writer flagship). The accumulator rides the order-
+    # preserving i32 flip (Mosaic can't reduce unsigned ints); the i32-min
+    # floor unflips to the dense form's 0 when nothing hits.
+    bn, w = table_ref.shape
+    m = idx_ref.shape[1]
+    nb = w // _GATHER_BLK
+    ids = jax.lax.broadcasted_iota(
+        jnp.int32, (_SUB_ROWS, m, _GATHER_BLK), 2
+    )
+    floor = jnp.int32(-(2**31))
+
+    def body(t, _):
+        r0 = t * _SUB_ROWS
+        idx = idx_ref[pl.ds(r0, _SUB_ROWS), :]
+        acc = jnp.full((_SUB_ROWS, m), floor, jnp.int32)
+        for j in range(nb):  # static unroll: nb is trace-time
+            tb = _flip(table_ref[
+                pl.ds(r0, _SUB_ROWS), j * _GATHER_BLK : (j + 1) * _GATHER_BLK
+            ])
+            hit = idx[:, :, None] == ids + jnp.int32(j * _GATHER_BLK)
+            acc = jnp.maximum(
+                acc, jnp.max(jnp.where(hit, tb[:, None, :], floor), axis=2)
+            )
+        out_ref[pl.ds(r0, _SUB_ROWS), :] = _unflip(acc)
+        return 0
+
+    jax.lax.fori_loop(0, bn // _SUB_ROWS, body, 0)
+
+
+def rowgather_wide(
+    table: jax.Array, idx: jax.Array, blk: int = 128,
+    backend: str | None = None,
+) -> jax.Array:
     """out[r, m] = table[r, idx[r, m]] for WIDE tables (thousands of
     columns), where both the dense one-hot form (O(R·M·W) lanes) and
     take_along_axis (serialized per-element gather, ~17 ms per 1.4M
     elements on v5e) are losing propositions.
 
-    Two-level: gather each index's 128-wide block with a one-hot f32
+    Dense: gather each index's 128-wide block with a one-hot f32
     matmul on the MXU (u16 halves keep all of u32 exact), then select
-    within the block. idx must be in [0, W)."""
+    within the block. Pallas: native u32 compare+max accumulation over
+    128-lane blocks — no f32 halves. idx must be in [0, W)."""
     r, w = table.shape
+    if r == 0 or idx.shape[1] == 0 or w == 0:
+        return jnp.zeros((r, idx.shape[1]), jnp.uint32)
     table = table.astype(jnp.uint32)
-    if _use_native():
+    bk = resolve_backend(backend)
+    if bk == "native":
         return jnp.take_along_axis(
             table, jnp.clip(idx.astype(jnp.int32), 0, w - 1), axis=1
         )
+    if bk == "pallas":
+        m = idx.shape[1]
+        wp = -(-w // _GATHER_BLK) * _GATHER_BLK
+        bn = _block_rows(m, wp)
+        rows_p = -(-r // bn) * bn
+        out = pl.pallas_call(
+            _rowgather_wide_kernel,
+            out_shape=jax.ShapeDtypeStruct((rows_p, m), jnp.uint32),
+            grid=(rows_p // bn,),
+            in_specs=[
+                pl.BlockSpec((bn, wp), lambda i: (i, 0)),
+                pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            interpret=_interpret(),
+        )(
+            _pad_rows(_pad_axis(table, 1, wp), rows_p),
+            _pad_rows(
+                jnp.clip(idx.astype(jnp.int32), 0, w - 1), rows_p
+            ),
+        )
+        return out[:r]
     nb = -(-w // blk)
     wp = nb * blk
     if wp != w:
@@ -210,7 +354,8 @@ def exact_u32_apply(dot, t: jax.Array) -> jax.Array:
     EXACTLY: the value travels as u16 halves (< 2^24, f32-exact at
     HIGHEST precision) and recombines by shift-OR. The exactness-critical
     idiom lives ONLY here — every one-hot-matmul gather/scatter of u32
-    data routes through it."""
+    data routes through it. (The ``pallas`` backend does not need it:
+    its gather kernels accumulate native u32 on chip.)"""
     t = t.astype(jnp.uint32)
     return (
         dot((t >> 16).astype(jnp.float32)).astype(jnp.uint32) << 16
@@ -235,22 +380,84 @@ def block_matmul_gather_u32(
     return exact_u32_apply(dot, tab)
 
 
+def _table_gather_kernel(table_ref, idx_ref, out_ref):
+    # Shared 1-D table gather, native u32: the table rides VMEM once per
+    # program and each 128-lane block is compared+max-accumulated on
+    # chip — the integer replacement for the f32-matmul-halves form.
+    # Accumulation in the order-preserving i32 flip (Mosaic can't reduce
+    # unsigned ints); the floor unflips to 0 when nothing hits.
+    bn, c = idx_ref.shape
+    w = table_ref.shape[1]
+    nb = w // _GATHER_BLK
+    ids = jax.lax.broadcasted_iota(
+        jnp.int32, (_SUB_ROWS, c, _GATHER_BLK), 2
+    )
+    floor = jnp.int32(-(2**31))
+
+    def body(t, _):
+        r0 = t * _SUB_ROWS
+        idx = idx_ref[pl.ds(r0, _SUB_ROWS), :]
+        acc = jnp.full((_SUB_ROWS, c), floor, jnp.int32)
+        for j in range(nb):  # static unroll
+            tb = _flip(table_ref[0, j * _GATHER_BLK : (j + 1) * _GATHER_BLK])
+            hit = idx[:, :, None] == ids + jnp.int32(j * _GATHER_BLK)
+            acc = jnp.maximum(
+                acc,
+                jnp.max(jnp.where(hit, tb[None, None, :], floor), axis=2),
+            )
+        out_ref[pl.ds(r0, _SUB_ROWS), :] = _unflip(acc)
+        return 0
+
+    jax.lax.fori_loop(0, bn // _SUB_ROWS, body, 0)
+
+
 def table_gather_u32(
     table: jax.Array,  # u32[W] SHARED 1-D table (same for every row)
     idx: jax.Array,  # i32[...] indices in [0, W)
     blk: int = 128,
+    backend: str | None = None,
 ) -> jax.Array:
-    """out[...] = table[idx[...]] without a serialized per-element gather:
-    one-hot f32 matmuls select each index's 128-wide block (u16 halves keep
-    all of u32 exact), then a compare+reduce picks within the block. Unlike
-    rowgather_wide the table is NOT per-row, so the block matmul contracts
-    a [..., NB] one-hot against the shared [NB, blk] table — no broadcast
-    materialization."""
+    """out[...] = table[idx[...]] without a serialized per-element gather.
+
+    Dense: one-hot f32 matmuls select each index's 128-wide block (u16
+    halves keep all of u32 exact), then a compare+reduce picks within the
+    block. Pallas: native u32 compare+max over 128-lane table blocks with
+    on-chip accumulation. Unlike rowgather_wide the table is NOT per-row,
+    so the block matmul contracts a [..., NB] one-hot against the shared
+    [NB, blk] table — no broadcast materialization."""
     w = table.shape[0]
-    if _use_native():
+    if w == 0 or idx.size == 0:
+        return jnp.zeros(idx.shape, jnp.uint32)
+    bk = resolve_backend(backend)
+    if bk == "native":
         return jnp.take(
             table.astype(jnp.uint32), idx.astype(jnp.int32), mode="clip"
         )
+    if bk == "pallas":
+        shape = idx.shape
+        flat = jnp.clip(
+            idx.astype(jnp.int32).reshape(-1), 0, w - 1
+        )
+        p = flat.shape[0]
+        cols = _GATHER_BLK
+        rows = max(1, -(-p // cols))
+        bn = max(_SUB_ROWS, min(_BLOCK_ROWS, -(-rows // _SUB_ROWS) * _SUB_ROWS))
+        rows_p = -(-rows // bn) * bn
+        flat = jnp.pad(flat, (0, rows_p * cols - p)).reshape(rows_p, cols)
+        wp = -(-w // _GATHER_BLK) * _GATHER_BLK
+        tp = _pad_axis(table.astype(jnp.uint32), 0, wp)[None, :]
+        out = pl.pallas_call(
+            _table_gather_kernel,
+            out_shape=jax.ShapeDtypeStruct((rows_p, cols), jnp.uint32),
+            grid=(rows_p // bn,),
+            in_specs=[
+                pl.BlockSpec((1, wp), lambda i: (0, 0)),
+                pl.BlockSpec((bn, cols), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((bn, cols), lambda i: (i, 0)),
+            interpret=_interpret(),
+        )(tp, flat)
+        return out.reshape(-1)[:p].reshape(shape)
     nb = -(-w // blk)
     wp = nb * blk
     tp = table.astype(jnp.uint32)
@@ -303,17 +510,21 @@ def rowsum(
     val: jax.Array,  # u32[R, M]
     mask: jax.Array | None,  # bool[R, M] live entries (None = all)
     width: int,
+    backend: str | None = None,
 ) -> jax.Array:
     """out[r, x] = sum (mod 2^32) over masked m with idx[r, m] == x of
     val[r, m]. With each (r, x, bit) contributed at most once, this is a
     row-local scatter-OR — how the gossip window assembles its possession
     bitmasks without a serialized TPU scatter."""
     r, m = idx.shape
+    if r == 0 or m == 0 or width == 0:
+        return jnp.zeros((r, width), jnp.uint32)
     val = val.astype(jnp.uint32)
     if mask is not None:
         idx = jnp.where(mask, idx, -1)
         val = jnp.where(mask, val, 0)
-    if _use_native():
+    bk = resolve_backend(backend)
+    if bk == "native":
         # Native row-local scatter-add (u32 add is mod 2^32 like the
         # dense sum); out-of-range entries drop, matching the dense
         # form's missed compares.
@@ -324,23 +535,24 @@ def rowsum(
             .at[rows, safe]
             .add(val, mode="drop")
         )
-    if not _use_pallas(r * m * width):
-        ids = jnp.arange(width, dtype=idx.dtype)
-        hit = idx[:, None, :] == ids[None, :, None]
-        return jnp.sum(jnp.where(hit, val[:, None, :], 0), axis=2)
-    bn = _block_rows(m, width)
-    rows_p = -(-r // bn) * bn
-    out = pl.pallas_call(
-        _rowsum_kernel,
-        out_shape=jax.ShapeDtypeStruct((rows_p, width), jnp.uint32),
-        grid=(rows_p // bn,),
-        in_specs=[
-            pl.BlockSpec((bn, m), lambda i: (i, 0)),
-            pl.BlockSpec((bn, m), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((bn, width), lambda i: (i, 0)),
-    )(_pad_rows(idx.astype(jnp.int32), rows_p), _pad_rows(val, rows_p))
-    return out[:r]
+    if bk == "pallas" or _use_pallas(r * m * width):
+        bn = _block_rows(m, width)
+        rows_p = -(-r // bn) * bn
+        out = pl.pallas_call(
+            _rowsum_kernel,
+            out_shape=jax.ShapeDtypeStruct((rows_p, width), jnp.uint32),
+            grid=(rows_p // bn,),
+            in_specs=[
+                pl.BlockSpec((bn, m), lambda i: (i, 0)),
+                pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((bn, width), lambda i: (i, 0)),
+            interpret=_interpret(),
+        )(_pad_rows(idx.astype(jnp.int32), rows_p), _pad_rows(val, rows_p))
+        return out[:r]
+    ids = jnp.arange(width, dtype=idx.dtype)
+    hit = idx[:, None, :] == ids[None, :, None]
+    return jnp.sum(jnp.where(hit, val[:, None, :], 0), axis=2)
 
 
 # -- rowgather ----------------------------------------------------------------
@@ -363,23 +575,9 @@ def _rowgather_kernel(table_ref, idx_ref, out_ref):
     jax.lax.fori_loop(0, bn // _SUB_ROWS, body, 0)
 
 
-def rowgather(table: jax.Array, idx: jax.Array) -> jax.Array:
-    """out[r, m] = table[r, idx[r, m]] (idx must be in range; u32 table)."""
+def _rowgather_pallas(table, idx):
     r, width = table.shape
     m = idx.shape[1]
-    table = table.astype(jnp.uint32)
-    if _use_native():
-        # Native row-local gather; out-of-range indices yield 0 like the
-        # dense form's missed compare (negatives routed to the fill
-        # sentinel — take_along_axis would otherwise wrap them).
-        safe = jnp.where(idx < 0, width, idx.astype(jnp.int32))
-        return jnp.take_along_axis(
-            table, safe, axis=1, mode="fill", fill_value=0
-        )
-    if not _use_pallas(r * m * width):
-        ids = jnp.arange(width, dtype=idx.dtype)
-        hit = idx[:, :, None] == ids[None, None, :]
-        return jnp.max(jnp.where(hit, table[:, None, :], 0), axis=2)
     bn = _block_rows(m, width)
     rows_p = -(-r // bn) * bn
     out = pl.pallas_call(
@@ -391,5 +589,300 @@ def rowgather(table: jax.Array, idx: jax.Array) -> jax.Array:
             pl.BlockSpec((bn, m), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((bn, m), lambda i: (i, 0)),
+        interpret=_interpret(),
     )(_pad_rows(table, rows_p), _pad_rows(idx.astype(jnp.int32), rows_p))
     return out[:r]
+
+
+def rowgather(
+    table: jax.Array, idx: jax.Array, backend: str | None = None
+) -> jax.Array:
+    """out[r, m] = table[r, idx[r, m]] (idx must be in range; u32 table)."""
+    r, width = table.shape
+    m = idx.shape[1]
+    if r == 0 or m == 0 or width == 0:
+        return jnp.zeros((r, m), jnp.uint32)
+    table = table.astype(jnp.uint32)
+    bk = resolve_backend(backend)
+    if bk == "native":
+        # Native row-local gather; out-of-range indices yield 0 like the
+        # dense form's missed compare (negatives routed to the fill
+        # sentinel — take_along_axis would otherwise wrap them).
+        safe = jnp.where(idx < 0, width, idx.astype(jnp.int32))
+        return jnp.take_along_axis(
+            table, safe, axis=1, mode="fill", fill_value=0
+        )
+    if bk == "pallas" or _use_pallas(r * m * width):
+        return _rowgather_pallas(table, idx)
+    ids = jnp.arange(width, dtype=idx.dtype)
+    hit = idx[:, :, None] == ids[None, None, :]
+    return jnp.max(jnp.where(hit, table[:, None, :], 0), axis=2)
+
+
+# -- fused delivery-chain kernels ---------------------------------------------
+#
+# The broadcast-round delivery chain (ops/gossip.py, fast path) runs, per
+# round: rowmax of applied deltas (the watermark advance), rowmax of
+# arriving versions folded into `seen`, then — under out-of-order windows
+# — a per-word rowgather of prior possession and a per-word rowsum
+# assembling the new possession bits. As separate one-hot launches each
+# re-materializes the [sub, M, W] compare block and round-trips the
+# [R, W] planes through HBM. The two kernels below fuse the chain: the
+# compare block (`hit`) is computed once per sub-tile and reused across
+# every reduction, and the [tile, W] accumulators live in VMEM for the
+# whole chain. The non-pallas composition of the SAME primitives is the
+# bit-identical tested reference (the `_BATCHED_SYNC` pattern).
+
+
+def _delivery_reduce_kernel(
+    idx_a_ref, val_a_ref, idx_v_ref, val_v_ref, seen_ref,
+    adv_ref, seen_out_ref,
+):
+    bn, m = idx_a_ref.shape
+    w = adv_ref.shape[1]
+    ids = jax.lax.broadcasted_iota(jnp.int32, (_SUB_ROWS, m, w), 2)
+    floor = jnp.int32(-(2**31))
+
+    def body(t, _):
+        r0 = t * _SUB_ROWS
+        # One pass, two accumulators: the applied-delta max (the
+        # watermark advance) and the heard-version max folded into
+        # `seen` — both [sub, W] planes stay on chip between them.
+        hit_a = idx_a_ref[pl.ds(r0, _SUB_ROWS), :][:, :, None] == ids
+        va = _flip(val_a_ref[pl.ds(r0, _SUB_ROWS), :])[:, :, None]
+        adv_ref[pl.ds(r0, _SUB_ROWS), :] = _unflip(
+            jnp.max(jnp.where(hit_a, va, floor), axis=1)
+        )
+        hit_v = idx_v_ref[pl.ds(r0, _SUB_ROWS), :][:, :, None] == ids
+        vv = _flip(val_v_ref[pl.ds(r0, _SUB_ROWS), :])[:, :, None]
+        seen_out_ref[pl.ds(r0, _SUB_ROWS), :] = jnp.maximum(
+            seen_ref[pl.ds(r0, _SUB_ROWS), :],
+            _unflip(jnp.max(jnp.where(hit_v, vv, floor), axis=1)),
+        )
+        return 0
+
+    jax.lax.fori_loop(0, bn // _SUB_ROWS, body, 0)
+
+
+def delivery_reduce(
+    idx: jax.Array,  # i32[R, M] writer column per sorted message
+    d: jax.Array,  # u32[R, M] delta above the pre-round watermark
+    v: jax.Array,  # u32[R, M] absolute version
+    applied: jax.Array,  # bool[R, M] messages on an unbroken run
+    valid: jax.Array,  # bool[R, M] live messages
+    seen: jax.Array,  # u32[R, W] highest version heard of
+    width: int,
+    backend: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused delivery reductions: ``(advance, seen')`` where
+    ``advance = rowmax(idx, d, applied, W)`` and
+    ``seen' = max(seen, rowmax(idx, v, valid, W))`` — one VMEM pass under
+    the pallas backend, the two-primitive composition elsewhere (the
+    bit-identical reference)."""
+    if idx.shape[0] == 0 or idx.shape[1] == 0 or width == 0:
+        return (
+            jnp.zeros((idx.shape[0], width), jnp.uint32),
+            seen.astype(jnp.uint32),
+        )
+    bk = resolve_backend(backend)
+    if bk != "pallas":
+        adv = rowmax(idx, d, applied, width, backend=bk)
+        return adv, jnp.maximum(
+            seen, rowmax(idx, v, valid, width, backend=bk)
+        )
+    r, m = idx.shape
+    idx_a = jnp.where(applied, idx, -1)
+    val_a = jnp.where(applied, d.astype(jnp.uint32), 0)
+    idx_v = jnp.where(valid, idx, -1)
+    val_v = jnp.where(valid, v.astype(jnp.uint32), 0)
+    bn = _block_rows(m, width)
+    rows_p = -(-r // bn) * bn
+    adv, seen2 = pl.pallas_call(
+        _delivery_reduce_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((rows_p, width), jnp.uint32),
+            jax.ShapeDtypeStruct((rows_p, width), jnp.uint32),
+        ),
+        grid=(rows_p // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            pl.BlockSpec((bn, width), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((bn, width), lambda i: (i, 0)),
+            pl.BlockSpec((bn, width), lambda i: (i, 0)),
+        ),
+        interpret=_interpret(),
+    )(
+        _pad_rows(idx_a.astype(jnp.int32), rows_p),
+        _pad_rows(val_a, rows_p),
+        _pad_rows(idx_v.astype(jnp.int32), rows_p),
+        _pad_rows(val_v, rows_p),
+        _pad_rows(seen.astype(jnp.uint32), rows_p),
+    )
+    return adv[:r], seen2[:r]
+
+
+def _window_delivery_kernel(
+    oo_ref, w2_ref, d_ref, advm_ref, valid_ref, poss_ref, words_ref,
+    *, wk: int,
+):
+    b_words = oo_ref.shape[0]
+    bn, m = w2_ref.shape
+    w = words_ref.shape[2]
+    ids = jax.lax.broadcasted_iota(jnp.int32, (_SUB_ROWS, m, w), 2)
+
+    def body(t, _):
+        r0 = t * _SUB_ROWS
+        w2 = w2_ref[pl.ds(r0, _SUB_ROWS), :]
+        d = d_ref[pl.ds(r0, _SUB_ROWS), :]
+        advm = advm_ref[pl.ds(r0, _SUB_ROWS), :]
+        valid = valid_ref[pl.ds(r0, _SUB_ROWS), :] != 0
+        # ONE compare block feeds every gather and scatter below — the
+        # separate-launch form recomputes it 2B times and round-trips
+        # each [R, W] word plane through HBM in between.
+        hit = w2[:, :, None] == ids
+        d_rel = d - advm  # meaningful only when d > advm
+        in_win = valid & (d > advm) & (d_rel <= jnp.uint32(wk))
+        # Already possessed in the OLD window (bit d-1 above contig_pre)?
+        bit_old = d - jnp.uint32(1)
+        prev = jnp.zeros((_SUB_ROWS, m), bool)
+        for b in range(b_words):
+            # Gather rides the order-preserving i32 flip (Mosaic can't
+            # reduce unsigned ints — window words routinely set bit 31).
+            word = _unflip(jnp.max(
+                jnp.where(
+                    hit,
+                    _flip(oo_ref[b, pl.ds(r0, _SUB_ROWS), :])[:, None, :],
+                    jnp.int32(-(2**31)),
+                ),
+                axis=2,
+            ))
+            sh = jnp.minimum(
+                bit_old - jnp.uint32(32 * b), jnp.uint32(31)
+            )
+            inb = (bit_old >= jnp.uint32(32 * b)) & (
+                bit_old < jnp.uint32(32 * (b + 1))
+            )
+            prev = prev | (
+                inb & (((word >> sh) & jnp.uint32(1)) == jnp.uint32(1))
+            )
+        new_poss = in_win & ~prev
+        poss_ref[pl.ds(r0, _SUB_ROWS), :] = new_poss.astype(jnp.uint32)
+        bit_new = d_rel - jnp.uint32(1)
+        for b in range(b_words):
+            sh = jnp.minimum(
+                bit_new - jnp.uint32(32 * b), jnp.uint32(31)
+            )
+            inb = new_poss & (bit_new >= jnp.uint32(32 * b)) & (
+                bit_new < jnp.uint32(32 * (b + 1))
+            )
+            contrib = jax.lax.bitcast_convert_type(
+                jnp.where(inb, jnp.uint32(1) << sh, jnp.uint32(0)),
+                jnp.int32,
+            )[:, :, None]
+            words_ref[b, pl.ds(r0, _SUB_ROWS), :] = (
+                jax.lax.bitcast_convert_type(
+                    jnp.sum(jnp.where(hit, contrib, 0), axis=1),
+                    jnp.uint32,
+                )
+            )
+        return 0
+
+    jax.lax.fori_loop(0, bn // _SUB_ROWS, body, 0)
+
+
+def window_delivery(
+    oo: jax.Array,  # u32[B, R, W] out-of-order window words
+    idx: jax.Array,  # i32[R, M] writer column per message (in range)
+    d: jax.Array,  # u32[R, M] delta above the pre-round watermark
+    adv_m: jax.Array,  # u32[R, M] per-message in-order advance
+    valid: jax.Array,  # bool[R, M] live, deduped messages
+    wk: int,
+    width: int,
+    backend: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused out-of-order admission for the delivery fast path: decide
+    which arrivals land in the window (not already possessed, within
+    ``wk`` of the advance) and assemble their possession bits. Returns
+    ``(new_poss bool[R, M], new_bits u32[B, R, W])`` for
+    ``gossip.window_absorb``. Under the pallas backend the per-word
+    gather, the old-bit check, and the per-word bit assembly share one
+    VMEM compare block; elsewhere the rowgather/rowsum composition below
+    is the bit-identical reference."""
+    b_words = oo.shape[0]
+    if idx.shape[0] == 0 or idx.shape[1] == 0 or width == 0:
+        return (
+            jnp.zeros(idx.shape, bool),
+            jnp.zeros((b_words,) + oo.shape[1:], jnp.uint32),
+        )
+    bk = resolve_backend(backend)
+    if bk != "pallas":
+        d_rel = d - adv_m
+        in_win = valid & (d > adv_m) & (d_rel <= jnp.uint32(wk))
+        bit_old = d - jnp.uint32(1)
+        prev_poss = jnp.zeros_like(in_win)
+        for b in range(b_words):
+            wordv = rowgather(oo[b], idx, backend=bk)
+            sh = jnp.minimum(
+                bit_old - jnp.uint32(32 * b), jnp.uint32(31)
+            )
+            inb = (bit_old >= jnp.uint32(32 * b)) & (
+                bit_old < jnp.uint32(32 * (b + 1))
+            )
+            prev_poss = prev_poss | (
+                inb & (((wordv >> sh) & jnp.uint32(1)) == jnp.uint32(1))
+            )
+        new_poss = in_win & ~prev_poss
+        bit_new = d_rel - jnp.uint32(1)
+        words = []
+        for b in range(b_words):
+            sh = jnp.minimum(
+                bit_new - jnp.uint32(32 * b), jnp.uint32(31)
+            )
+            inb = new_poss & (bit_new >= jnp.uint32(32 * b)) & (
+                bit_new < jnp.uint32(32 * (b + 1))
+            )
+            words.append(
+                rowsum(
+                    idx,
+                    jnp.where(inb, jnp.uint32(1) << sh, jnp.uint32(0)),
+                    None,
+                    width,
+                    backend=bk,
+                )
+            )
+        return new_poss, jnp.stack(words)
+    r, m = idx.shape
+    bn = _block_rows(m, width)
+    rows_p = -(-r // bn) * bn
+    poss, words = pl.pallas_call(
+        partial(_window_delivery_kernel, wk=wk),
+        out_shape=(
+            jax.ShapeDtypeStruct((rows_p, m), jnp.uint32),
+            jax.ShapeDtypeStruct((b_words, rows_p, width), jnp.uint32),
+        ),
+        grid=(rows_p // bn,),
+        in_specs=[
+            pl.BlockSpec((b_words, bn, width), lambda i: (0, i, 0)),
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),
+            pl.BlockSpec((b_words, bn, width), lambda i: (0, i, 0)),
+        ),
+        interpret=_interpret(),
+    )(
+        _pad_axis(oo.astype(jnp.uint32), 1, rows_p),
+        _pad_rows(idx.astype(jnp.int32), rows_p),
+        _pad_rows(d.astype(jnp.uint32), rows_p),
+        _pad_rows(adv_m.astype(jnp.uint32), rows_p),
+        _pad_rows(valid.astype(jnp.int32), rows_p),
+    )
+    return (poss[:r] != 0), words[:, :r]

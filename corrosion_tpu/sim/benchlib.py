@@ -22,6 +22,36 @@ PLANE_STAGES = ("broadcast", "swim", "sync", "track")
 DEFAULT_TOLERANCE = 1.5
 
 
+def config_fingerprint(*parts) -> str:
+    """Stable short hash of the measured configuration. Dataclass /
+    NamedTuple reprs are deterministic (field order is declaration
+    order), so two runs fingerprint equal iff every config field and
+    bench shape parameter matches — the provenance field
+    ``telemetry.check_bench_invariants`` requires on every emitted
+    bench JSON."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(repr(p).encode())
+        h.update(b"\x00")
+    return h.hexdigest()[:16]
+
+
+def bench_context(*fingerprint_parts) -> dict:
+    """The self-describing provenance block every bench emit site must
+    include (and check_bench_invariants asserts): the platform the
+    numbers were actually measured on, the device count, and the config
+    fingerprint — so a CPU-fallback run can never again be mistaken for
+    an accelerator artifact (the BENCH_r05 incident)."""
+    devs = jax.devices()
+    return {
+        "platform": devs[0].platform,
+        "device_count": len(devs),
+        "config_fingerprint": config_fingerprint(*fingerprint_parts),
+    }
+
+
 def rounded_step_report(step_ms: float, plane: dict) -> dict:
     """The ONE emit-site rounding: round step and planes to 0.1 ms and
     derive the residual from the ROUNDED values, so
@@ -78,7 +108,10 @@ def plane_composite(cfg, topo, sched, final):
                     d, topo, sw.alive, part, i, k_sy, cfg.gossip
                 )
             if "track" in enabled:
-                vis_now = gossip_ops.visibility(d, s_writer, s_ver)
+                vis_now = gossip_ops.visibility(
+                    d, s_writer, s_ver,
+                    backend=cfg.gossip.kernel_backend,
+                )
                 active = i >= s_round
                 vr = jnp.where(
                     (vr < 0) & vis_now & active[:, None], i, vr
@@ -105,13 +138,15 @@ def check_budget(
     one human-readable line per breach. Budget keys absent from the
     measurement are breaches too (a silently vanished plane is how the
     r05 regression class hides), and so is a bench-shape mismatch: a
-    measurement taken at different ``nodes``/``rounds`` than the budget
-    was refreshed at must not gate against stale ceilings (shrinking the
-    smoke config without ``--update`` would silently loosen the gate).
+    measurement taken at different ``nodes``/``rounds``/``platform``/
+    ``kernels`` than the budget was refreshed at must not gate against
+    stale ceilings (shrinking the smoke config without ``--update``
+    would silently loosen the gate; ceilings measured on one platform
+    or kernel backend say nothing about another).
     """
     tol = float(budget.get("tolerance", DEFAULT_TOLERANCE))
     breaches: list[str] = []
-    for dim in ("nodes", "rounds"):
+    for dim in ("nodes", "rounds", "platform", "kernels"):
         if dim in budget and measured.get(dim) != budget[dim]:
             breaches.append(
                 f"{dim}: measured at {measured.get(dim)} but the budget "

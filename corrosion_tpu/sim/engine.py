@@ -196,7 +196,8 @@ def _cluster_round(
     with jax.named_scope("corro_track"):
         active = state.round >= sample_round  # [S]
         vis_now = gossip_ops.visibility(
-            data, sample_writer, sample_ver
+            data, sample_writer, sample_ver,
+            backend=cfg.gossip.kernel_backend,
         )  # [S, N]
         vis_round = jnp.where(
             (state.vis_round < 0) & vis_now & active[:, None],

@@ -291,7 +291,10 @@ def mixed_round(
 
     # Visibility over sampled SMALL writes and big versions alike rides
     # the version plane (possession = watermark or window).
-    vis_now = gossip_ops.visibility(data, sample_writer, sample_ver)
+    vis_now = gossip_ops.visibility(
+        data, sample_writer, sample_ver,
+        backend=cfg.gossip.kernel_backend,
+    )
     active = state.round >= sample_round
     vis_round = jnp.where(
         (state.vis_round < 0) & vis_now & active[:, None],

@@ -279,7 +279,8 @@ def _epoch_scan_impl(
         with jax.named_scope("corro_track"):
             hot = s_slot >= 0
             vis_now = gossip_ops.visibility(
-                st.data, jnp.maximum(s_slot, 0), s_ver
+                st.data, jnp.maximum(s_slot, 0), s_ver,
+                backend=cfg.gossip.kernel_backend,
             )
             active_s = r >= s_round
             vr_new = jnp.where(
@@ -566,11 +567,18 @@ def simulate_sparse(
         if stop_after_epoch is not None and epoch >= stop_after_epoch:
             break
 
-    merged = {
-        k: np.concatenate([p[k] for p in curve_parts])
-        for k in curve_parts[0]
-    }
-    if telemetry is not None:
+    # A zero-epoch run (resume cursor already at/past the schedule end,
+    # or rounds == 0) executes no epochs: return the resumed state with
+    # EMPTY curves instead of tripping over curve_parts[0].
+    merged = (
+        {
+            k: np.concatenate([p[k] for p in curve_parts])
+            for k in curve_parts[0]
+        }
+        if curve_parts
+        else {}
+    )
+    if telemetry is not None and curve_parts:
         telemetry.on_run_end(merged)
     info["resume"] = {
         "planner": planner.snapshot(),

@@ -520,6 +520,15 @@ def check_bench_invariants(report: dict, tol: float = 1e-6) -> dict:
     report (bench.py module docstring), exactly as they appear in the
     JSON, and return the report unchanged so the emit site can wrap it.
 
+    **Provenance**: every report must be self-describing — ``platform``
+    (the jax device platform the numbers were measured on), ``nodes``,
+    ``device_count``, and ``config_fingerprint`` (a stable hash of the
+    measured configuration, ``benchlib.config_fingerprint``) are
+    REQUIRED. The BENCH_r05 incident was a CPU-fallback run published
+    under a TPU metric name; with these fields a fallback artifact is
+    unmistakable and the budget gate can refuse cross-platform
+    comparisons outright.
+
     Checked for the base fields and every suffixed variant present
     (``step_ms_100k``, ...):
 
@@ -537,6 +546,16 @@ def check_bench_invariants(report: dict, tol: float = 1e-6) -> dict:
     the bench emits nothing rather than publishing a report that
     contradicts its own documentation.
     """
+    for field in ("platform", "nodes", "device_count", "config_fingerprint"):
+        v = report.get(field)
+        if v is None or v == "":
+            raise ValueError(
+                f"bench report is missing provenance field {field!r}: "
+                f"every emitted bench JSON must be self-describing "
+                f"(platform, nodes, device_count, config_fingerprint) "
+                f"so a CPU-fallback run can never pass as an "
+                f"accelerator artifact"
+            )
     suffixes = sorted(
         {
             k[len("step_ms"):]

@@ -8,6 +8,17 @@ or any plane exceeds its committed budget (bench_budget.json) by the
 budget's tolerance — so the r04→r05 class of silent step-time regression
 fails the PR that introduces it instead of surfacing rounds later.
 
+A second, smaller measurement runs the SAME engine under the ``pallas``
+kernel backend (interpret mode off-TPU — the identical kernel math as
+XLA ops) and gates against the budget's ``interpret`` entry, so a
+regression in the fused delivery kernels' structure is caught on CPU CI
+without a TPU in the loop.
+
+Every emitted report is self-describing (platform, device_count, nodes,
+config fingerprint — asserted by ``telemetry.check_bench_invariants``)
+so a CPU-fallback run can never be mistaken for an accelerator artifact,
+and the gate refuses to compare across platforms or kernel backends.
+
 Usage:
     python scripts/bench_smoke.py [--out report.json] [--budget FILE]
     python scripts/bench_smoke.py --update   # refresh the budget file
@@ -26,6 +37,7 @@ _sys.path.insert(
 )
 
 import argparse
+import dataclasses
 import json
 import sys
 import time
@@ -37,6 +49,13 @@ NODES = 128
 ROUNDS = 48
 SAMPLES = 64
 SEED = 0
+# Interpret-mode (pallas kernel) shape: interpret runs the kernel math
+# as XLA ops with per-call overhead, so the config is smaller — the gate
+# watches for structural multi-x regressions in the fused kernels, not
+# absolute speed.
+INTERP_NODES = 32
+INTERP_ROUNDS = 12
+INTERP_SAMPLES = 16
 # --update headroom: budget = measured * this.
 UPDATE_HEADROOM = 3.0
 # Per-plane ceiling floor for --update: cumulative-prefix increments at
@@ -53,6 +72,7 @@ def measure() -> dict:
     import jax
 
     from corrosion_tpu import models
+    from corrosion_tpu.ops import onehot
     from corrosion_tpu.sim import benchlib, simulate, telemetry
 
     cfg, topo, sched = models.merge_10k(
@@ -78,7 +98,9 @@ def measure() -> dict:
     attr = telemetry.attribute_planes(composite, stages, carry0, iters=20)
     plane, _ = attr.scale(step_ms)
     report = {
-        "platform": jax.devices()[0].platform,
+        # Self-describing provenance (check_bench_invariants asserts it).
+        **benchlib.bench_context(cfg, NODES, ROUNDS, SAMPLES, SEED),
+        "kernels": onehot.resolve_backend(cfg.gossip.kernel_backend),
         "nodes": NODES,
         "rounds": ROUNDS,
         "seed": SEED,
@@ -92,6 +114,43 @@ def measure() -> dict:
     return telemetry.check_bench_invariants(report)
 
 
+def measure_interpret() -> dict:
+    """The interpret-mode kernel gate: the same engine, the ``pallas``
+    kernel backend (fused delivery kernels under
+    ``pallas_call(..., interpret=True)`` off-TPU). Warm step time only —
+    plane attribution at this shape is timer-noise."""
+    import jax
+
+    from corrosion_tpu import models
+    from corrosion_tpu.sim import benchlib, simulate, telemetry
+
+    cfg, topo, sched = models.merge_10k(
+        n=INTERP_NODES, rounds=INTERP_ROUNDS, samples=INTERP_SAMPLES
+    )
+    cfg = dataclasses.replace(
+        cfg,
+        gossip=dataclasses.replace(cfg.gossip, kernel_backend="pallas"),
+    )
+    chunk = 6
+    final, _ = simulate(cfg, topo, sched, seed=SEED, max_chunk=chunk)
+    jax.block_until_ready(final.data.contig)
+    t0 = time.perf_counter()
+    final, _ = simulate(cfg, topo, sched, seed=SEED, max_chunk=chunk)
+    jax.block_until_ready(final.data.contig)
+    step_ms = (time.perf_counter() - t0) / INTERP_ROUNDS * 1000.0
+    report = {
+        **benchlib.bench_context(
+            cfg, INTERP_NODES, INTERP_ROUNDS, INTERP_SAMPLES, SEED
+        ),
+        "kernels": "pallas",
+        "nodes": INTERP_NODES,
+        "rounds": INTERP_ROUNDS,
+        "seed": SEED,
+        "step_ms": round(step_ms, 1),
+    }
+    return telemetry.check_bench_invariants(report)
+
+
 def main(argv=None) -> int:
     repo = Path(__file__).resolve().parent.parent
     ap = argparse.ArgumentParser(description=__doc__)
@@ -102,11 +161,16 @@ def main(argv=None) -> int:
         help="rewrite the budget file from this measurement "
         f"(x{UPDATE_HEADROOM} headroom) instead of gating",
     )
+    ap.add_argument(
+        "--skip-interpret", action="store_true",
+        help="skip the interpret-mode (pallas kernel) measurement",
+    )
     args = ap.parse_args(argv)
 
     from corrosion_tpu.sim import benchlib
 
     measured = measure()
+    interp = None if args.skip_interpret else measure_interpret()
     budget_path = Path(args.budget)
     if args.update:
         old = (
@@ -118,8 +182,11 @@ def main(argv=None) -> int:
                 "Per-round step-time budget for scripts/bench_smoke.py "
                 "(docs/PERFORMANCE.md). Ceilings are measured-on-refresh "
                 f"x{UPDATE_HEADROOM} headroom; the gate additionally "
-                "multiplies by `tolerance`."
+                "multiplies by `tolerance`. `interpret` is the pallas-"
+                "kernel interpret-mode entry (same headroom)."
             ),
+            "platform": measured["platform"],
+            "kernels": measured["kernels"],
             "nodes": NODES,
             "rounds": ROUNDS,
             "tolerance": old.get("tolerance", benchlib.DEFAULT_TOLERANCE),
@@ -131,15 +198,54 @@ def main(argv=None) -> int:
                 for k, v in measured["plane_ms"].items()
             },
         }
+        if interp is not None:
+            budget["interpret"] = {
+                "platform": interp["platform"],
+                "kernels": "pallas",
+                "nodes": INTERP_NODES,
+                "rounds": INTERP_ROUNDS,
+                "step_ms": round(
+                    interp["step_ms"] * UPDATE_HEADROOM, 1
+                ),
+            }
+        elif "interpret" in old:
+            # --skip-interpret must not silently DELETE the interpret
+            # gate: carry the previous ceilings forward unchanged.
+            budget["interpret"] = old["interpret"]
         budget_path.write_text(json.dumps(budget, indent=2) + "\n")
         print(f"[bench-smoke] budget refreshed: {budget_path}")
         print(json.dumps(measured))
+        if interp is not None:
+            print(json.dumps({"interpret": interp}))
         return 0
 
     budget = json.loads(budget_path.read_text())
     ok, breaches = benchlib.check_budget(measured, budget)
+    if interp is not None:
+        if "interpret" in budget:
+            ok_i, br_i = benchlib.check_budget(
+                interp,
+                {
+                    "tolerance": budget.get(
+                        "tolerance", benchlib.DEFAULT_TOLERANCE
+                    ),
+                    **budget["interpret"],
+                },
+            )
+            ok = ok and ok_i
+            breaches = breaches + [f"interpret.{b}" for b in br_i]
+        else:
+            # Measuring without gating is how regressions pass silently:
+            # a budget file predating the interpret entry must breach,
+            # not skip.
+            ok = False
+            breaches = breaches + [
+                "interpret: entry missing from budget — rerun with "
+                "--update"
+            ]
     report = {
         **measured,
+        "interpret": interp,
         "budget": {k: v for k, v in budget.items() if k != "_comment"},
         "ok": ok,
         "breaches": breaches,

@@ -83,8 +83,10 @@ def main() -> None:
         for x in jax.tree.leaves((sstate, swim_state))
     )
     distinct_writers = int((sched.writes.sum(axis=0) > 0).sum())
+    from corrosion_tpu.sim import benchlib
+
     out = {
-        "platform": jax.devices()[0].platform,
+        **benchlib.bench_context(cfg, rounds),
         "nodes": cfg.n_nodes,
         "w_hot": cfg.w_hot,
         "distinct_writers": distinct_writers,
@@ -136,7 +138,9 @@ def main() -> None:
             & jnp.all(pc.col_version == ref.col_version[None, :])
             & jnp.all(pc.value_rank == ref.value_rank[None, :])
         )
-    print(json.dumps(out))
+    from corrosion_tpu.sim import telemetry as telemetry_mod
+
+    print(json.dumps(telemetry_mod.check_bench_invariants(out)))
 
 
 if __name__ == "__main__":

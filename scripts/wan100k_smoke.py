@@ -99,12 +99,15 @@ def main() -> None:
         state, _ = simulate(cfg, topo, rest, seed=0, state=state, max_chunk=ck)
         jax.block_until_ready(state.data.contig)
         wall = time.perf_counter() - t0
-        print(json.dumps({
-            "platform": jax.devices()[0].platform,
+        from corrosion_tpu.sim import benchlib, telemetry
+
+        print(json.dumps(telemetry.check_bench_invariants({
+            **benchlib.bench_context(cfg, rounds, ck),
+            "nodes": cfg.n_nodes,
             "mode": "steptime",
             "rounds_timed": rounds - ck,
             "step_ms": round(wall / max(rounds - ck, 1) * 1000.0, 1),
-        }))
+        })))
         return
     tele = KernelTelemetry(
         engine="dense",
@@ -132,8 +135,10 @@ def main() -> None:
     # window AND whose writer sits in the cut-off region (or whose
     # observers include it before the heal) measure partition recovery.
     # wan_100k cuts region 0 for rounds [60, 120).
+    from corrosion_tpu.sim import benchlib
+
     out = {
-        "platform": jax.devices()[0].platform,
+        **benchlib.bench_context(cfg, rounds, steady),
         "steady": steady,
         "nodes": cfg.n_nodes,
         "rounds": rounds,
@@ -202,7 +207,9 @@ def main() -> None:
         out["steady_samples"] = int(steady.sum())
         out["vis_partition_p99_s"] = round(lat_part["p99_s"], 2)
         out["partition_samples"] = int(affected.sum())
-    print(json.dumps(out))
+    from corrosion_tpu.sim import telemetry as telemetry_mod
+
+    print(json.dumps(telemetry_mod.check_bench_invariants(out)))
 
 
 if __name__ == "__main__":

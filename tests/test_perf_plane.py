@@ -1,6 +1,6 @@
 """Broadcast/sync hot-path overhaul: equivalence + safety pins.
 
-Three families of guarantees from the perf pass (docs/PERFORMANCE.md):
+Five families of guarantees from the perf passes (docs/PERFORMANCE.md):
 
 1. **Batched anti-entropy pipeline**: the single tiled [R, C, W]
    candidate-scoring gather and the [R, S+1, W] union-pull are
@@ -11,14 +11,28 @@ Three families of guarantees from the perf pass (docs/PERFORMANCE.md):
    of ops/onehot.py equal the dense one-hot forms bit-for-bit, at the
    primitive level (including out-of-range index handling) and through
    whole gossip rounds.
-3. **Donation safety**: donated round/scan entry points return
+3. **Pallas kernel branch**: every kernel of the third backend — the
+   per-primitive VMEM kernels, the fused delivery reductions
+   (``delivery_reduce``), the fused window admission
+   (``window_delivery``), and the native-u32 gathers
+   (``rowgather_wide``, ``table_gather_u32``) — is bit-identical to the
+   native and dense references under ``pallas_call(..., interpret=True)``
+   on CPU, at the primitive level and through whole broadcast+sync
+   rounds, in exact AND digest scoring modes, on the cohort and
+   non-cohort sync paths.
+4. **Digest quantization**: the int8/bf16 sync-scoring digest ranks
+   candidate peers identically to the u32 digest below the saturation
+   threshold (where the quantizer is provably the identity), and
+   run-level selection/state are unchanged in that regime.
+5. **Donation safety**: donated round/scan entry points return
    bit-identical results, actually release the donated input buffers,
    never read a donated buffer after the call in any engine driver, and
    keep the per-function compile-cache count at <= 1 (the CT031 retrace
    tripwire's invariant).
 
 Plus the bench-report invariants (step_inner_ms <= step_ms;
-sum(plane_ms) + residual == step_ms) and the bench-smoke budget gate.
+sum(plane_ms) + residual == step_ms; provenance fields present) and the
+bench-smoke budget gate with its platform/kernels shape checks.
 """
 
 import jax
@@ -232,6 +246,424 @@ def test_gossip_rounds_native_equals_dense():
 
 
 # ---------------------------------------------------------------------------
+# 2b. Pallas kernel branch: interpret-mode bit-equality vs native + dense
+
+
+def _all_backends(fn):
+    """Evaluate ``fn()`` once per onehot backend; returns {backend: out}.
+    Off-TPU the pallas branch runs under interpret=True — identical
+    kernel math, no Mosaic."""
+    old = onehot._BACKEND
+    out = {}
+    try:
+        for bk in onehot.BACKENDS:
+            onehot._BACKEND = bk
+            out[bk] = fn()
+    finally:
+        onehot._BACKEND = old
+    return out
+
+
+def _assert_backends_equal(outs, msg=""):
+    ref = outs["native"]
+    for bk in ("dense", "pallas"):
+        np.testing.assert_array_equal(
+            np.asarray(ref), np.asarray(outs[bk]), err_msg=f"{msg} {bk}"
+        )
+
+
+def test_pallas_primitives_bit_equal_interpret():
+    """Every per-primitive pallas kernel == native == dense, including
+    out-of-range/masked index handling, under interpret mode on CPU."""
+    k = jax.random.PRNGKey(0)
+    r, m, w = 17, 23, 41
+    idx = jax.random.randint(k, (r, m), -3, w + 4)
+    val = jax.random.randint(
+        jax.random.fold_in(k, 1), (r, m), 0, 1 << 30
+    ).astype(jnp.uint32)
+    mask = jax.random.bernoulli(jax.random.fold_in(k, 2), 0.7, (r, m))
+    table = jax.random.randint(
+        jax.random.fold_in(k, 3), (r, w), 0, 1 << 30
+    ).astype(jnp.uint32)
+    idx_in = jnp.clip(idx, 0, w - 1)
+    for name, fn in {
+        "rowmax": lambda: onehot.rowmax(idx, val, mask, w),
+        "rowmax_nomask": lambda: onehot.rowmax(idx, val, None, w),
+        "rowsum": lambda: onehot.rowsum(idx, val, mask, w),
+        "rowgather": lambda: onehot.rowgather(table, idx),
+        "rowgather_wide": lambda: onehot.rowgather_wide(table, idx_in),
+        "table_gather": lambda: onehot.table_gather_u32(
+            table[0], idx_in
+        ),
+    }.items():
+        _assert_backends_equal(_all_backends(fn), msg=name)
+
+
+def test_fused_delivery_reduce_bit_equal():
+    """The fused (advance, seen') kernel == the two-rowmax reference on
+    every backend."""
+    k = jax.random.PRNGKey(4)
+    r, m, w = 19, 29, 37
+    idx = jax.random.randint(k, (r, m), 0, w)
+    d = jax.random.randint(
+        jax.random.fold_in(k, 1), (r, m), 0, 60
+    ).astype(jnp.uint32)
+    v = d + jax.random.randint(
+        jax.random.fold_in(k, 2), (r, m), 0, 1 << 20
+    ).astype(jnp.uint32)
+    valid = jax.random.bernoulli(jax.random.fold_in(k, 3), 0.8, (r, m))
+    applied = valid & jax.random.bernoulli(
+        jax.random.fold_in(k, 4), 0.6, (r, m)
+    )
+    seen = jax.random.randint(
+        jax.random.fold_in(k, 5), (r, w), 0, 1 << 30
+    ).astype(jnp.uint32)
+    outs = _all_backends(
+        lambda: onehot.delivery_reduce(idx, d, v, applied, valid, seen, w)
+    )
+    for part, pname in ((0, "adv"), (1, "seen")):
+        _assert_backends_equal(
+            {bk: o[part] for bk, o in outs.items()}, msg=pname
+        )
+
+
+def test_fused_window_delivery_bit_equal():
+    """The fused window-admission kernel (gather + old-bit check + bit
+    assembly in one VMEM pass) == the rowgather/rowsum reference, for
+    1- and 2-word windows."""
+    k = jax.random.PRNGKey(9)
+    r, m, w = 13, 21, 33
+    idx = jax.random.randint(k, (r, m), 0, w)
+    valid = jax.random.bernoulli(jax.random.fold_in(k, 1), 0.7, (r, m))
+    adv_m = jax.random.randint(
+        jax.random.fold_in(k, 2), (r, m), 0, 10
+    ).astype(jnp.uint32)
+    for b_words, wk in ((1, 32), (2, 64)):
+        d = jax.random.randint(
+            jax.random.fold_in(k, 3 + b_words), (r, m), 0, wk + 16
+        ).astype(jnp.uint32)
+        oo = jax.random.randint(
+            jax.random.fold_in(k, 5 + b_words), (b_words, r, w),
+            0, 1 << 30,
+        ).astype(jnp.uint32)
+        outs = _all_backends(
+            lambda: onehot.window_delivery(oo, idx, d, adv_m, valid, wk, w)
+        )
+        _assert_backends_equal(
+            {bk: o[0] for bk, o in outs.items()}, msg=f"poss B={b_words}"
+        )
+        _assert_backends_equal(
+            {bk: o[1] for bk, o in outs.items()}, msg=f"words B={b_words}"
+        )
+
+
+@pytest.mark.slow  # ~75 s of backend recompiles: runs in the bench-smoke
+# CI kernel suite, outside the tier-1 870 s budget.
+def test_window_admit_lambda_path_equals_window_delivery():
+    """The admission math exists in two places — gossip._window_admit's
+    legacy-lambda branch and onehot.window_delivery's reference branch.
+    Pin them equal on identical inputs (same gather/assemble semantics)
+    so a future edit to one copy cannot silently diverge the fast and
+    legacy delivery paths."""
+    k = jax.random.PRNGKey(21)
+    r, m, w, wk = 11, 17, 29, 64
+    idx = jax.random.randint(k, (r, m), 0, w)
+    valid = jax.random.bernoulli(jax.random.fold_in(k, 1), 0.7, (r, m))
+    adv_m = jax.random.randint(
+        jax.random.fold_in(k, 2), (r, m), 0, 9
+    ).astype(jnp.uint32)
+    d = jax.random.randint(
+        jax.random.fold_in(k, 3), (r, m), 0, wk + 12
+    ).astype(jnp.uint32)
+    # High bit deliberately set on some window words: the gather must
+    # preserve u32 ordering (the Mosaic flip trick in the kernels).
+    oo = jax.random.randint(
+        jax.random.fold_in(k, 4), (2, r, w), 0, 1 << 30
+    ).astype(jnp.uint32) | (jnp.uint32(1) << 31)
+    contig_pre = jax.random.randint(
+        jax.random.fold_in(k, 5), (r, w), 0, 1000
+    ).astype(jnp.uint32)
+    adv = jax.random.randint(
+        jax.random.fold_in(k, 6), (r, w), 0, 5
+    ).astype(jnp.uint32)
+    via_lambdas = gossip._window_admit(
+        oo, contig_pre, adv, adv_m, d, valid, wk,
+        gather_word=lambda word: onehot.rowgather(word, idx),
+        assemble_word=lambda contrib: onehot.rowsum(
+            idx, contrib, None, w
+        ),
+    )
+    via_fast = gossip._window_admit(
+        oo, contig_pre, adv, adv_m, d, valid, wk,
+        fast_idx=idx, width=w,
+    )
+    for xa, xb, name in zip(
+        via_lambdas, via_fast, ("contig", "oo", "new_poss")
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(xa), np.asarray(xb), err_msg=name
+        )
+
+
+# One representative param stays in tier-1 as the round-level pin; the
+# other three combinations are slow-marked (~20 s of backend recompiles
+# each) and run in the bench-smoke CI kernel suite, outside the tier-1
+# 870 s budget.
+@pytest.mark.parametrize(
+    "digest,cohorts",
+    [
+        pytest.param(False, True, id="exact-cohort"),
+        pytest.param(
+            False, False, id="exact-phase", marks=pytest.mark.slow
+        ),
+        pytest.param(
+            True, True, id="digest-cohort", marks=pytest.mark.slow
+        ),
+        pytest.param(
+            True, False, id="digest-phase", marks=pytest.mark.slow
+        ),
+    ],
+)
+def test_gossip_rounds_pallas_equals_native(cohorts, digest):
+    """Whole broadcast+sync rounds (fused delivery chain, window
+    admission, CRDT merge, grant enumeration, visibility) are
+    bit-identical across all three backends, in exact and digest
+    scoring modes, on the cohort and non-cohort sync paths — the
+    tentpole acceptance pin for the pallas branch."""
+    old_exact = gossip._EXACT_SCORE_MAX
+    if digest:
+        gossip._EXACT_SCORE_MAX = 0
+
+    def one():
+        _clear_round_caches()
+        cfg, topo, data = mk(
+            16, regions=[8, 8], sync_interval=3, n_cells=16,
+            cells_per_write=1, loss_prob=0.25, cohorts=cohorts,
+        )
+        w = jnp.zeros(16, jnp.uint32).at[3].set(3).at[12].set(2)
+        data, _ = run_rounds(
+            cfg, topo, data, 8,
+            writes_fn=lambda r: w if r < 3 else jnp.zeros(16, jnp.uint32),
+        )
+        vis = gossip.visibility(
+            data, jnp.asarray([3, 12], jnp.int32),
+            jnp.asarray([2, 1], jnp.uint32),
+        )
+        return data, np.asarray(vis)
+
+    try:
+        outs = _all_backends(one)
+    finally:
+        gossip._EXACT_SCORE_MAX = old_exact
+        _clear_round_caches()
+    for bk in ("dense", "pallas"):
+        assert_states_equal(
+            outs["native"][0], outs[bk][0], msg=f"native vs {bk}"
+        )
+        np.testing.assert_array_equal(
+            outs["native"][1], outs[bk][1], err_msg=f"vis {bk}"
+        )
+
+
+@pytest.mark.slow  # full engine-scan compile under interpret mode: runs
+# in the bench-smoke CI kernel suite, outside the tier-1 870 s budget.
+def test_config_kernel_backend_plumbs_through_engine():
+    """GossipConfig.kernel_backend reaches every delivery/sync/visibility
+    primitive through the engine drivers: a pallas-backend simulate() is
+    bit-identical to the auto (native-on-CPU) run."""
+    import dataclasses
+
+    from corrosion_tpu.sim.engine import simulate
+
+    cfg, topo, sched = _tiny_cluster(rounds=9)
+    final_a, curves_a = simulate(cfg, topo, sched, seed=0, max_chunk=3)
+    cfg_p = dataclasses.replace(
+        cfg, gossip=dataclasses.replace(cfg.gossip, kernel_backend="pallas")
+    )
+    final_b, curves_b = simulate(cfg_p, topo, sched, seed=0, max_chunk=3)
+    assert_states_equal(final_a.data, final_b.data, msg="pallas engine")
+    np.testing.assert_array_equal(
+        np.asarray(final_a.vis_round), np.asarray(final_b.vis_round)
+    )
+    for k in curves_a:
+        np.testing.assert_array_equal(curves_a[k], curves_b[k], err_msg=k)
+
+
+def test_kernel_backend_validated():
+    with pytest.raises(ValueError, match="kernel_backend"):
+        gossip.GossipConfig(n_nodes=4, n_writers=4, kernel_backend="mxu")
+    with pytest.raises(ValueError, match="backend"):
+        onehot.resolve_backend("mxu")
+
+
+# ---------------------------------------------------------------------------
+# 2c. Digest quantization: rank preservation
+
+
+def test_digest_quantization_rank_property():
+    """Property: below saturation the quantized digest is the identity
+    on the u32 deficit, so the packed need/ring score ranks candidates
+    IDENTICALLY to the unclamped u32 path; at/above saturation the
+    quantized digest equals the saturating clamp in u32 (ties decided by
+    the ring term, deterministically) — for both u8 and bf16, across
+    random deficit tensors straddling the threshold. Quantization only
+    engages while sync_budget <= the dtype's saturation point (the
+    provably-harmless regime); bigger budgets pass through as u32."""
+    old = gossip._DIGEST_QUANT
+    key = jax.random.PRNGKey(11)
+    budget = 128  # <= every saturation point: quantization engages
+    try:
+        for mode, sat in (("u8", 255), ("bf16", 256)):
+            for lo, hi in ((0, sat), (0, 4 * sat), (sat, 8 * sat)):
+                key, k1 = jax.random.split(key)
+                defc = jax.random.randint(
+                    k1, (7, 9), lo, hi
+                ).astype(jnp.uint32)
+                gossip._DIGEST_QUANT = mode
+                got = np.asarray(gossip._digest_score(defc, budget))
+                clamped = np.minimum(np.asarray(defc), sat).astype(
+                    np.int32
+                )
+                # The quantized score IS the saturating clamp, exactly.
+                np.testing.assert_array_equal(got, clamped, err_msg=mode)
+                # A budget past the saturation point must NOT quantize:
+                # ranking among deep deficits still changes what a
+                # session can drain there.
+                np.testing.assert_array_equal(
+                    np.asarray(gossip._digest_score(defc, sat + 1)),
+                    np.asarray(defc).astype(np.int32),
+                    err_msg=f"{mode} budget>{sat} passthrough",
+                )
+                if hi <= sat:
+                    # Sub-saturation: identity on the u32 deficit ->
+                    # identical packed-score ranking, provably.
+                    gossip._DIGEST_QUANT = None
+                    raw = np.asarray(gossip._digest_score(defc, budget))
+                    np.testing.assert_array_equal(got, raw)
+                    ring = np.asarray(
+                        jax.random.randint(k1, (7, 9), 0, 6)
+                    )
+                    np.testing.assert_array_equal(
+                        np.argsort(-(got * 8 + (5 - ring)), axis=1,
+                                   kind="stable"),
+                        np.argsort(-(raw * 8 + (5 - ring)), axis=1,
+                                   kind="stable"),
+                    )
+    finally:
+        gossip._DIGEST_QUANT = old
+
+
+@pytest.mark.parametrize(
+    "mode",
+    ["u8", pytest.param("bf16", marks=pytest.mark.slow)],
+)
+def test_digest_quant_run_level_rank_identical(mode):
+    """Across the exact<->digest threshold: with every deficit below the
+    saturation bound (deficits here are tens of versions), digest-mode
+    runs under the quantized digest select the same peers and land the
+    same post-sync state as the unclamped u32 digest."""
+    old_q, old_exact = gossip._DIGEST_QUANT, gossip._EXACT_SCORE_MAX
+    gossip._EXACT_SCORE_MAX = 0  # force the digest side of the threshold
+    try:
+        gossip._DIGEST_QUANT = None
+        _clear_sync_caches()
+        ref, stats_r = _one_sync_run(cohorts=True)
+        gossip._DIGEST_QUANT = mode
+        _clear_sync_caches()
+        got, stats_g = _one_sync_run(cohorts=True)
+    finally:
+        gossip._DIGEST_QUANT = old_q
+        gossip._EXACT_SCORE_MAX = old_exact
+        _clear_sync_caches()
+    assert_states_equal(ref, got, msg=f"digest quant {mode}")
+    for r, ((_, sr), (_, sg)) in enumerate(zip(stats_r, stats_g)):
+        for k in ("applied_sync", "sessions"):
+            assert int(sr[k]) == int(sg[k]), f"round {r} stat {k}"
+
+
+# ---------------------------------------------------------------------------
+# 2d. window_degraded dedup in the windowless branches (ADVICE r5)
+
+
+@pytest.mark.parametrize(
+    "fresh", [True, False], ids=["fast_path", "legacy_path"]
+)
+def test_window_degraded_dedup_windowless(fresh):
+    """window_k=0: same-round duplicate copies of one (writer, version)
+    degrade a single version per receiver, not one per copy — the
+    windowed branches' first-copy adjacency dedup applied to the
+    windowless counters on both delivery paths."""
+    cfg = gossip.GossipConfig(
+        n_nodes=4, n_writers=1, window_k=0, queue=4,
+        fanout_near=0, fanout_far=8,
+        rebroadcast_fresh_budget=fresh, rebroadcast_stale=False,
+    )
+    topo = gossip.make_topology([4], [0])
+    data = gossip.init_data(cfg)
+    # Nodes 1..3 each hold a queued copy of (writer 0, v5); every
+    # receiver lacks v1..4 so the arrival can never apply in-order.
+    qw = np.full((4, 4), -1, np.int32)
+    qv = np.zeros((4, 4), np.uint32)
+    qt = np.zeros((4, 4), np.int32)
+    for nidx in (1, 2, 3):
+        qw[nidx, 0] = 0
+        qv[nidx, 0] = 5
+        qt[nidx, 0] = 6
+    data = data._replace(
+        head=jnp.asarray([5], jnp.uint32),
+        q_writer=jnp.asarray(qw), q_ver=jnp.asarray(qv),
+        q_tx=jnp.asarray(qt),
+    )
+    alive = jnp.ones(4, bool)
+    part = jnp.zeros((1, 1), bool)
+    _, stats = gossip.broadcast_round(
+        data, topo, alive, part, jnp.zeros(1, jnp.uint32),
+        jax.random.PRNGKey(3), cfg,
+    )
+    # With fanout_far=8 every receiver (nodes 1..3; node 0 is the writer
+    # and holds everything) pulls several duplicate copies (17 messages
+    # land in total at this seed) — but exactly ONE distinct version
+    # degrades per receiver.
+    assert int(stats["msgs"]) > 3  # duplicates definitely arrived
+    assert int(stats["window_degraded"]) == 3
+
+
+def test_window_degraded_dedup_sentinel_versions():
+    """Far-sentinel arrivals (delta clamped beyond max(kk, wk)) share a
+    sort key, so the dedup must distinguish DISTINCT versions via the
+    carried version operand: v40 copies collapse, v40 vs v41 do not."""
+    cfg = gossip.GossipConfig(
+        n_nodes=4, n_writers=1, window_k=0, queue=4,
+        fanout_near=0, fanout_far=8,
+    )
+    topo = gossip.make_topology([4], [0])
+    data = gossip.init_data(cfg)
+    qw = np.full((4, 4), -1, np.int32)
+    qv = np.zeros((4, 4), np.uint32)
+    qt = np.zeros((4, 4), np.int32)
+    # kk = fanout*queue = 32, so deltas 40/41 clamp to the far sentinel.
+    for nidx, ver in ((1, 40), (2, 40), (3, 41)):
+        qw[nidx, 0] = 0
+        qv[nidx, 0] = ver
+        qt[nidx, 0] = 6
+    data = data._replace(
+        head=jnp.asarray([41], jnp.uint32),
+        q_writer=jnp.asarray(qw), q_ver=jnp.asarray(qv),
+        q_tx=jnp.asarray(qt),
+    )
+    alive = jnp.ones(4, bool)
+    part = jnp.zeros((1, 1), bool)
+    _, stats = gossip.broadcast_round(
+        data, topo, alive, part, jnp.zeros(1, jnp.uint32),
+        jax.random.PRNGKey(3), cfg,
+    )
+    # At this seed the receivers hear 4 distinct (receiver, version)
+    # degradations across 17 delivered copies.
+    assert int(stats["msgs"]) > 4
+    assert int(stats["window_degraded"]) == 4
+
+
+# ---------------------------------------------------------------------------
 # 3. Donation safety
 
 
@@ -390,8 +822,17 @@ def test_caller_supplied_state_never_donated():
 # 4. Bench-report invariants + smoke budget gate
 
 
+_PROVENANCE = {
+    "platform": "cpu",
+    "nodes": 128,
+    "device_count": 1,
+    "config_fingerprint": "deadbeefcafe0123",
+}
+
+
 def test_check_bench_invariants_accepts_consistent_report():
     rep = {
+        **_PROVENANCE,
         "step_ms": 100.0,
         "step_inner_ms": 90.0,
         "plane_ms": {"swim": 10.0, "broadcast": 50.0, "sync": 30.0},
@@ -408,16 +849,69 @@ def test_check_bench_invariants_rejects_r05_shape():
     # the guarantee must survive `python -O`.
     with pytest.raises(ValueError, match="step_inner_ms"):
         telemetry.check_bench_invariants(
-            {"step_ms": 1189.1, "step_inner_ms": 1545.2}
+            {**_PROVENANCE, "step_ms": 1189.1, "step_inner_ms": 1545.2}
         )
     with pytest.raises(ValueError, match="partition"):
         telemetry.check_bench_invariants(
             {
+                **_PROVENANCE,
                 "step_ms": 1189.1,
                 "plane_ms": {"swim": 53.8, "broadcast": 807.6},
                 "residual_ms": 0.2,
             }
         )
+
+
+def test_check_bench_invariants_requires_provenance():
+    """Every emitted bench JSON must be self-describing: a report
+    missing platform/nodes/device_count/config_fingerprint — the shape
+    under which a CPU-fallback run once passed as a TPU artifact — is
+    refused at the emit site."""
+    for missing in _PROVENANCE:
+        rep = {
+            **{k: v for k, v in _PROVENANCE.items() if k != missing},
+            "step_ms": 10.0,
+        }
+        with pytest.raises(ValueError, match=missing):
+            telemetry.check_bench_invariants(rep)
+
+
+def test_bench_context_is_self_describing():
+    ctx = benchlib.bench_context("cfg-repr", 128, 48)
+    assert ctx["platform"] == "cpu"
+    assert ctx["device_count"] >= 1
+    assert len(ctx["config_fingerprint"]) == 16
+    # Deterministic, and sensitive to every fingerprinted part.
+    assert (
+        ctx["config_fingerprint"]
+        == benchlib.bench_context("cfg-repr", 128, 48)["config_fingerprint"]
+    )
+    assert (
+        ctx["config_fingerprint"]
+        != benchlib.bench_context("cfg-repr", 256, 48)["config_fingerprint"]
+    )
+
+
+def test_bench_budget_platform_mismatch_breaches():
+    """Ceilings measured on one platform or kernel backend must refuse
+    to gate a measurement from another — the budget analogue of the
+    self-describing report rule."""
+    budget = {
+        "tolerance": 1.5, "platform": "cpu", "kernels": "native",
+        "step_ms": 100.0,
+    }
+    ok, breaches = benchlib.check_budget(
+        {"platform": "tpu", "kernels": "native", "step_ms": 1.0}, budget
+    )
+    assert not ok and "platform" in "\n".join(breaches)
+    ok2, breaches2 = benchlib.check_budget(
+        {"platform": "cpu", "kernels": "pallas", "step_ms": 1.0}, budget
+    )
+    assert not ok2 and "kernels" in "\n".join(breaches2)
+    ok3, _ = benchlib.check_budget(
+        {"platform": "cpu", "kernels": "native", "step_ms": 1.0}, budget
+    )
+    assert ok3
 
 
 def test_bench_budget_gate():

@@ -518,3 +518,46 @@ def test_pg_binary_formats(tmp_path):
             await a.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# Statement routing: write-verb tokens vs the replace() SQL function
+# (ADVICE r5: a read-only query using replace(...) must not be misrouted
+# to the write path, and a WITH-headed write's CommandComplete tag must
+# name the real top-level DML verb).
+
+
+def test_replace_function_routes_as_read():
+    from corrosion_tpu.agent import pg
+
+    # replace() as a function: pure reads, even under WITH.
+    assert pg._is_query(
+        "WITH x AS (SELECT replace(name, 'a', 'b') AS n FROM t) "
+        "SELECT * FROM x"
+    )
+    assert pg._is_query("SELECT replace(col, 'x', 'y') FROM t")
+    # Real write verbs still route as writes.
+    assert not pg._is_query(
+        "WITH x AS (SELECT 1) INSERT INTO t SELECT * FROM x"
+    )
+    assert not pg._is_query("WITH x AS (SELECT 1) REPLACE INTO t VALUES (1)")
+    # Verb words inside strings/comments never count (lexer tokens).
+    assert pg._is_query(
+        "WITH x AS (SELECT 'insert into y' AS s) SELECT * FROM x"
+    )
+
+
+def test_dml_word_skips_function_calls():
+    from corrosion_tpu.agent import pg
+
+    assert pg._dml_word(
+        "WITH x AS (SELECT replace(n, 'a', 'b') FROM t) "
+        "UPDATE u SET v = 1"
+    ) == "UPDATE"
+    assert pg._dml_word(
+        "WITH x AS (SELECT replace(n, 'a', 'b') FROM t) "
+        "INSERT INTO u SELECT * FROM x"
+    ) == "INSERT"
+    # Plain-headed statements keep their head verb.
+    assert pg._dml_word("REPLACE INTO t VALUES (1)") == "REPLACE"
+    assert pg._dml_word("DELETE FROM t WHERE a = 1") == "DELETE"

@@ -376,3 +376,26 @@ def test_sparse_checkpoint_resume_bit_identical(tmp_path):
         np.asarray(full[0].head_full) == np.asarray(part2[0].head_full)
     ).all()
     assert (np.asarray(full[2]) == np.asarray(part2[2])).all()  # vis
+
+
+def test_sparse_zero_epoch_resume_returns_empty_curves():
+    """A resume whose cursor is already at/past the schedule end (or a
+    rounds==0 schedule) runs zero epochs: the resumed state comes back
+    unchanged with EMPTY curves instead of an IndexError on the curve
+    merge (ADVICE r5)."""
+    cfg, topo, sched = _small(rounds=48)
+    out = sparse_engine.simulate_sparse(cfg, topo, sched, seed=7)
+    resume = out[4]["resume"]
+    assert resume["next_epoch"] * cfg.sparse.epoch_rounds >= sched.rounds
+    sstate, swim_state, vis_round, curves, info = (
+        sparse_engine.simulate_sparse(
+            cfg, topo, sched, seed=7, resume=resume
+        )
+    )
+    assert curves == {}
+    assert info["epochs"] == 0
+    np.testing.assert_array_equal(
+        np.asarray(sstate.data.contig), np.asarray(out[0].data.contig)
+    )
+    np.testing.assert_array_equal(np.asarray(vis_round), np.asarray(out[2]))
+    assert info["resume"]["next_epoch"] == resume["next_epoch"]
