@@ -42,6 +42,21 @@ import numpy as np
 
 
 def main() -> None:
+    if "--multichip" in sys.argv:
+        # The standing sharded bench lane (ISSUE 7): dense + sparse under
+        # the explicit shard_map round driver at device_count ∈ {1,2,4,8},
+        # gated against bench_budget.json's `multichip` entry. ONE
+        # implementation owns the lane — scripts/multichip_smoke.py — so
+        # the headline bench and the CI gate can never measure different
+        # things (the same rule benchlib enforces for the plane composite).
+        from pathlib import Path
+
+        sys.path.insert(0, str(Path(__file__).resolve().parent / "scripts"))
+        import multichip_smoke
+
+        argv = [a for a in sys.argv[1:] if a != "--multichip"]
+        raise SystemExit(multichip_smoke.main(argv))
+
     from corrosion_tpu.utils.cache import (
         enable_persistent_cache,
         ensure_live_backend,

@@ -33,6 +33,7 @@ def apply_loss(
     ok: jax.Array,  # bool[...] deliverable-message mask
     static_prob: float,
     dyn_prob: jax.Array | None = None,  # f32 broadcastable to ok.shape
+    full_rows: tuple | None = None,  # (n_total, row_start) shard slicing
 ) -> tuple[jax.Array, jax.Array]:
     """Drop each deliverable message independently with the combined
     loss probability. Returns ``(ok', lost_count u32)``.
@@ -52,7 +53,17 @@ def apply_loss(
     """
     if static_prob <= 0.0 and dyn_prob is None:
         return ok, jnp.uint32(0)
-    u = jax.random.uniform(key, ok.shape)
+    if full_rows is None:
+        u = jax.random.uniform(key, ok.shape)
+    else:
+        # Shard_map callers (gossip.ShardCtx): draw the mask at the FULL
+        # leading-row shape and slice this shard's rows, so injected
+        # loss is bit-identical across device counts.
+        n_total, row_start = full_rows
+        u = jax.lax.dynamic_slice_in_dim(
+            jax.random.uniform(key, (n_total,) + ok.shape[1:]),
+            row_start, ok.shape[0], axis=0,
+        )
     p = jnp.float32(static_prob)
     if dyn_prob is not None:
         d = dyn_prob.astype(jnp.float32)

@@ -241,6 +241,29 @@ def make_topology(
     )
 
 
+class ShardCtx(NamedTuple):
+    """Per-shard context for the explicit shard_map broadcast driver
+    (corrosion_tpu/parallel/shard_driver.py).
+
+    When present, ``_broadcast_round`` runs as the LOCAL-rows body of a
+    ``shard_map`` call: ``data`` holds only this shard's node rows while
+    ``topo``/``alive``/``partition`` are the full replicated tables, the
+    pending-queue tables arrive pre-gathered (the one batched cross-shard
+    exchange per round — staged all_gather per mesh axis), and the few
+    cross-shard scalar reductions ride ``lax.psum`` over ``axes``. All
+    RNG draws whose shape would otherwise depend on the shard sample at
+    the FULL shape and slice local rows, so the sharded round is
+    bit-identical to the unsharded one for any device count.
+    """
+
+    axes: tuple  # mesh axis names, outer -> inner (trace-time static)
+    row_start: jax.Array  # i32[] global node index of this shard's first row
+    q_writer: jax.Array  # i32[N, Q] full gathered queue tables
+    q_ver: jax.Array  # u32[N, Q]
+    q_tx: jax.Array  # i32[N, Q]
+    q_gw: jax.Array | None  # u32[N, Q] (track_writer_ids configs only)
+
+
 class DataState(NamedTuple):
     head: jax.Array  # u32[W] writer's committed version head
     contig: jax.Array  # u32[N, W] contiguous watermark per (node, writer)
@@ -538,9 +561,40 @@ def _broadcast_round(
     rng: jax.Array,
     cfg: GossipConfig,
     loss: jax.Array | None = None,  # f32[R] injected per-region loss prob
+    shard: ShardCtx | None = None,
 ) -> tuple[DataState, dict]:
-    n, w_count, q_cap = cfg.n_nodes, cfg.n_writers, cfg.queue
-    nodes = jnp.arange(n)
+    w_count, q_cap = cfg.n_writers, cfg.queue
+    n_total = cfg.n_nodes  # global node count
+    # Receiver rows owned by this caller. Unsharded: all of them. Under
+    # the shard_map driver (``shard`` present): this shard's slice of the
+    # node axis — every delivery tensor below is then [n_rows, ...] and
+    # the queue tables/alive/topo vectors are read at FULL width through
+    # the ShardCtx / replicated arguments.
+    n = data.contig.shape[0]
+    if shard is None:
+        nodes = jnp.arange(n)  # global node id per local row
+        region_r = topo.region
+        rstart_r = topo.region_start
+        rsize_r = topo.region_size
+        won_r = topo.writer_of_node
+        alive_r = alive
+        qf_w, qf_v, qf_t = data.q_writer, data.q_ver, data.q_tx
+        qf_g = data.q_gw
+    else:
+        rs = shard.row_start
+
+        def _rows(x):
+            return jax.lax.dynamic_slice_in_dim(x, rs, n, axis=0)
+
+        nodes = rs + jnp.arange(n)
+        region_r = _rows(topo.region)
+        rstart_r = _rows(topo.region_start)
+        rsize_r = _rows(topo.region_size)
+        won_r = _rows(topo.writer_of_node)
+        alive_r = _rows(alive)
+        qf_w, qf_v, qf_t, qf_g = (
+            shard.q_writer, shard.q_ver, shard.q_tx, shard.q_gw
+        )
     # One trace-time backend resolution for the whole round: config
     # override first, then the onehot module's globals/platform auto.
     bk = onehot.resolve_backend(cfg.kernel_backend)
@@ -552,8 +606,20 @@ def _broadcast_round(
     ) * alive[topo.writer_nodes].astype(jnp.uint32)
     head = data.head + writes
     wi = jnp.arange(w_count)
-    contig = data.contig.at[topo.writer_nodes, wi].max(head)
-    seen = data.seen.at[topo.writer_nodes, wi].max(head)
+    # Writer-hosting rows owned elsewhere drop out of the scatter
+    # (mode="drop"); unsharded every index is in bounds, so the mode is
+    # inert there and both paths share one scatter form.
+    if shard is None:
+        w_rows = topo.writer_nodes
+    else:
+        w_rows = jnp.where(
+            (topo.writer_nodes >= shard.row_start)
+            & (topo.writer_nodes < shard.row_start + n),
+            topo.writer_nodes - shard.row_start,
+            n,
+        )
+    contig = data.contig.at[w_rows, wi].max(head, mode="drop")
+    seen = data.seen.at[w_rows, wi].max(head, mode="drop")
     # Captured after local commits so applied_broadcast counts only versions
     # applied via *delivery*, not the writer's own head bump.
     contig_before = contig
@@ -561,13 +627,13 @@ def _broadcast_round(
     # New queue entries for the writing node, one per committed version.
     mw = cfg.max_writes_per_round
     nw = jnp.where(
-        topo.writer_of_node >= 0,
-        writes[jnp.maximum(topo.writer_of_node, 0)],
+        won_r >= 0,
+        writes[jnp.maximum(won_r, 0)],
         0,
-    )  # u32[N] versions written by each node this round
+    )  # u32[n_rows] versions written by each local node this round
     head_old_n = jnp.where(
-        topo.writer_of_node >= 0,
-        data.head[jnp.maximum(topo.writer_of_node, 0)],
+        won_r >= 0,
+        data.head[jnp.maximum(won_r, 0)],
         0,
     )
     new_ver = head_old_n[:, None] + 1 + jnp.arange(mw, dtype=jnp.uint32)[None, :]
@@ -575,8 +641,8 @@ def _broadcast_round(
     # sanitizer) rejects an implicit i32/u32 comparison.
     new_valid = (
         jnp.arange(mw, dtype=jnp.uint32)[None, :] < nw[:, None]
-    ) & alive[:, None]
-    new_writer = jnp.broadcast_to(topo.writer_of_node[:, None], (n, mw))
+    ) & alive_r[:, None]
+    new_writer = jnp.broadcast_to(won_r[:, None], (n, mw))
     track = cfg.track_writer_ids
     if track and topo.writer_ids is None:
         raise ValueError("track_writer_ids requires topo.writer_ids")
@@ -610,10 +676,30 @@ def _broadcast_round(
     # which dominated step time at 10k+ nodes.
     f = cfg.fanout
     if f > 0:
-        near = topo.region_start[:, None] + jax.random.randint(
-            k_near, (n, cfg.fanout_near), 0, 1 << 30
-        ) % jnp.maximum(topo.region_size[:, None], 1)
-        far = jax.random.randint(k_far, (n, cfg.fanout_far), 0, n)
+        if shard is None:
+            near_off = jax.random.randint(
+                k_near, (n, cfg.fanout_near), 0, 1 << 30
+            )
+            far = jax.random.randint(k_far, (n, cfg.fanout_far), 0, n)
+        else:
+            # Sample at the FULL shape and slice local rows: every shard
+            # draws the same [N, F] tensors the unsharded round draws, so
+            # source choice is device-count invariant bit-for-bit.
+            near_off = jax.lax.dynamic_slice_in_dim(
+                jax.random.randint(
+                    k_near, (n_total, cfg.fanout_near), 0, 1 << 30
+                ),
+                shard.row_start, n, axis=0,
+            )
+            far = jax.lax.dynamic_slice_in_dim(
+                jax.random.randint(
+                    k_far, (n_total, cfg.fanout_far), 0, n_total
+                ),
+                shard.row_start, n, axis=0,
+            )
+        near = rstart_r[:, None] + near_off % jnp.maximum(
+            rsize_r[:, None], 1
+        )
         src = jnp.concatenate([near, far], axis=1)  # i32[N, F] sources
         # Gather i32, never bool: TPU vectorizes integer row gathers but
         # serializes pred gathers element-by-element (~50 ms per million-
@@ -621,8 +707,8 @@ def _broadcast_round(
         alive_i = alive.astype(jnp.int32)
         part_i = partition.astype(jnp.int32)
         link_ok = (
-            (part_i[topo.region[:, None], topo.region[src]] == 0)
-            & alive[:, None]
+            (part_i[region_r[:, None], topo.region[src]] == 0)
+            & alive_r[:, None]
             & (alive_i[src] > 0)
             & (src != nodes[:, None])
         )
@@ -633,20 +719,25 @@ def _broadcast_round(
         # longest contiguous version run starting at contig+1 — including
         # runs stitched across sources.
         kk = f * q_cap
-        m_w = data.q_writer[src].reshape(n, kk)
-        m_v = data.q_ver[src].reshape(n, kk)
-        m_tx = data.q_tx[src].reshape(n, kk)
-        m_gw = data.q_gw[src].reshape(n, kk) if track else None
+        m_w = qf_w[src].reshape(n, kk)
+        m_v = qf_v[src].reshape(n, kk)
+        m_tx = qf_t[src].reshape(n, kk)
+        m_gw = qf_g[src].reshape(n, kk) if track else None
         m_ok = (
             jnp.repeat(link_ok[:, :, None], q_cap, axis=2).reshape(n, kk)
             & (m_w >= 0)
         )
         # Shared static-skip loss (ops/faulting.py): config loss and the
         # chaos plane's per-region schedule compose here; receiver-side,
-        # so a region's loss burst degrades what IT hears.
-        dyn_loss = None if loss is None else loss[topo.region][:, None]
+        # so a region's loss burst degrades what IT hears. Sharded rounds
+        # draw the loss mask at the full shape (same device-count
+        # invariance as the source sampling above).
+        dyn_loss = None if loss is None else loss[region_r][:, None]
         m_ok, n_lost = faulting.apply_loss(
-            k_loss, m_ok, cfg.loss_prob, dyn_loss
+            k_loss, m_ok, cfg.loss_prob, dyn_loss,
+            full_rows=(
+                None if shard is None else (n_total, shard.row_start)
+            ),
         )
         n_msgs = jnp.sum(m_ok)
         k_in = cfg.rebroadcast_intake or cfg.fanout * 2
@@ -908,7 +999,9 @@ def _broadcast_round(
             # Applied = delivered versions on an unbroken run from contig+1.
             contig_pre = contig
             w2c = jnp.minimum(w2, w_count - 1)
-            rw2 = nodes[:, None] * w_count + w2c
+            # LOCAL row index (scatters target this caller's [n, W]
+            # tables; ``nodes`` is the global id and only names identity).
+            rw2 = jnp.arange(n)[:, None] * w_count + w2c
             applied_v = jnp.where(run & valid2, v2, 0)
             contig_run = (
                 contig.reshape(-1)
@@ -1034,12 +1127,29 @@ def _broadcast_round(
             in_gw = in_payloads[3] if track else None
             in_w = jnp.where(in_mask, in_w, -1)
         # A source's budgets burn when at least one receiver pulled it.
-        pulled = (
-            jnp.zeros((n,), jnp.int32)
-            .at[jnp.where(link_ok, src, n)]
-            .add(1, mode="drop")
-        )
-        sent_any = pulled > 0
+        # Sources live on arbitrary shards, so the sharded driver counts
+        # pulls into the FULL vector, psums across shards, and keeps its
+        # local rows — the round's one cross-shard reduction.
+        if shard is None:
+            pulled = (
+                jnp.zeros((n,), jnp.int32)
+                .at[jnp.where(link_ok, src, n)]
+                .add(1, mode="drop")
+            )
+            sent_any = pulled > 0
+        else:
+            pulled = (
+                jnp.zeros((n_total,), jnp.int32)
+                .at[jnp.where(link_ok, src, n_total)]
+                .add(1, mode="drop")
+            )
+            pulled = jax.lax.psum(pulled, shard.axes)
+            sent_any = (
+                jax.lax.dynamic_slice_in_dim(
+                    pulled, shard.row_start, n, axis=0
+                )
+                > 0
+            )
     else:
         # Sync-only configuration: no fanout, no delivery, budgets retained.
         n_msgs = jnp.uint32(0)
@@ -1098,10 +1208,26 @@ def _broadcast_round(
         q_gw = data.q_gw
     q_writer = jnp.where(keep, q_writer, -1)
 
+    applied_b = jnp.sum(
+        (contig - contig_before).astype(jnp.uint32), dtype=jnp.uint32
+    )
+    if shard is not None:
+        # One coalesced cross-shard scalar reduction for the round's
+        # stats, plus the global OR for the window-live flag (a psum of
+        # a replicated flag still reduces to the right truth value, so
+        # the windowless/sync-only branches need no special case).
+        applied_b, n_msgs, n_merges, n_degraded, n_lost, oo_cnt = (
+            jax.lax.psum(
+                (
+                    applied_b, n_msgs, n_merges, n_degraded, n_lost,
+                    oo_any_new.astype(jnp.uint32),
+                ),
+                shard.axes,
+            )
+        )
+        oo_any_new = oo_cnt > 0
     stats = {
-        "applied_broadcast": jnp.sum(
-            (contig - contig_before).astype(jnp.uint32), dtype=jnp.uint32
-        ),
+        "applied_broadcast": applied_b,
         "msgs": n_msgs,
         "cell_merges": n_merges,
         # Arrivals that could not be possessed this round (beyond the

@@ -11,7 +11,20 @@ scatter boundaries (all-to-all-shaped traffic riding ICI).
 from corrosion_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     make_wan_mesh,
+    shard_chunk_state,
     shard_cluster_state,
+    shard_mixed_state,
+    shard_node_major,
     shard_sparse_state,
     shard_topology,
+)
+from corrosion_tpu.parallel.shard_driver import (  # noqa: F401
+    make_sharded_broadcast,
+    per_device_state_bytes,
+    replicate,
+    simulate_chunks_sharded,
+    simulate_mixed_sharded,
+    simulate_sharded,
+    simulate_sparse_sharded,
+    traffic_model,
 )

@@ -88,39 +88,46 @@ def shard_topology(topo: Topology, mesh: Mesh, axis=None) -> Topology:
     )
 
 
-def shard_cluster_state(
-    state: ClusterState, mesh: Mesh, axis=None
-) -> ClusterState:
-    axis = _node_axis(mesh, axis)
+def _shard_data_state(d: DataState, mesh: Mesh, axis) -> DataState:
+    """NamedSharding placement for a gossip DataState (shared by the
+    dense, sparse, and mixed shard helpers): node-major tensors shard
+    their row axis, writer heads and the window-live flag replicate,
+    window words shard dim 1 ([B, N, W]), and the flat cell plane
+    shards on node boundaries (K divides each shard when N does)."""
     row = P(axis, None)
     vec = P(axis)
     rep = P()
-    # Every SWIM-plane field (dense SwimState or SparseSwimState) is
-    # node-major: shard the leading axis, replicate the rest.
-    sw = jax.tree.map(
-        lambda x: _put(x, mesh, P(axis, *([None] * (x.ndim - 1)))),
-        state.swim,
-    )
-    d: DataState = state.data
-    d = DataState(
+    return DataState(
         head=_put(d.head, mesh, rep),
         contig=_put(d.contig, mesh, row),
         seen=_put(d.seen, mesh, row),
-        # Window words are [B, N, W]: node axis is dim 1.
         oo=_put(d.oo, mesh, P(None, axis, None)),
         oo_any=_put(d.oo_any, mesh, rep),
         q_writer=_put(d.q_writer, mesh, row),
         q_ver=_put(d.q_ver, mesh, row),
         q_tx=_put(d.q_tx, mesh, row),
         q_gw=_put(d.q_gw, mesh, row),
-        # Cell plane is node-major flat [N * K]: sharding the single axis
-        # splits it on node boundaries (K divides each shard when N does).
         cells=jax.tree.map(lambda a: _put(a, mesh, vec), d.cells),
     )
+
+
+def shard_node_major(tree, mesh: Mesh, axis):
+    """Shard every leaf's leading axis (SWIM state, chunk coverage)."""
+    return jax.tree.map(
+        lambda x: _put(x, mesh, P(axis, *([None] * (x.ndim - 1)))), tree
+    )
+
+
+def shard_cluster_state(
+    state: ClusterState, mesh: Mesh, axis=None
+) -> ClusterState:
+    axis = _node_axis(mesh, axis)
     return ClusterState(
-        swim=sw,
-        data=d,
-        round=_put(state.round, mesh, rep),
+        # Every SWIM-plane field (dense SwimState or SparseSwimState) is
+        # node-major: shard the leading axis, replicate the rest.
+        swim=shard_node_major(state.swim, mesh, axis),
+        data=_shard_data_state(state.data, mesh, axis),
+        round=_put(state.round, mesh, P()),
         vis_round=_put(state.vis_round, mesh, P(None, axis)),
     )
 
@@ -134,26 +141,38 @@ def shard_sparse_state(sstate, mesh: Mesh, axis=None):
 
     axis = _node_axis(mesh, axis)
     row = P(axis, None)
-    vec = P(axis)
-    rep = P()
-    d = sstate.data
-    d = DataState(
-        head=_put(d.head, mesh, rep),
-        contig=_put(d.contig, mesh, row),
-        seen=_put(d.seen, mesh, row),
-        oo=_put(d.oo, mesh, P(None, axis, None)),
-        oo_any=_put(d.oo_any, mesh, rep),
-        q_writer=_put(d.q_writer, mesh, row),
-        q_ver=_put(d.q_ver, mesh, row),
-        q_tx=_put(d.q_tx, mesh, row),
-        q_gw=_put(d.q_gw, mesh, row),
-        cells=jax.tree.map(lambda a: _put(a, mesh, vec), d.cells),
-    )
     return SparseState(
-        data=d,
-        head_full=_put(sstate.head_full, mesh, vec),
-        slot_writer=_put(sstate.slot_writer, mesh, rep),
+        data=_shard_data_state(sstate.data, mesh, axis),
+        head_full=_put(sstate.head_full, mesh, P(axis)),
+        slot_writer=_put(sstate.slot_writer, mesh, P()),
         dev_writer=_put(sstate.dev_writer, mesh, row),
         dev_contig=_put(sstate.dev_contig, mesh, row),
-        dev_any=_put(sstate.dev_any, mesh, rep),
+        dev_any=_put(sstate.dev_any, mesh, P()),
+    )
+
+
+def shard_chunk_state(state, mesh: Mesh, axis=None):
+    """NamedSharding placement for the seq-chunk plane
+    (ops/chunks.ChunkState): coverage rows are node-major flat
+    [N * S, C], so sharding the row axis splits on node boundaries when
+    N divides the mesh size (each shard holds whole nodes' streams)."""
+    axis = _node_axis(mesh, axis)
+    return shard_node_major(state, mesh, axis)
+
+
+def shard_mixed_state(state, mesh: Mesh, axis=None):
+    """NamedSharding placement for the mixed chunk+version engine
+    (sim/mixed_engine.MixedState): the version plane shards like the
+    dense engine, chunk coverage like the chunk plane, the per-stream
+    completion latch is node-major, and the round counter replicates."""
+    from corrosion_tpu.sim.mixed_engine import MixedState
+
+    axis = _node_axis(mesh, axis)
+    return MixedState(
+        data=_shard_data_state(state.data, mesh, axis),
+        swim=shard_node_major(state.swim, mesh, axis),
+        chunks=shard_node_major(state.chunks, mesh, axis),
+        applied_before=_put(state.applied_before, mesh, P(axis, None)),
+        round=_put(state.round, mesh, P()),
+        vis_round=_put(state.vis_round, mesh, P(None, axis)),
     )

@@ -1,0 +1,431 @@
+"""Explicit shard_map round driver for the multi-chip scale-out plane.
+
+``parallel/mesh.py`` places state with NamedSharding and lets GSPMD
+decide where the collectives go inside the 1.8k-line ``ops/gossip.py``
+step. That works — the placements are bit-identity-pinned — but the
+multi-chip cost model is then whatever XLA felt like: nothing states
+*which* traffic crosses shards, nothing measures it, and a partitioner
+regression would change the wire volume silently. This module makes the
+broadcast delivery chain's cross-shard structure EXPLICIT:
+
+- **One batched queue exchange per round.** The pending-broadcast queue
+  tables (``q_writer``/``q_ver``/``q_tx`` and ``q_gw`` under rotating
+  slots) are the entire wire format of the delivery plane — a bounded
+  ``[N, Q]`` digest of everything any node may transmit this round.
+  Each shard publishes its block once: an ``all_gather`` over the fast
+  (ici) axis first, then one coalesced second hop across the slow (dcn)
+  axis. Every receiver then needs *nothing else* remote — source
+  sampling, link checks, the sorted delivery pass, window admission,
+  CRDT merges, and the queue rebuild are all row-local
+  (``gossip._broadcast_round`` with a ``ShardCtx``). The per-backend
+  trace-time dispatch in ``ops/onehot.py`` stays the inner-kernel seam,
+  so the sharded driver composes with native/dense/pallas unchanged.
+  (The exchange is an all_gather, not an element-routed all_to_all, on
+  purpose: far peers are sampled uniformly over N, so every shard may
+  need any row — same-data-to-all is the correct collective, and the
+  queue tables are already the compact bounded form.)
+- **One cross-shard reduction per round.** A source's retransmission
+  budget burns when at least one receiver — on any shard — pulled it:
+  a single psum over the mesh covers it, coalesced with the round's
+  scalar stats.
+- **Bit-identity by construction.** Every RNG draw whose shape would
+  otherwise depend on the shard (source sampling, injected loss) is
+  drawn at the FULL shape and row-sliced, so dense and sparse rounds
+  are bit-identical across device_count ∈ {1, 2, 4, 8, ...} — pinned in
+  tests/test_shard_driver.py.
+- **Exact traffic accounting.** The exchange is staged explicitly, so
+  its per-round byte volume is computed from the actual operands of
+  each staged collective (shapes × dtype widths at trace time) and
+  emitted through the canonical RoundCurves keys
+  ``xshard_bytes_ici``/``xshard_bytes_dcn`` (zero when unsharded).
+  :func:`traffic_model` derives the same numbers INDEPENDENTLY from the
+  config arithmetic; the two are pinned equal in
+  tests/test_shard_driver.py and the bench lane, so a wire-format
+  regression surfaces as a curve/model mismatch. The SWIM/sync planes
+  stay GSPMD-placed (their gathers are data-dependent and
+  cohort-bounded); the model carries a documented estimate for them in
+  ``detail``.
+
+The anti-entropy sync plane deliberately remains on the GSPMD path: its
+candidate/peer gathers touch ``sync_candidates + sync_peers + 1`` rows
+per cohort row, are already cohort-bounded (N / sync_interval rows per
+round), and XLA's placement there has never been the regression class —
+the r04→r05 incident lived in the broadcast chain this module pins.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from corrosion_tpu.ops import crdt
+from corrosion_tpu.ops import gossip as gossip_ops
+from corrosion_tpu.ops.gossip import DataState, ShardCtx
+
+
+def node_spec_entry(mesh: Mesh):
+    """The PartitionSpec entry that shards a node-major dimension over
+    every mesh axis (dcn outer, ici inner) — the same placement rule
+    ``parallel.mesh._node_axis`` applies for NamedSharding."""
+    names = mesh.axis_names
+    return names if len(names) > 1 else names[0]
+
+
+def replicate(tree, mesh: Mesh):
+    """device_put every leaf replicated over the mesh (P())."""
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+def _data_specs(mesh: Mesh) -> DataState:
+    node = node_spec_entry(mesh)
+    return DataState(
+        head=P(),  # writer heads are replicated (every shard commits them)
+        contig=P(node),
+        seen=P(node),
+        oo=P(None, node),  # [B, N, W]: node axis is dim 1
+        oo_any=P(),
+        q_writer=P(node),
+        q_ver=P(node),
+        q_tx=P(node),
+        q_gw=P(node),
+        cells=crdt.CellState(
+            cl=P(node), col_version=P(node), value_rank=P(node)
+        ),
+    )
+
+
+def traffic_model(cfg: gossip_ops.GossipConfig, mesh: Mesh) -> dict:
+    """Static per-round cross-shard byte accounting for the explicit
+    broadcast exchange, plus documented estimates for the GSPMD planes.
+
+    The queue exchange is staged per mesh axis (innermost first), so its
+    volume is exact arithmetic: before the hop over an axis of size s,
+    each of the D devices holds a ``cur`` -byte block and receives
+    ``(s - 1) * cur`` from its group peers; the block then grows s-fold
+    for the next (outer) hop. ``xshard_bytes_ici`` is the innermost-axis
+    hop (intra-group), ``xshard_bytes_dcn`` sums every outer hop (zero
+    on a 1-D mesh). Counts are cluster totals per round, in bytes.
+
+    ``detail`` additionally models the control-plane collectives (the
+    alive-vector gather at the shard_map boundary, the pulled-count
+    psum) and the GSPMD sync plane's expected gather volume — estimates,
+    labeled as such, because their placement belongs to XLA.
+    """
+    axes = tuple(mesh.axis_names)
+    sizes = tuple(int(mesh.shape[a]) for a in axes)
+    d = int(np.prod(sizes))
+    n, q = cfg.n_nodes, cfg.queue
+    if d <= 1:
+        return {
+            "xshard_bytes_ici": 0.0,
+            "xshard_bytes_dcn": 0.0,
+            "detail": {"device_count": d},
+        }
+    nl = n // d
+    per_entry = 12 + (4 if cfg.track_writer_ids else 0)
+    block = float(nl * q * per_entry)
+    per_hop = []
+    cur = block
+    ici_bytes = dcn_bytes = 0.0
+    for a, s in zip(reversed(axes), reversed(sizes)):
+        hop = d * (s - 1) * cur
+        per_hop.append({"axis": a, "group": s, "bytes": hop})
+        if a == axes[-1]:
+            ici_bytes += hop
+        else:
+            dcn_bytes += hop
+        cur *= s
+    # Control plane: the alive vector replicates at the shard_map
+    # boundary (bool[N] per device), and the pulled-count psum is an
+    # i32[N] all-reduce (ring model: 2 (D-1)/D volumes per device).
+    alive_gather = float(d * (n - nl) * 1)
+    pulled_reduce = float(2 * (d - 1) * n * 4)
+    # GSPMD sync plane (estimate): per cohort row, the score pass
+    # gathers C candidate contig+seen rows and the union pull gathers
+    # S+1 peer rows, each [W] u32; a gathered row is remote with
+    # probability (D-1)/D under uniform sampling.
+    cohort = -(-n // max(cfg.sync_interval, 1))
+    sync_rows = cohort * (2 * cfg.sync_candidates + cfg.sync_peers + 1)
+    sync_est = float(sync_rows * cfg.n_writers * 4) * (d - 1) / d
+    return {
+        "xshard_bytes_ici": ici_bytes,
+        "xshard_bytes_dcn": dcn_bytes,
+        "detail": {
+            "device_count": d,
+            "queue_block_bytes": block,
+            "per_hop": per_hop,
+            "alive_gather_bytes": alive_gather,
+            "pulled_reduce_bytes": pulled_reduce,
+            "sync_gather_bytes_est": sync_est,
+        },
+    }
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_broadcast(mesh: Mesh):
+    """Build a drop-in replacement for ``gossip.broadcast_round`` that
+    runs the delivery chain as a shard_map over ``mesh``.
+
+    The returned function has the broadcast_round signature
+    ``(data, topo, alive, partition, writes, rng, cfg, loss=None)`` and
+    expects ``data`` node-sharded over the mesh
+    (``parallel.shard_cluster_state`` / ``shard_sparse_state``) with
+    ``topo`` replicated. It returns the stats dict of the unsharded
+    round plus ``xshard_bytes_ici``/``xshard_bytes_dcn`` (the exchange's
+    exact per-round byte volume), which the engine scan bodies forward
+    into the canonical RoundCurves. Cached per mesh so jitted callers
+    see one stable callable per mesh (one compile per config).
+    """
+    axes = tuple(mesh.axis_names)
+    sizes = tuple(int(mesh.shape[a]) for a in axes)
+    dev = int(np.prod(sizes))
+
+    def bcast(data, topo, alive, partition, writes, rng, cfg, loss=None):
+        n_total = cfg.n_nodes
+        if n_total % dev:
+            raise ValueError(
+                f"shard_map driver needs n_nodes divisible by the mesh "
+                f"size: {n_total} % {dev} != 0"
+            )
+        nl = n_total // dev
+        track = cfg.track_writer_ids
+
+        def body(data_l, topo_f, alive_f, part, w, key, *rest):
+            loss_f = rest[0] if rest else None
+            idx = jnp.int32(0)
+            for a, s in zip(axes, sizes):
+                idx = idx * s + jax.lax.axis_index(a)
+            row_start = idx * nl
+            # The one batched cross-shard exchange: publish this shard's
+            # queue block over the fast axis, then the coalesced outer
+            # hop(s). Row order matches the (dcn-major, ici-minor) node
+            # partitioning, so the gathered tables are globally indexed.
+            # The emitted byte curves are computed HERE, from the actual
+            # operands of each staged collective (local shapes x dtype
+            # widths at trace time) — NOT from traffic_model — so the
+            # model stays an independent prediction and the measured==
+            # model pins (tests/test_shard_driver.py, the bench lane)
+            # catch a wire-format regression: gathering an extra table,
+            # widening a dtype, or moving a hop changes these numbers
+            # while the model's arithmetic does not.
+            qs = [data_l.q_writer, data_l.q_ver, data_l.q_tx]
+            if track:
+                qs.append(data_l.q_gw)
+            hop_ici = hop_dcn = 0.0
+            for a, s in zip(reversed(axes), reversed(sizes)):
+                cur = sum(
+                    int(np.prod(x.shape)) * x.dtype.itemsize for x in qs
+                )
+                hop = float(dev * (s - 1) * cur)
+                if a == axes[-1]:
+                    hop_ici += hop
+                else:
+                    hop_dcn += hop
+                qs = [
+                    jax.lax.all_gather(x, a, axis=0, tiled=True)
+                    for x in qs
+                ]
+            ctx = ShardCtx(
+                axes=axes,
+                row_start=row_start,
+                q_writer=qs[0],
+                q_ver=qs[1],
+                q_tx=qs[2],
+                q_gw=qs[3] if track else None,
+            )
+            out, stats = gossip_ops._broadcast_round(
+                data_l, topo_f, alive_f, part, w, key, cfg,
+                loss=loss_f, shard=ctx,
+            )
+            stats["xshard_bytes_ici"] = jnp.float32(hop_ici)
+            stats["xshard_bytes_dcn"] = jnp.float32(hop_dcn)
+            return out, stats
+
+        dspecs = _data_specs(mesh)
+        topo_specs = jax.tree.map(lambda _: P(), topo)
+        stats_specs = {
+            k: P()
+            for k in (
+                "applied_broadcast", "msgs", "cell_merges",
+                "window_degraded", "lost_msgs",
+                "xshard_bytes_ici", "xshard_bytes_dcn",
+            )
+        }
+        in_specs = [dspecs, topo_specs, P(), P(), P(), P()]
+        args = [data, topo, alive, partition, writes, rng]
+        if loss is not None:
+            in_specs.append(P())
+            args.append(loss)
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=tuple(in_specs),
+            out_specs=(dspecs, stats_specs),
+            check_rep=False,
+        )
+        return fn(*args)
+
+    return bcast
+
+
+def simulate_sharded(
+    cfg,
+    topo,
+    sched,
+    mesh: Mesh,
+    seed: int = 0,
+    state=None,
+    max_chunk: int | None = None,
+    telemetry=None,
+):
+    """Dense-engine run under the shard_map round driver.
+
+    State is node-sharded over ``mesh`` (``shard_cluster_state``), the
+    topology is replicated, the broadcast plane runs through
+    :func:`make_sharded_broadcast`, and SWIM/sync/track stay
+    GSPMD-placed over the sharded carry. Curves carry the exchange's
+    per-round cross-shard bytes; results are bit-identical to
+    ``sim.simulate`` on one device (tests/test_shard_driver.py).
+    """
+    from corrosion_tpu.parallel import mesh as mesh_mod
+    from corrosion_tpu.sim import engine
+
+    if state is None:
+        state = engine.init_cluster(cfg, len(sched.sample_writer))
+        state = mesh_mod.shard_cluster_state(state, mesh)
+    return engine.simulate(
+        cfg, replicate(topo, mesh), sched, seed=seed, state=state,
+        max_chunk=max_chunk, telemetry=telemetry,
+        bcast_fn=make_sharded_broadcast(mesh),
+    )
+
+
+def per_device_state_bytes(tree) -> dict:
+    """Live-buffer bytes per device over a state pytree's addressable
+    shards — the measured (not arithmetic) side of the O(N/D) memory
+    claim in docs/SCALING.md. Replicated leaves (writer heads, slot
+    metadata) count fully on every device, sharded leaves only their
+    block, so the per-device total is exactly what that device's
+    allocator holds for the state."""
+    out: dict = {}
+    for leaf in jax.tree.leaves(tree):
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        for s in leaf.addressable_shards:
+            nbytes = int(np.prod(s.data.shape or (1,))) * s.data.dtype.itemsize
+            out[s.device] = out.get(s.device, 0) + nbytes
+    return out
+
+
+def simulate_sparse_sharded(
+    cfg,
+    topo,
+    sched,
+    mesh: Mesh,
+    seed: int = 0,
+    telemetry=None,
+    resume: dict | None = None,
+):
+    """Sparse-engine (any-node-writes) run under the shard_map driver:
+    slot-plane broadcast through the explicit exchange (queue entries
+    carry global writer ids, so ``q_gw`` rides the same gather), epoch
+    rotation/cold sync/SWIM over GSPMD-sharded state."""
+    from corrosion_tpu.parallel import mesh as mesh_mod
+    from corrosion_tpu.sim import sparse_engine
+
+    node = node_spec_entry(mesh)
+    if resume is None:
+        resume = sparse_engine.initial_resume(
+            cfg, len(sched.sample_writer)
+        )
+        resume["sstate"] = mesh_mod.shard_sparse_state(
+            resume["sstate"], mesh
+        )
+        resume["swim"] = jax.tree.map(
+            lambda x: jax.device_put(
+                x,
+                NamedSharding(
+                    mesh, P(node, *([None] * (x.ndim - 1)))
+                ),
+            ),
+            resume["swim"],
+        )
+        resume["vis_round"] = jax.device_put(
+            resume["vis_round"], NamedSharding(mesh, P(None, node))
+        )
+    return sparse_engine.simulate_sparse(
+        cfg, replicate(topo, mesh), sched, seed=seed, resume=resume,
+        telemetry=telemetry, bcast_fn=make_sharded_broadcast(mesh),
+    )
+
+
+def simulate_chunks_sharded(
+    ccfg,
+    origin,
+    last_seq,
+    rounds: int,
+    mesh: Mesh,
+    seed: int = 0,
+    max_chunk: int | None = None,
+    telemetry=None,
+):
+    """Chunk-plane (seq-chunk) run with coverage node-sharded over
+    ``mesh``. The chunk round's gossip is row-local gathers over the
+    bounded coverage tables, so GSPMD placement alone partitions it —
+    there is no version-plane broadcast queue to exchange explicitly,
+    and the xshard curve keys stay zero by design."""
+    import jax.numpy as jnp
+
+    from corrosion_tpu.parallel import mesh as mesh_mod
+    from corrosion_tpu.ops import chunks as chunk_ops
+    from corrosion_tpu.sim import chunk_engine
+
+    node = node_spec_entry(mesh)
+    origin = jnp.asarray(origin, jnp.int32)
+    last_seq = jnp.asarray(last_seq, jnp.int32)
+    state = mesh_mod.shard_chunk_state(
+        chunk_ops.init_chunks(ccfg, origin, last_seq), mesh
+    )
+    vis = jax.device_put(
+        jnp.full((ccfg.n_nodes, ccfg.n_streams), -1, jnp.int32),
+        NamedSharding(mesh, P(node, None)),
+    )
+    return chunk_engine.simulate_chunks(
+        ccfg, origin, replicate(last_seq, mesh), rounds, seed=seed,
+        max_chunk=max_chunk, telemetry=telemetry, state=state, vis=vis,
+    )
+
+
+def simulate_mixed_sharded(
+    cfg,
+    ccfg,
+    topo,
+    sched,
+    streams,
+    mesh: Mesh,
+    seed: int = 0,
+    max_chunk: int | None = None,
+    telemetry=None,
+):
+    """Mixed chunk+version run under the shard_map broadcast driver:
+    the version plane's delivery chain runs through the explicit queue
+    exchange (same ShardCtx path as the dense engine), the chunk plane
+    and big-version admission stay GSPMD-placed over the node-sharded
+    MixedState."""
+    from corrosion_tpu.parallel import mesh as mesh_mod
+    from corrosion_tpu.sim import mixed_engine
+
+    state = mesh_mod.shard_mixed_state(
+        mixed_engine.init_mixed_state(cfg, ccfg, topo, sched, streams),
+        mesh,
+    )
+    return mixed_engine.simulate_mixed(
+        cfg, ccfg, replicate(topo, mesh), sched, streams, seed=seed,
+        max_chunk=max_chunk, telemetry=telemetry, state=state,
+        bcast_fn=make_sharded_broadcast(mesh),
+    )

@@ -68,13 +68,20 @@ def rounded_step_report(step_ms: float, plane: dict) -> dict:
     }
 
 
-def plane_composite(cfg, topo, sched, final):
+def plane_composite(cfg, topo, sched, final, bcast_fn=None):
     """Build the cumulative-prefix attribution inputs for a finished run.
 
     Returns ``(make_step, stages, carry0)`` for
     ``telemetry.attribute_planes``: a composite round step over the run's
     FINAL state (fresh state would flatter sync — no deficits to score or
     grant) whose stages enable one at a time in execution order.
+
+    ``bcast_fn`` swaps the broadcast stage's driver exactly like the
+    engine scan bodies do — the multi-chip lane passes
+    ``parallel.shard_driver.make_sharded_broadcast(mesh)`` (with
+    ``final``/``topo`` already placed on the mesh) so the attributed
+    broadcast cost is the SHARDED delivery chain including its explicit
+    queue exchange, not the single-host form.
 
     NOTE: the big arrays ride the CARRY, never closures — a closed-over
     DataState would be embedded as compile-payload constants (hundreds of
@@ -83,6 +90,8 @@ def plane_composite(cfg, topo, sched, final):
     from corrosion_tpu.ops import gossip as gossip_ops
     from corrosion_tpu.ops import swim as swim_ops
 
+    if bcast_fn is None:
+        bcast_fn = gossip_ops.broadcast_round
     swim_impl = swim_ops.impl(cfg.swim)
     n_regions = int(np.asarray(topo.region).max()) + 1
     part = jnp.zeros((n_regions, n_regions), bool)
@@ -98,7 +107,7 @@ def plane_composite(cfg, topo, sched, final):
             k = jax.random.fold_in(key, i)
             k_b, k_sw, k_sy = jax.random.split(k, 3)
             if "broadcast" in enabled:
-                d, _ = gossip_ops.broadcast_round(
+                d, _ = bcast_fn(
                     d, topo, sw.alive, part, writes, k_b, cfg.gossip
                 )
             if "swim" in enabled:
@@ -126,6 +135,262 @@ def plane_composite(cfg, topo, sched, final):
     return composite, PLANE_STAGES, carry0
 
 
+# Multichip lane fixed shape (scripts/multichip_smoke.py + bench.py
+# --multichip): big enough that the broadcast queue exchange moves real
+# bytes, small enough that 4 device counts x 2 planes compile inside a
+# CI runner's budget.
+MULTICHIP_DEVICE_COUNTS = (1, 2, 4, 8)
+MULTICHIP_NODES = 512
+MULTICHIP_ROUNDS = 32
+MULTICHIP_SPARSE_NODES = 256
+MULTICHIP_SEED = 0
+# The O(N/D) acceptance bound: the max per-device live-state bytes at
+# D=8 must be at most this fraction of the D=1 state (1/8 sharded +
+# replicated writer heads and slot metadata leaves headroom to ~1/6).
+MULTICHIP_STATE_FRACTION = 1.0 / 6.0
+
+
+def multichip_mesh(d: int):
+    """The lane's mesh for a device count: 2-D (dcn, ici) from 4 devices
+    up — so the coalesced outer hop of the queue exchange is exercised,
+    not just the fast axis — else the 1-D node mesh."""
+    from corrosion_tpu import parallel
+
+    if d >= 4:
+        return parallel.make_wan_mesh(2, d // 2)
+    return parallel.make_mesh(d)
+
+
+def measure_multichip(
+    device_counts=MULTICHIP_DEVICE_COUNTS,
+    large_nodes: int | None = None,
+    large_rounds: int = 96,
+    progress=None,
+) -> dict:
+    """Measure the multi-chip lane: dense + sparse planes under the
+    explicit shard_map round driver at every requested device count.
+
+    Per device count D: warm per-round ``step_ms`` for both planes (the
+    SAME driver at D=1 anchors the scaling curve — shard_map over a
+    1-device mesh runs the identical code path with identity
+    collectives). At max(D) additionally: the cumulative-prefix plane
+    split measured ON THE SHARDED step (``plane_composite`` with the
+    sharded broadcast), the exchange's cross-shard bytes per round
+    (curves vs the static :func:`traffic_model` — they must agree
+    exactly), max per-device live-state MiB vs the D=1 state bytes
+    (the measured O(N/D) claim), and dense convergence. Final states
+    and curves are asserted bit-identical across every device count —
+    a multichip artifact can never publish numbers from diverged runs.
+
+    ``large_nodes`` appends the "largest sharded run" tail: a dense
+    convergence run at that node count on the max-D mesh, reported
+    under ``large`` (step_ms, per-device state MiB, converged).
+
+    Returns the self-describing report dict (caller emits it through
+    ``telemetry.check_bench_invariants``).
+    """
+    import time
+
+    from corrosion_tpu import models, parallel
+    from corrosion_tpu.models.baselines import anywrite_sparse
+    from corrosion_tpu.ops import onehot
+    from corrosion_tpu.sim import telemetry
+
+    def note(msg):
+        if progress is not None:
+            progress.write(f"[multichip] {msg}\n")
+            progress.flush()
+
+    cfg, topo, sched = models.merge_10k(
+        n=MULTICHIP_NODES, rounds=MULTICHIP_ROUNDS, samples=64
+    )
+    s_cfg, s_topo, s_sched = anywrite_sparse(
+        n=MULTICHIP_SPARSE_NODES, w_hot=16, rounds=MULTICHIP_ROUNDS,
+        n_regions=4, epoch_rounds=8, cohort=10, burst_writes=2,
+        samples=16, k_dev=8,
+    )
+    dmax = max(device_counts)
+    report: dict = {}
+    ref_contig = ref_curves = None
+    s_ref = None
+    state_mib: dict = {}
+    for d in sorted(device_counts):
+        mesh = multichip_mesh(d)
+        note(f"D={d}: dense compile+run")
+        final, curves = parallel.simulate_sharded(
+            cfg, topo, sched, mesh, seed=MULTICHIP_SEED
+        )
+        jax.block_until_ready(final.data.contig)
+        t0 = time.perf_counter()
+        final, curves = parallel.simulate_sharded(
+            cfg, topo, sched, mesh, seed=MULTICHIP_SEED
+        )
+        jax.block_until_ready(final.data.contig)
+        step_ms = (
+            (time.perf_counter() - t0) / MULTICHIP_ROUNDS * 1000.0
+        )
+        contig = np.asarray(final.data.contig)
+        if ref_contig is None:
+            ref_contig, ref_curves = contig, curves
+        else:
+            np.testing.assert_array_equal(
+                contig, ref_contig,
+                err_msg=f"dense final state diverged at D={d}",
+            )
+            for k in ref_curves:
+                if k.startswith("xshard"):
+                    continue
+                np.testing.assert_array_equal(
+                    ref_curves[k], curves[k],
+                    err_msg=f"dense curve {k} diverged at D={d}",
+                )
+        per_dev = parallel.per_device_state_bytes(final)
+        state_mib[d] = max(per_dev.values()) / 2**20
+        note(f"D={d}: sparse compile+run")
+        s_final = parallel.simulate_sparse_sharded(
+            s_cfg, s_topo, s_sched, mesh, seed=MULTICHIP_SEED
+        )
+        jax.block_until_ready(s_final[0].data.contig)
+        t0 = time.perf_counter()
+        s_final = parallel.simulate_sparse_sharded(
+            s_cfg, s_topo, s_sched, mesh, seed=MULTICHIP_SEED
+        )
+        jax.block_until_ready(s_final[0].data.contig)
+        s_step_ms = (
+            (time.perf_counter() - t0) / MULTICHIP_ROUNDS * 1000.0
+        )
+        if s_ref is None:
+            s_ref = np.asarray(s_final[0].data.contig)
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(s_final[0].data.contig), s_ref,
+                err_msg=f"sparse final state diverged at D={d}",
+            )
+        sfx = "" if d == dmax else f"_d{d}"
+        if d == dmax:
+            # Plane split measured ON the sharded step: the composite's
+            # broadcast stage is the shard_map delivery chain including
+            # its explicit queue exchange.
+            note(f"D={d}: plane attribution")
+            bfn = parallel.make_sharded_broadcast(mesh)
+            composite, stages, carry0 = plane_composite(
+                cfg, parallel.replicate(topo, mesh), sched, final,
+                bcast_fn=bfn,
+            )
+            attr = telemetry.attribute_planes(
+                composite, stages, carry0, iters=10
+            )
+            plane, _ = attr.scale(step_ms)
+            report.update(rounded_step_report(step_ms, plane))
+            tm = parallel.traffic_model(cfg.gossip, mesh)
+            got_ici = float(curves["xshard_bytes_ici"][0])
+            got_dcn = float(curves["xshard_bytes_dcn"][0])
+            if (got_ici, got_dcn) != (
+                tm["xshard_bytes_ici"], tm["xshard_bytes_dcn"]
+            ):
+                raise AssertionError(
+                    f"measured cross-shard bytes ({got_ici}, {got_dcn}) "
+                    f"!= static traffic model ({tm['xshard_bytes_ici']},"
+                    f" {tm['xshard_bytes_dcn']})"
+                )
+            heads = np.asarray(final.data.head)
+            report.update(
+                {
+                    "xshard_bytes_per_round_ici": got_ici,
+                    "xshard_bytes_per_round_dcn": got_dcn,
+                    "traffic_model": tm["detail"],
+                    "converged": bool((contig == heads[None, :]).all()),
+                }
+            )
+        else:
+            report[f"step_ms{sfx}"] = round(step_ms, 1)
+        report[f"step_ms_sparse{sfx or '_d' + str(d)}"] = round(
+            s_step_ms, 1
+        )
+    frac = state_mib[dmax] / state_mib[min(device_counts)]
+    report.update(
+        {
+            **bench_context(
+                cfg, s_cfg, MULTICHIP_NODES, MULTICHIP_ROUNDS,
+                MULTICHIP_SEED, tuple(sorted(device_counts)),
+            ),
+            "kernels": onehot.resolve_backend(cfg.gossip.kernel_backend),
+            "metric": "multichip_step_scaling",
+            "nodes": MULTICHIP_NODES,
+            "sparse_nodes": MULTICHIP_SPARSE_NODES,
+            "rounds": MULTICHIP_ROUNDS,
+            "seed": MULTICHIP_SEED,
+            "device_counts": sorted(device_counts),
+            "device_count": dmax,
+            "state_mib_per_device": {
+                f"d{d}": round(v, 3) for d, v in state_mib.items()
+            },
+            "state_fraction_dmax": round(frac, 4),
+            "bit_identical_across_device_counts": True,
+        }
+    )
+    if len(device_counts) > 1 and frac > MULTICHIP_STATE_FRACTION:
+        raise AssertionError(
+            f"per-device state at D={dmax} holds {frac:.3f} of the "
+            f"D={min(device_counts)} state bytes — O(N/D) sharding "
+            f"requires <= {MULTICHIP_STATE_FRACTION:.3f}"
+        )
+    if large_nodes:
+        note(f"large: {large_nodes} nodes on D={dmax}")
+        report["large"] = _measure_large(
+            large_nodes, large_rounds, multichip_mesh(dmax), note
+        )
+    return report
+
+
+def _measure_large(n_nodes: int, rounds: int, mesh, note) -> dict:
+    """The 'largest sharded run the host can hold' tail: a dense
+    convergence run at ``n_nodes`` on the lane's max mesh — light early
+    writes, then drain (the dryrun's schedule shape), queue depth 16
+    (wall-clock fidelity note in __graft_entry__.dryrun_multichip)."""
+    import time
+    from dataclasses import replace as dc_replace
+
+    from corrosion_tpu import models, parallel
+
+    n_writers = min(128, n_nodes // 4)
+    cfg, topo, sched = models.wan_100k(
+        n=n_nodes, n_regions=8, n_writers=n_writers, rounds=rounds,
+        samples=16, partition=False,
+    )
+    cfg = dc_replace(cfg, gossip=dc_replace(cfg.gossip, queue=16))
+    sched.writes[:, :] = 0
+    sched.writes[:6, :] = 1
+    sched = sched.make_samples(16)
+    t0 = time.perf_counter()
+    final, curves = parallel.simulate_sharded(
+        cfg, topo, sched, mesh, seed=MULTICHIP_SEED
+    )
+    jax.block_until_ready(final.data.contig)
+    wall = time.perf_counter() - t0
+    heads = np.asarray(final.data.head)
+    per_dev = parallel.per_device_state_bytes(final)
+    note(f"large: {wall:.0f}s wall, need={int(curves['need'][-1])}")
+    return {
+        "nodes": n_nodes,
+        "rounds": rounds,
+        "step_ms_incl_compile": round(wall / rounds * 1000.0, 1),
+        "converged": bool(
+            (np.asarray(final.data.contig) == heads[None, :]).all()
+        ),
+        "need_last": int(curves["need"][-1]),
+        "state_mib_per_device_max": round(
+            max(per_dev.values()) / 2**20, 2
+        ),
+        "xshard_bytes_per_round_ici": float(
+            curves["xshard_bytes_ici"][0]
+        ),
+        "xshard_bytes_per_round_dcn": float(
+            curves["xshard_bytes_dcn"][0]
+        ),
+    }
+
+
 def check_budget(
     measured: dict, budget: dict
 ) -> tuple[bool, list[str]]:
@@ -146,7 +411,7 @@ def check_budget(
     """
     tol = float(budget.get("tolerance", DEFAULT_TOLERANCE))
     breaches: list[str] = []
-    for dim in ("nodes", "rounds", "platform", "kernels"):
+    for dim in ("nodes", "rounds", "platform", "kernels", "device_count"):
         if dim in budget and measured.get(dim) != budget[dim]:
             breaches.append(
                 f"{dim}: measured at {measured.get(dim)} but the budget "

@@ -110,6 +110,8 @@ def simulate_chunks(
     max_chunk: int | None = None,
     telemetry: KernelTelemetry | None = None,
     faults=None,
+    state=None,
+    vis=None,
 ):
     """Run ``rounds`` chunk-plane rounds; returns (state, metrics dict).
 
@@ -131,12 +133,21 @@ def simulate_chunks(
     full holder makes it unrecoverable, so plans protect origins).
     Partition components are rejected loudly — there is no region
     topology to cut.
+
+    ``state``/``vis`` supply pre-built carries — the multi-chip path
+    places ``init_chunks`` output and the visibility latch on a mesh
+    (``parallel.shard_chunk_state`` / node-major) and passes them in;
+    everything else about the run is unchanged (GSPMD partitions the
+    row-local chunk round, so curves stay bit-identical to the
+    unsharded run — pinned in tests/test_shard_driver.py).
     """
     origin = jnp.asarray(origin, jnp.int32)
     last_seq = jnp.asarray(last_seq, jnp.int32)
-    state = chunk_ops.init_chunks(cfg, origin, last_seq)
+    if state is None:
+        state = chunk_ops.init_chunks(cfg, origin, last_seq)
     alive = jnp.ones((cfg.n_nodes,), bool)
-    vis = jnp.full((cfg.n_nodes, cfg.n_streams), -1, jnp.int32)
+    if vis is None:
+        vis = jnp.full((cfg.n_nodes, cfg.n_streams), -1, jnp.int32)
     base_key = jax.random.PRNGKey(seed)
 
     alive_np = loss_np = wipe_np = None

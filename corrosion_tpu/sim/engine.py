@@ -140,6 +140,7 @@ def _cluster_round(
     loss: jax.Array | None = None,  # f32[R] chaos receiver-region loss
     probe_loss: jax.Array | None = None,  # f32[] chaos probe/ack loss
     wipe: jax.Array | None = None,  # bool[N] crash-with-state-wipe
+    bcast_fn=None,  # static broadcast override (parallel/shard_driver)
 ) -> tuple[ClusterState, dict]:
     # The rejoin key exists only for churn configs, so churn-free runs
     # keep bit-identical RNG streams with earlier measurements. The
@@ -168,8 +169,13 @@ def _cluster_round(
         )
     alive = sw.alive
 
+    # The broadcast plane is the one round stage with a pluggable driver:
+    # ``bcast_fn`` (trace-time static) swaps in the explicit shard_map
+    # delivery of parallel/shard_driver.make_sharded_broadcast — same
+    # signature, same stats contract plus the cross-shard byte counts.
+    bfn = gossip_ops.broadcast_round if bcast_fn is None else bcast_fn
     with jax.named_scope("corro_broadcast"):
-        data, bstats = gossip_ops.broadcast_round(
+        data, bstats = bfn(
             data_pre, topo, alive, partition, writes, k_bcast, cfg.gossip,
             loss=loss,
         )
@@ -237,6 +243,11 @@ def _cluster_round(
             jnp.uint32(0) if wipe is None
             else jnp.sum(wipe, dtype=jnp.uint32)
         ),
+        # Cross-shard traffic of the explicit exchange (zero under the
+        # single-host/GSPMD drivers — only the shard_map broadcast
+        # reports bytes, and they are exact static accounting).
+        xshard_bytes_ici=bstats.get("xshard_bytes_ici", jnp.float32(0.0)),
+        xshard_bytes_dcn=bstats.get("xshard_bytes_dcn", jnp.float32(0.0)),
         **lat_hist,
     )
     return (
@@ -253,11 +264,12 @@ def _cluster_round(
 # 100k configs). Donation binds at top-level calls only; the plain entry
 # stays the default for ad-hoc stepping where the caller may re-read its
 # input state. See docs/PERFORMANCE.md ("Donation invariants").
-cluster_round = partial(jax.jit, static_argnames=("cfg", "has_churn"))(
-    _cluster_round
-)
+cluster_round = partial(
+    jax.jit, static_argnames=("cfg", "has_churn", "bcast_fn")
+)(_cluster_round)
 cluster_round_donated = partial(
-    jax.jit, static_argnames=("cfg", "has_churn"), donate_argnums=(0,)
+    jax.jit, static_argnames=("cfg", "has_churn", "bcast_fn"),
+    donate_argnums=(0,),
 )(_cluster_round)
 
 
@@ -269,6 +281,7 @@ def simulate(
     state: ClusterState | None = None,
     max_chunk: int | None = None,
     telemetry: KernelTelemetry | None = None,
+    bcast_fn=None,
     _donate_state: bool = False,
 ) -> tuple[ClusterState, dict]:
     """Scan `cluster_round` over the schedule. Returns final state + per-round
@@ -279,6 +292,12 @@ def simulate(
     can trip device-side watchdogs, and chunking also bounds the stacked
     curve buffers. Results are identical either way — per-round RNG keys
     fold in the absolute round index.
+
+    ``bcast_fn`` (trace-time static) swaps the broadcast plane's driver —
+    the multi-chip path passes
+    ``parallel.shard_driver.make_sharded_broadcast(mesh)`` with a
+    node-sharded ``state`` and a replicated ``topo`` (use
+    ``parallel.simulate_sharded`` for the packaged form).
 
     ``telemetry`` (sim.telemetry.KernelTelemetry) instruments the run:
     each chunk execution (the whole run counts as one chunk when
@@ -348,7 +367,7 @@ def simulate(
             if telemetry is None:
                 cur, curves = simulate(
                     cfg, topo, part, seed=seed, state=cur,
-                    _donate_state=owned,
+                    bcast_fn=bcast_fn, _donate_state=owned,
                 )
             else:
                 # Chunk boundary: time the execution, span it, and flush
@@ -358,7 +377,7 @@ def simulate(
                     start_round + start,
                     lambda part=part, cur=cur, owned=owned: simulate(
                         cfg, topo, part, seed=seed, state=cur,
-                        _donate_state=owned,
+                        bcast_fn=bcast_fn, _donate_state=owned,
                     ),
                 )
             owned = True
@@ -435,7 +454,7 @@ def simulate(
     if telemetry is None:
         final, curves = _scan_rounds_donated(
             state, topo, xs, s_writer, s_ver, s_round, base_key, cfg,
-            has_churn,
+            has_churn, bcast_fn=bcast_fn,
         )
     else:
         # Unchunked run with telemetry: the whole execution is one chunk.
@@ -443,7 +462,7 @@ def simulate(
             offset,
             lambda: _scan_rounds_donated(
                 state, topo, xs, s_writer, s_ver, s_round, base_key, cfg,
-                has_churn,
+                has_churn, bcast_fn=bcast_fn,
             ),
         )
     curves = {k: np.asarray(v) for k, v in curves.items()}
@@ -453,7 +472,8 @@ def simulate(
 
 
 def _scan_rounds_impl(
-    state, topo, xs, s_writer, s_ver, s_round, base_key, cfg, has_churn
+    state, topo, xs, s_writer, s_ver, s_round, base_key, cfg, has_churn,
+    bcast_fn=None,
 ):
     """Whole-run scan, jitted once per (cfg, shapes): repeat calls — e.g. a
     timed bench run after a warm-up — hit the compile cache (the seed is a
@@ -464,7 +484,7 @@ def _scan_rounds_impl(
         key = jax.random.fold_in(base_key, r)
         return cluster_round(
             carry, topo, w, p, kl, rv, s_writer, s_ver, s_round, key, cfg,
-            has_churn, loss=lo, probe_loss=pl, wipe=wp,
+            has_churn, loss=lo, probe_loss=pl, wipe=wp, bcast_fn=bcast_fn,
         )
 
     return jax.lax.scan(body, state, xs)
@@ -481,11 +501,12 @@ def _scan_rounds_impl(
 # a double donation) — are made owned by ONE `telemetry.owned_copy` per run,
 # amortized across all chunks. The plain entry remains for ad-hoc
 # callers that want non-consuming semantics without a copy.
-_scan_rounds = partial(jax.jit, static_argnames=("cfg", "has_churn"))(
-    _scan_rounds_impl
-)
+_scan_rounds = partial(
+    jax.jit, static_argnames=("cfg", "has_churn", "bcast_fn")
+)(_scan_rounds_impl)
 _scan_rounds_donated = partial(
-    jax.jit, static_argnames=("cfg", "has_churn"), donate_argnums=(0,)
+    jax.jit, static_argnames=("cfg", "has_churn", "bcast_fn"),
+    donate_argnums=(0,),
 )(_scan_rounds_impl)
 
 

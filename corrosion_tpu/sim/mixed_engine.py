@@ -166,7 +166,7 @@ def _backfill_coverage(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "ccfg", "has_churn"))
+@partial(jax.jit, static_argnames=("cfg", "ccfg", "has_churn", "bcast_fn"))
 def mixed_round(
     state: MixedState,
     topo: Topology,
@@ -188,6 +188,7 @@ def mixed_round(
     loss: jax.Array | None = None,  # f32[R] chaos receiver-region loss
     probe_loss: jax.Array | None = None,  # f32[]
     wipe: jax.Array | None = None,  # bool[N] crash-with-state-wipe
+    bcast_fn=None,  # static broadcast override (parallel/shard_driver)
 ) -> tuple[MixedState, dict]:
     # Churn/rejoin keys exist only for churn configs so fault-free runs
     # keep bit-identical RNG streams (same discipline as the dense
@@ -259,8 +260,12 @@ def mixed_round(
         data, newly, s_writer, s_version, cfg.gossip
     )
 
-    # Ordinary broadcast + SWIM + sync.
-    data, bstats = gossip_ops.broadcast_round(
+    # Ordinary broadcast + SWIM + sync. The broadcast plane's driver is
+    # pluggable exactly like the dense engine: ``bcast_fn`` (trace-time
+    # static) swaps in the explicit shard_map delivery of
+    # parallel/shard_driver.make_sharded_broadcast.
+    bfn = gossip_ops.broadcast_round if bcast_fn is None else bcast_fn
+    data, bstats = bfn(
         data, topo, alive, part, writes, k_b, cfg.gossip, loss=loss
     )
     sw = swim_impl.swim_round(
@@ -342,6 +347,10 @@ def mixed_round(
             jnp.uint32(0) if wipe is None
             else jnp.sum(wipe, dtype=jnp.uint32)
         ),
+        # Cross-shard traffic of the explicit exchange (zero under the
+        # single-host/GSPMD drivers; see sim/engine.py).
+        xshard_bytes_ici=bstats.get("xshard_bytes_ici", jnp.float32(0.0)),
+        xshard_bytes_dcn=bstats.get("xshard_bytes_dcn", jnp.float32(0.0)),
         **telemetry_mod.delivery_latency_hist(
             state.round - sample_round[:, None], newly
         ),
@@ -358,7 +367,7 @@ def mixed_round(
 
 def _scan_mixed_impl(
     state, topo, xs, s_writer, s_version, s_last, s_w, s_v, s_r,
-    base_key, cfg, ccfg, has_churn,
+    base_key, cfg, ccfg, has_churn, bcast_fn=None,
 ):
     """Whole-chunk scan, jitted once per (cfg, shapes) — chunked runs
     with equal chunk lengths hit the compile cache."""
@@ -369,7 +378,7 @@ def _scan_mixed_impl(
         return mixed_round(
             carry, topo, w, c, p, kl, rv, s_writer, s_version, s_last,
             s_w, s_v, s_r, key, cfg, ccfg, has_churn,
-            loss=lo, probe_loss=pl, wipe=wp,
+            loss=lo, probe_loss=pl, wipe=wp, bcast_fn=bcast_fn,
         )
 
     return jax.lax.scan(body, state, xs)
@@ -382,43 +391,31 @@ def _scan_mixed_impl(
 # freshly-built carry is made donatable by one deep copy — zero-filled
 # leaves can share one constant buffer, which XLA rejects as a double
 # donation. The plain entry remains for ad-hoc callers.
-_scan_mixed = partial(jax.jit, static_argnames=("cfg", "ccfg", "has_churn"))(
-    _scan_mixed_impl
-)
+_scan_mixed = partial(
+    jax.jit, static_argnames=("cfg", "ccfg", "has_churn", "bcast_fn")
+)(_scan_mixed_impl)
 _scan_mixed_donated = partial(
-    jax.jit, static_argnames=("cfg", "ccfg", "has_churn"),
+    jax.jit, static_argnames=("cfg", "ccfg", "has_churn", "bcast_fn"),
     donate_argnums=(0,),
 )(_scan_mixed_impl)
 
 
-def simulate_mixed(
+def init_mixed_state(
     cfg: ClusterConfig,
     ccfg: ChunkConfig,
     topo: Topology,
-    schedule: Schedule,  # SMALL writes only
+    schedule: Schedule,
     streams: StreamSpec,
-    seed: int = 0,
-    max_chunk: int | None = None,
-    telemetry: KernelTelemetry | None = None,
-):
-    """Scan mixed_round over the schedule. Returns (final, curves).
-
-    Emits the canonical RoundCurves schema (sim/telemetry.py) like every
-    other engine. ``max_chunk`` splits the run into several device
-    executions (state carried across; per-round RNG keys fold the
-    absolute round index, so results are identical either way), and
-    ``telemetry`` (sim.telemetry.KernelTelemetry) instruments each
-    execution as a chunk — timed, spanned, flushed to the flight
-    recorder, with run totals folded into the metrics registry.
-    """
+) -> MixedState:
+    """Fresh composite state for ``simulate_mixed`` — factored out so the
+    sharded driver (parallel/shard_driver.py) can build it, place it on a
+    mesh, and pass it back through ``simulate_mixed(state=...)``."""
     n = cfg.n_nodes
-    s_writer = jnp.asarray(streams.writer, jnp.int32)
-    s_version = jnp.asarray(streams.version, jnp.uint32)
     s_last = jnp.asarray(streams.last_seq, jnp.int32)
     origin_nodes = np.asarray(topo.writer_nodes)[
         np.asarray(streams.writer)
     ]
-    state = MixedState(
+    return MixedState(
         data=gossip_ops.init_data(cfg.gossip),
         swim=swim_ops.impl(cfg.swim).init_state(cfg.swim),
         chunks=chunk_ops.init_chunks(
@@ -430,6 +427,43 @@ def simulate_mixed(
             (len(schedule.sample_writer), n), -1, jnp.int32
         ),
     )
+
+
+def simulate_mixed(
+    cfg: ClusterConfig,
+    ccfg: ChunkConfig,
+    topo: Topology,
+    schedule: Schedule,  # SMALL writes only
+    streams: StreamSpec,
+    seed: int = 0,
+    max_chunk: int | None = None,
+    telemetry: KernelTelemetry | None = None,
+    state: MixedState | None = None,
+    bcast_fn=None,
+):
+    """Scan mixed_round over the schedule. Returns (final, curves).
+
+    Emits the canonical RoundCurves schema (sim/telemetry.py) like every
+    other engine. ``max_chunk`` splits the run into several device
+    executions (state carried across; per-round RNG keys fold the
+    absolute round index, so results are identical either way), and
+    ``telemetry`` (sim.telemetry.KernelTelemetry) instruments each
+    execution as a chunk — timed, spanned, flushed to the flight
+    recorder, with run totals folded into the metrics registry.
+
+    ``state`` supplies a pre-built (e.g. node-sharded) initial
+    MixedState — ``init_mixed_state`` builds the canonical fresh one —
+    and ``bcast_fn`` (trace-time static) swaps the broadcast plane's
+    driver, the multi-chip path being
+    ``parallel.shard_driver.make_sharded_broadcast(mesh)`` (use
+    ``parallel.simulate_mixed_sharded`` for the packaged form).
+    """
+    n = cfg.n_nodes
+    s_writer = jnp.asarray(streams.writer, jnp.int32)
+    s_version = jnp.asarray(streams.version, jnp.uint32)
+    s_last = jnp.asarray(streams.last_seq, jnp.int32)
+    if state is None:
+        state = init_mixed_state(cfg, ccfg, topo, schedule, streams)
     rounds = schedule.rounds
     writes = jnp.asarray(schedule.writes, jnp.uint32)
     commit = np.zeros((rounds, len(streams.writer)), bool)
@@ -497,12 +531,14 @@ def simulate_mixed(
             state, curves = _scan_mixed_donated(
                 state, topo, xs, s_writer, s_version, s_last,
                 s_w, s_v, s_r, base_key, cfg, ccfg, has_churn,
+                bcast_fn=bcast_fn,
             )
         else:
             def _run(state=state, xs=xs):
                 return _scan_mixed_donated(
                     state, topo, xs, s_writer, s_version, s_last,
                     s_w, s_v, s_r, base_key, cfg, ccfg, has_churn,
+                    bcast_fn=bcast_fn,
                 )
 
             state, curves = telemetry.run_chunk(r0, _run)

@@ -232,9 +232,11 @@ def _epoch_scan_impl(
     cfg: SparseClusterConfig,
     sp: SparseConfig,
     has_churn: bool,
+    bcast_fn=None,  # static broadcast override (parallel/shard_driver)
 ):
     swim_impl = swim_ops.impl(cfg.swim)
     region = topo.region
+    bfn = gossip_ops.broadcast_round if bcast_fn is None else bcast_fn
 
     def body(carry, x):
         st, sw, vr = carry
@@ -253,7 +255,7 @@ def _epoch_scan_impl(
         alive = sw.alive
 
         with jax.named_scope("corro_broadcast"):
-            data, bstats = gossip_ops.broadcast_round(
+            data, bstats = bfn(
                 st.data, topo, alive, part, w_slots, k_b, cfg.gossip,
                 loss=lo,
             )
@@ -328,6 +330,12 @@ def _epoch_scan_impl(
             ),
             queue_backlog=gossip_ops.queue_backlog(st.data),
             chaos_lost_msgs=bstats["lost_msgs"],
+            xshard_bytes_ici=bstats.get(
+                "xshard_bytes_ici", jnp.float32(0.0)
+            ),
+            xshard_bytes_dcn=bstats.get(
+                "xshard_bytes_dcn", jnp.float32(0.0)
+            ),
             **lat_hist,
         )
         return (st, sw, vr_new), stats
@@ -348,11 +356,11 @@ def _epoch_scan_impl(
 # can share constant buffers, and a caller's resume snapshot must stay
 # replayable — amortized over the run. docs/PERFORMANCE.md ("Donation
 # invariants"); the plain entry remains for ad-hoc callers.
-_epoch_scan = partial(jax.jit, static_argnames=("cfg", "sp", "has_churn"))(
-    _epoch_scan_impl
-)
+_epoch_scan = partial(
+    jax.jit, static_argnames=("cfg", "sp", "has_churn", "bcast_fn")
+)(_epoch_scan_impl)
 _epoch_scan_donated = partial(
-    jax.jit, static_argnames=("cfg", "sp", "has_churn"),
+    jax.jit, static_argnames=("cfg", "sp", "has_churn", "bcast_fn"),
     donate_argnums=(0, 1, 2),
 )(_epoch_scan_impl)
 
@@ -393,6 +401,7 @@ def simulate_sparse(
     resume: dict | None = None,
     stop_after_epoch: int | None = None,
     telemetry: KernelTelemetry | None = None,
+    bcast_fn=None,
 ):
     """Run the epoch-rotated any-node-writes simulation. Returns
     (final_sparse_state, swim_state, vis_round, curves, info).
@@ -531,6 +540,7 @@ def simulate_sparse(
                 sstate, swim_state, vis_round, topo,
                 (writes_slots, kill, revive, ridx, loss_e, probe_e), part,
                 s_slot, s_ver, s_round, base_key, cfg, sp, has_churn,
+                bcast_fn=bcast_fn,
             )
         else:
             # Epoch boundary == chunk boundary for the flight recorder.
@@ -544,6 +554,7 @@ def simulate_sparse(
                     (writes_slots, kill, revive, ridx, loss_e, probe_e),
                     part,
                     s_slot, s_ver, s_round, base_key, cfg, sp, has_churn,
+                    bcast_fn=bcast_fn,
                 )
                 return out[:3], out[3]
 

@@ -80,6 +80,17 @@ HEALTH_CURVE_KEYS = (
     "seqs_granted",  # chunk-plane seqs granted by partial-need sync
 ) + CHAOS_CURVE_KEYS + VIS_LAT_KEYS
 
+# Multi-chip scale-out plane (parallel/shard_driver.py): exact per-round
+# cross-shard byte volume of the explicit broadcast queue exchange,
+# split by mesh axis (ici = innermost/fast hop, dcn = coalesced outer
+# hop(s)). Zero under the single-host and GSPMD drivers — a nonzero
+# value certifies the shard_map delivery path ran. f32 (byte counts at
+# 100k-node shapes exceed u32).
+XSHARD_CURVE_KEYS = (
+    "xshard_bytes_ici",  # queue-exchange bytes over the fast axis
+    "xshard_bytes_dcn",  # queue-exchange bytes across dcn groups
+)
+
 # Canonical per-round curve keys. Every engine's scan body emits exactly
 # this set (superset of the former ad-hoc dicts); semantics per key are
 # documented in docs/OBSERVABILITY.md ("Kernel plane" + "Convergence
@@ -96,7 +107,7 @@ ROUND_CURVE_KEYS = (
     "sync_regrant",
     "cold_healed",
     "vis_count",
-) + HEALTH_CURVE_KEYS
+) + HEALTH_CURVE_KEYS + XSHARD_CURVE_KEYS
 
 # Level-style curves whose end-of-run value is a convergence verdict on
 # its own: published additionally as ``<series>_last`` gauges.

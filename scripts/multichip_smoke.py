@@ -1,0 +1,158 @@
+"""CI multichip gate: the standing sharded bench lane vs its budget.
+
+Runs the dense + sparse planes under the explicit shard_map round driver
+(parallel/shard_driver.py) at device_count ∈ {1, 2, 4, 8} on the
+8-virtual-device CPU mesh (or real chips when the host has >= 8), and
+records the lane's whole evidence chain in one self-describing JSON
+artifact:
+
+- warm per-round ``step_ms`` per device count for BOTH planes (the D=1
+  anchor runs the same driver with identity collectives);
+- the per-plane step split at D=8, measured on the SHARDED composite
+  (broadcast = the shard_map delivery chain incl. its queue exchange);
+- cross-shard bytes per round (measured curves, asserted equal to the
+  static ``traffic_model``) split by mesh axis (ici vs dcn);
+- max per-device live-state MiB per device count, with the O(N/D)
+  acceptance bound (D=8 holds <= 1/6 of the D=1 state bytes) enforced;
+- bit-identity of final state and curves across every device count —
+  the lane refuses to publish numbers from diverged runs.
+
+The D=8 dense ``step_ms`` / ``plane_ms`` gate against the ``multichip``
+entry in ``bench_budget.json`` exactly like the bench-smoke gate
+(``benchlib.check_budget``; a missing entry is a breach, not a skip).
+NOTE on reading the curve: on the VIRTUAL CPU mesh D>1 is slower than
+D=1 — eight shards of one host CPU plus real collectives — so the gate
+bounds regression of the sharded step itself; the D-scaling *speedup*
+story belongs to real-chip runs of this same lane (docs/SCALING.md
+"Multi-chip").
+
+Usage:
+    python scripts/multichip_smoke.py [--out report.json] [--budget FILE]
+    python scripts/multichip_smoke.py --update     # refresh budget entry
+    python scripts/multichip_smoke.py --large N [--large-rounds R]
+        # append the "largest sharded run the host can hold" tail
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+# Must run before jax initializes a backend: the lane needs >= 8 devices,
+# which off real multi-chip hardware means the virtual CPU mesh.
+_flags = _os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+UPDATE_HEADROOM = 3.0  # budget = measured * this (docs/PERFORMANCE.md)
+UPDATE_PLANE_FLOOR_MS = 30.0  # same floor rationale as bench_smoke.py
+
+
+def main(argv=None) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", default=str(repo / "bench_budget.json"))
+    ap.add_argument("--out", default="multichip_report.json")
+    ap.add_argument(
+        "--update", action="store_true",
+        help="rewrite the budget file's `multichip` entry from this "
+        f"measurement (x{UPDATE_HEADROOM} headroom) instead of gating",
+    )
+    ap.add_argument(
+        "--large", type=int, default=None, metavar="NODES",
+        help="append a sharded convergence run at NODES nodes (the "
+        "largest-run tail; not gated — evidence, recorded in the "
+        "artifact)",
+    )
+    ap.add_argument("--large-rounds", type=int, default=96)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if jax.config.jax_platforms and "axon" in jax.config.jax_platforms:
+        jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < 8:
+        print(
+            f"[multichip] need 8 devices, have {len(jax.devices())}",
+            file=sys.stderr,
+        )
+        return 2
+
+    from corrosion_tpu.sim import benchlib, telemetry
+
+    measured = telemetry.check_bench_invariants(
+        benchlib.measure_multichip(
+            large_nodes=args.large, large_rounds=args.large_rounds,
+            progress=sys.stderr,
+        )
+    )
+
+    budget_path = Path(args.budget)
+    if args.update:
+        budget = (
+            json.loads(budget_path.read_text())
+            if budget_path.exists() else {}
+        )
+        budget["multichip"] = {
+            "platform": measured["platform"],
+            "kernels": measured["kernels"],
+            "nodes": measured["nodes"],
+            "rounds": measured["rounds"],
+            "device_count": measured["device_count"],
+            "step_ms": round(
+                measured["step_ms"] * UPDATE_HEADROOM, 1
+            ),
+            "plane_ms": {
+                k: round(
+                    max(v * UPDATE_HEADROOM, UPDATE_PLANE_FLOOR_MS), 1
+                )
+                for k, v in measured["plane_ms"].items()
+            },
+        }
+        budget_path.write_text(json.dumps(budget, indent=2) + "\n")
+        print(f"[multichip] budget entry refreshed: {budget_path}")
+        print(json.dumps(measured))
+        return 0
+
+    budget = json.loads(budget_path.read_text())
+    if "multichip" not in budget:
+        # Measuring without gating is how regressions pass silently.
+        ok, breaches = False, [
+            "multichip: entry missing from bench_budget.json — rerun "
+            "with --update"
+        ]
+    else:
+        ok, breaches = benchlib.check_budget(
+            measured,
+            {
+                "tolerance": budget.get(
+                    "tolerance", benchlib.DEFAULT_TOLERANCE
+                ),
+                **budget["multichip"],
+            },
+        )
+    report = {
+        **measured,
+        "budget": budget.get("multichip"),
+        "ok": ok,
+        "breaches": breaches,
+    }
+    Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report))
+    if not ok:
+        for b in breaches:
+            print(f"[multichip] BREACH {b}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

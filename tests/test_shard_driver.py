@@ -1,0 +1,281 @@
+"""Shard-count invariance for the explicit shard_map round driver.
+
+tests/test_parallel_mesh.py pins the GSPMD placements; this module pins
+the multi-chip plane built on top of them (parallel/shard_driver.py):
+
+- All FOUR engine planes (dense, sparse, chunk, mixed) are bit-identical
+  across device_count ∈ {1, 2, 4, 8} under the sharded entries — the
+  explicit broadcast queue exchange and the GSPMD-placed remainder must
+  not change semantics on any mesh shape (1-D node mesh at D ≤ 2, the
+  2-D (dcn, ici) WAN mesh from D = 4 so the coalesced outer hop is
+  exercised too).
+- The measured cross-shard curves equal the static ``traffic_model``
+  exactly (and stay zero at D=1 / in unsharded runs) — the traffic
+  accounting the bench artifact publishes is the arithmetic the driver
+  actually runs, not an estimate.
+- Per-device live-state bytes scale O(N/D): the D=8 shard holds ≤ 1/6
+  of the D=1 state (docs/SCALING.md "Multi-chip").
+- The donated entry points from PR 5 keep their contract when state is
+  node-sharded: donated rounds release the sharded input buffers, and
+  the chunked engine run (which scans through the donated twins) stays
+  bit-identical under the shard_map broadcast driver.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu import models, parallel
+from corrosion_tpu.models.baselines import (
+    anti_entropy_chunks,
+    anywrite_sparse,
+    mixed_storm,
+)
+from corrosion_tpu.sim import benchlib, chunk_engine, engine, mixed_engine
+from corrosion_tpu.sim import simulate
+from corrosion_tpu.sim.sparse_engine import simulate_sparse
+from corrosion_tpu.sim.telemetry import XSHARD_CURVE_KEYS
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+DEVICE_COUNTS = (1, 2, 4, 8)
+
+
+def _dense_setup(n=64, rounds=24):
+    cfg, topo, sched = models.wan_100k(
+        n=n, n_regions=4, n_writers=16, rounds=rounds, samples=16,
+        partition=False,
+    )
+    sched.writes[:, :] = 0
+    sched.writes[:8, :] = 1
+    return cfg, topo, sched.make_samples(16)
+
+
+def _assert_curves_equal(ref: dict, got: dict, plane: str):
+    for k in ref:
+        if k in XSHARD_CURVE_KEYS:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(ref[k]), np.asarray(got[k]),
+            err_msg=f"{plane} curve {k}",
+        )
+
+
+def _assert_xshard_matches_model(curves: dict, cfg_gossip, mesh):
+    """The emitted cross-shard curves are the static model, constant
+    every round — measured-vs-arithmetic agreement is the accounting
+    invariant the bench artifact publishes."""
+    tm = parallel.traffic_model(cfg_gossip, mesh)
+    for key in XSHARD_CURVE_KEYS:
+        got = np.asarray(curves[key], np.float64)
+        np.testing.assert_array_equal(
+            got, np.full_like(got, tm[key]), err_msg=key
+        )
+
+
+# The 4-device-count whole-run pins cost ~40-60 s of compiles each;
+# dense/sparse/mixed run outside the tier-1 870 s budget in the CI
+# `multichip` job (the chunk pin stays in-lane as the cheap
+# representative, alongside the traffic/memory/donation contracts).
+@pytest.mark.slow
+def test_dense_bit_identical_across_device_counts():
+    cfg, topo, sched = _dense_setup()
+    ref_final, ref_curves = simulate(cfg, topo, sched, seed=5)
+    for k in XSHARD_CURVE_KEYS:  # unsharded runs report zero traffic
+        assert float(np.asarray(ref_curves[k]).sum()) == 0.0
+    for d in DEVICE_COUNTS:
+        mesh = benchlib.multichip_mesh(d)
+        final, curves = parallel.simulate_sharded(
+            cfg, topo, sched, mesh, seed=5
+        )
+        for name in ("head", "contig", "seen", "q_writer", "q_ver"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref_final.data, name)),
+                np.asarray(getattr(final.data, name)),
+                err_msg=f"dense D={d} {name}",
+            )
+        for u, s in zip(
+            jax.tree.leaves(ref_final.swim), jax.tree.leaves(final.swim)
+        ):
+            np.testing.assert_array_equal(np.asarray(u), np.asarray(s))
+        _assert_curves_equal(ref_curves, curves, f"dense D={d}")
+        _assert_xshard_matches_model(curves, cfg.gossip, mesh)
+        if d > 1:
+            assert float(np.asarray(curves["xshard_bytes_ici"][0])) > 0
+
+
+@pytest.mark.slow  # see test_dense_bit_identical_across_device_counts
+def test_sparse_bit_identical_across_device_counts():
+    cfg, topo, sched = anywrite_sparse(
+        n=64, w_hot=8, rounds=16, n_regions=4, epoch_rounds=8,
+        cohort=10, burst_writes=2, samples=16, k_dev=8,
+    )
+    ref = simulate_sparse(cfg, topo, sched, seed=0)
+    for d in DEVICE_COUNTS:
+        mesh = benchlib.multichip_mesh(d)
+        got = parallel.simulate_sparse_sharded(
+            cfg, topo, sched, mesh, seed=0
+        )
+        for name in ("contig", "seen", "q_writer", "q_ver"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref[0].data, name)),
+                np.asarray(getattr(got[0].data, name)),
+                err_msg=f"sparse D={d} {name}",
+            )
+        np.testing.assert_array_equal(
+            np.asarray(ref[0].head_full), np.asarray(got[0].head_full),
+            err_msg=f"sparse D={d} head_full",
+        )
+        _assert_curves_equal(ref[3], got[3], f"sparse D={d}")
+        _assert_xshard_matches_model(got[3], cfg.gossip, mesh)
+
+
+def test_chunk_bit_identical_across_device_counts():
+    ccfg, origin, last_seq, _ = anti_entropy_chunks(
+        n=64, streams=2, last_seq=127, rounds=0
+    )
+    _, ref_metrics = chunk_engine.simulate_chunks(
+        ccfg, origin, last_seq, 24, seed=3
+    )
+    for d in DEVICE_COUNTS:
+        mesh = benchlib.multichip_mesh(d)
+        _, metrics = parallel.simulate_chunks_sharded(
+            ccfg, origin, last_seq, 24, mesh, seed=3
+        )
+        assert metrics["applied_frac"] == ref_metrics["applied_frac"]
+        _assert_curves_equal(
+            ref_metrics["curves"], metrics["curves"], f"chunk D={d}"
+        )
+
+
+@pytest.mark.slow  # see test_dense_bit_identical_across_device_counts
+def test_mixed_bit_identical_across_device_counts():
+    cfg, ccfg, topo, sched, spec = mixed_storm(
+        n=64, streams=2, last_seq=63, rounds=48, samples=16, n_cells=64
+    )
+    ref_final, ref_curves = mixed_engine.simulate_mixed(
+        cfg, ccfg, topo, sched, spec, seed=0
+    )
+    for d in DEVICE_COUNTS:
+        mesh = benchlib.multichip_mesh(d)
+        final, curves = parallel.simulate_mixed_sharded(
+            cfg, ccfg, topo, sched, spec, mesh, seed=0
+        )
+        for name in ("head", "contig", "seen"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref_final.data, name)),
+                np.asarray(getattr(final.data, name)),
+                err_msg=f"mixed D={d} {name}",
+            )
+        np.testing.assert_array_equal(
+            np.asarray(ref_final.applied_before),
+            np.asarray(final.applied_before),
+            err_msg=f"mixed D={d} applied_before",
+        )
+        _assert_curves_equal(ref_curves, curves, f"mixed D={d}")
+        _assert_xshard_matches_model(curves, cfg.gossip, mesh)
+
+
+def test_traffic_model_arithmetic():
+    """Hand-checked exchange volume on the (2, 4) mesh: the inner hop
+    gathers each device's block across its 4-wide ici group, the outer
+    hop moves the 4x-grown block across the 2 dcn groups."""
+    cfg, _, _ = _dense_setup(n=512)
+    g = cfg.gossip
+    mesh = benchlib.multichip_mesh(8)
+    tm = parallel.traffic_model(g, mesh)
+    per_entry = 12 + (4 if g.track_writer_ids else 0)
+    block = (512 // 8) * g.queue * per_entry
+    assert tm["xshard_bytes_ici"] == 8 * 3 * block
+    assert tm["xshard_bytes_dcn"] == 8 * 1 * (block * 4)
+    one = parallel.traffic_model(g, benchlib.multichip_mesh(1))
+    assert one["xshard_bytes_ici"] == one["xshard_bytes_dcn"] == 0.0
+
+
+def test_per_device_state_scales_o_n_over_d():
+    cfg, _, sched = _dense_setup(n=512)
+    mib = {}
+    for d in (1, 8):
+        state = engine.init_cluster(cfg, len(sched.sample_writer))
+        state = parallel.shard_cluster_state(
+            state, benchlib.multichip_mesh(d)
+        )
+        per_dev = parallel.per_device_state_bytes(state)
+        assert len(per_dev) == d
+        mib[d] = max(per_dev.values())
+    assert mib[8] <= mib[1] * benchlib.MULTICHIP_STATE_FRACTION, (
+        f"D=8 shard holds {mib[8] / mib[1]:.3f} of the D=1 state — "
+        f"per-device memory must scale O(N/D)"
+    )
+
+
+def test_donated_rounds_release_sharded_buffers():
+    """The PR 5 donation contract survives sharding: a donated round on
+    a node-sharded ClusterState releases the (sharded) input buffers and
+    matches the plain entry bit-for-bit."""
+    cfg, topo, sched = _dense_setup(rounds=6)
+    mesh = benchlib.multichip_mesh(8)
+    topo_r = parallel.replicate(topo, mesh)
+    n_regions = int(np.asarray(topo.region).max()) + 1
+    part = jnp.zeros((n_regions, n_regions), bool)
+    kill = jnp.zeros((1,), bool)
+    writes = jnp.asarray(sched.writes[0], jnp.uint32)
+    s_w = jnp.asarray(sched.sample_writer)
+    s_v = jnp.asarray(sched.sample_ver)
+    s_r = jnp.asarray(sched.sample_round)
+    key = jax.random.PRNGKey(7)
+
+    state0 = engine.init_cluster(cfg, len(sched.sample_writer))
+    state0 = parallel.shard_cluster_state(state0, mesh)
+    # One plain round first: donation requires a device-execution output
+    # (a fresh init may share constant buffers between zero leaves).
+    state1, _ = engine.cluster_round(
+        state0, topo_r, writes, part, kill, kill, s_w, s_v, s_r, key,
+        cfg, False,
+    )
+    plain, _ = engine.cluster_round(
+        state1, topo_r, writes, part, kill, kill, s_w, s_v, s_r, key,
+        cfg, False,
+    )
+    donated, _ = engine.cluster_round_donated(
+        state1, topo_r, writes, part, kill, kill, s_w, s_v, s_r, key,
+        cfg, False,
+    )
+    for name in ("head", "contig", "seen"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(plain.data, name)),
+            np.asarray(getattr(donated.data, name)),
+            err_msg=name,
+        )
+    # The donated input's shards are gone; the output stays sharded.
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(state1.data.contig)
+    assert len(parallel.per_device_state_bytes(donated)) == 8
+
+
+def test_donated_scan_under_sharding_bit_identical():
+    """The chunked engine run scans through the _donated twins; under
+    the shard_map broadcast driver it must still match the unsharded
+    run (and leave the caller's sharded init readable — the
+    copy-once-donate-always ownership rule)."""
+    cfg, topo, sched = _dense_setup()
+    ref_final, ref_curves = simulate(cfg, topo, sched, seed=5, max_chunk=8)
+    mesh = benchlib.multichip_mesh(8)
+    state0 = engine.init_cluster(cfg, len(sched.sample_writer))
+    state0 = parallel.shard_cluster_state(state0, mesh)
+    final, curves = simulate(
+        cfg, parallel.replicate(topo, mesh), sched, seed=5,
+        state=state0, max_chunk=8,
+        bcast_fn=parallel.make_sharded_broadcast(mesh),
+    )
+    for name in ("head", "contig", "seen"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref_final.data, name)),
+            np.asarray(getattr(final.data, name)),
+            err_msg=name,
+        )
+    _assert_curves_equal(ref_curves, curves, "donated scan")
+    np.asarray(state0.data.contig)  # caller state survives donation

@@ -7,6 +7,13 @@ import ssl
 
 import pytest
 
+# agent/tls.py generates the PKI with the cryptography package; without it
+# the whole module (not just individual tests) fails to import, which
+# pytest reports as a tier-1 COLLECTION error. Skip cleanly instead.
+pytest.importorskip(
+    "cryptography", reason="agent TLS plane needs the cryptography package"
+)
+
 from corrosion_tpu.agent import tls as tls_mod
 from corrosion_tpu.agent.agent import AgentTls
 from corrosion_tpu.agent.testing import launch_test_agent, poll_until
