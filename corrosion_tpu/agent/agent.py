@@ -1119,10 +1119,22 @@ class Agent:
         write_conns_g = self.metrics.gauge(
             "corro_sqlite_pool_write_connections", "writer connections"
         )
+        subs_dropped_g = self.metrics.gauge(
+            "corro_subs_dropped_events",
+            "subscription listener-queue overflow drops (each evicts "
+            "its stream; clients resume via ?from=)",
+        )
         interval = self.cfg.metrics_interval
         while not self.tripwire.tripped:
             await asyncio.sleep(interval)
             cluster_g.set(len(self.members.alive()) + 1)
+            if self.subs is not None:
+                subs_dropped_g.set(
+                    sum(
+                        h.dropped_events
+                        for h in self.subs._by_id.values()
+                    )
+                )
             if self.swim is not None:
                 backlog_g.set(len(self.swim.rumors))
             if self.pool is None:

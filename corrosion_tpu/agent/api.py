@@ -22,6 +22,12 @@ if TYPE_CHECKING:
     from corrosion_tpu.agent.agent import Agent
 
 MAX_BODY = 64 * 1024 * 1024
+# Header-section caps: an abusive or buggy client must not be able to
+# buffer unbounded memory on the server by streaming headers forever.
+# asyncio's stream limit (64 KiB) already bounds any SINGLE line; these
+# bound the count and the section total, answered with 431.
+MAX_HEADER_COUNT = 128
+MAX_HEADER_BYTES = 32 * 1024
 
 
 class HttpError(Exception):
@@ -35,24 +41,63 @@ class RouteLimit:
     """Admission control per route: the reference wraps every /v1 route in
     a concurrency limit + load-shed (128 per route, 4 for migrations;
     agent.rs:836-902). Handlers run on one event loop, so a plain counter
-    suffices; over-limit requests shed immediately with 503."""
+    suffices; over-limit requests shed immediately with 503.
 
-    def __init__(self, limit: int):
+    When a ``MetricsRegistry`` is wired (``rebuild_api_limits``), shed
+    decisions and the live admission count are visible on /metrics as
+    ``corro_api_shed_total{route=...}`` / ``corro_api_inflight{route=...}``
+    — so a load generator's client-side 503 accounting can be
+    cross-checked against the server's own."""
+
+    def __init__(self, limit: int, route: str = "", metrics=None):
         self.limit = limit
         self.active = 0
+        self.route = route
+        self._shed = (
+            metrics.counter(
+                "corro_api_shed_total",
+                "requests shed (503) by per-route admission control",
+            )
+            if metrics is not None else None
+        )
+        self._inflight = (
+            metrics.gauge(
+                "corro_api_inflight",
+                "requests currently holding a per-route admission slot",
+            )
+            if metrics is not None else None
+        )
 
     def __enter__(self):
         if self.active >= self.limit:
+            if self._shed is not None:
+                self._shed.inc(route=self.route)
             raise HttpError(503, "concurrency limit reached (load shed)")
         self.active += 1
+        if self._inflight is not None:
+            # add(), not set(self.active): after a config hot-reload
+            # (rebuild_api_limits) old and new RouteLimit instances
+            # briefly coexist on the same gauge label — deltas keep the
+            # published value equal to TOTAL in-flight across both,
+            # where a set() from a draining old instance would clobber
+            # the new one's count.
+            self._inflight.add(1, route=self.route)
         return self
 
     def __exit__(self, *exc):
         self.active -= 1
+        if self._inflight is not None:
+            self._inflight.add(-1, route=self.route)
 
 
 async def _read_request(reader: asyncio.StreamReader):
-    line = await reader.readline()
+    try:
+        line = await reader.readline()
+    except ValueError:
+        # asyncio stream-limit overrun: a request line longer than the
+        # 64 KiB buffer. The read side is no longer line-synchronized,
+        # so the caller closes the connection after responding.
+        raise HttpError(431, "request line too long")
     if not line:
         return None
     try:
@@ -60,10 +105,20 @@ async def _read_request(reader: asyncio.StreamReader):
     except ValueError:
         raise HttpError(400, "bad request line")
     headers = {}
+    header_bytes = 0
     while True:
-        h = await reader.readline()
+        try:
+            h = await reader.readline()
+        except ValueError:
+            raise HttpError(431, "header line too long")
         if h in (b"\r\n", b"\n", b""):
             break
+        header_bytes += len(h)
+        if (
+            len(headers) >= MAX_HEADER_COUNT
+            or header_bytes > MAX_HEADER_BYTES
+        ):
+            raise HttpError(431, "too many request headers")
         k, _, v = h.decode().partition(":")
         headers[k.strip().lower()] = v.strip()
     body = b""
@@ -82,7 +137,9 @@ async def _read_request(reader: asyncio.StreamReader):
 
 def _resp(writer, status: int, body: bytes, content_type="application/json"):
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-              413: "Payload Too Large", 500: "Internal Server Error",
+              413: "Payload Too Large",
+              431: "Request Header Fields Too Large",
+              500: "Internal Server Error",
               501: "Not Implemented",
               503: "Service Unavailable"}.get(status, "?")
     writer.write(
@@ -126,6 +183,26 @@ async def serve_api(agent: "Agent") -> tuple[str, int]:
                 except HttpError as e:
                     _json_resp(writer, e.status, {"error": e.message})
                     await writer.drain()
+                    if e.status in (431, 413):
+                        # Bounded best-effort input drain before close,
+                        # ONLY for the desync statuses whose request
+                        # bytes are known-unread: closing with unread
+                        # input RSTs the connection and can destroy the
+                        # error response before the client reads it.
+                        # Other errors (400s from a clean read) must not
+                        # pay a 0.2 s lingering read per connection.
+                        # Hard-capped — this must never become the
+                        # unbounded read it guards against.
+                        try:
+                            for _ in range(16):
+                                chunk = await asyncio.wait_for(
+                                    reader.read(65536), 0.2
+                                )
+                                if not chunk:
+                                    break
+                        except (asyncio.TimeoutError, ConnectionError,
+                                ValueError):
+                            pass
                     break
                 if req is None:
                     break
@@ -168,11 +245,18 @@ def rebuild_api_limits(agent) -> None:
     api_concurrency takes effect without restart. In-flight requests keep
     their old limiter; new requests see the new one."""
     n = agent.cfg.api_concurrency
+    metrics = getattr(agent, "metrics", None)
+
+    def rl(route: str, limit: int) -> RouteLimit:
+        return RouteLimit(limit, route=route, metrics=metrics)
+
     agent._api_limits = {
-        "/v1/transactions": RouteLimit(n),
-        "/v1/queries": RouteLimit(n),
-        "/v1/migrations": RouteLimit(agent.cfg.migration_concurrency),
-        "/v1/subscriptions": RouteLimit(n),
+        "/v1/transactions": rl("/v1/transactions", n),
+        "/v1/queries": rl("/v1/queries", n),
+        "/v1/migrations": rl(
+            "/v1/migrations", agent.cfg.migration_concurrency
+        ),
+        "/v1/subscriptions": rl("/v1/subscriptions", n),
     }
 
 
@@ -288,6 +372,24 @@ async def _stream_sub(
                 writer, json.dumps(_json_safe(ev.to_json_obj())).encode() + b"\n"
             )
         while not agent.tripwire.tripped and not eof.done():
+            if handle.lossy(queue):
+                # The listener queue overflowed: events were dropped, so
+                # continuing would silently violate exactly-once
+                # delivery. Flush what IS queued (all older than the
+                # drop), then end the stream — the client reconnects
+                # with ?from= and the durable log replays the gap.
+                while True:
+                    try:
+                        ev = queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    await _stream_chunk(
+                        writer,
+                        json.dumps(
+                            _json_safe(ev.to_json_obj())
+                        ).encode() + b"\n",
+                    )
+                break
             try:
                 ev = await asyncio.wait_for(queue.get(), timeout=0.5)
             except asyncio.TimeoutError:

@@ -41,15 +41,33 @@ class _Job:
 
 class SplitPool:
     """Async facade over a Store: serialized prioritized writes + pooled
-    snapshot reads."""
+    snapshot reads.
 
-    def __init__(self, store: Store, read_conns: int = 20) -> None:
+    **Backpressure contract** (deterministic; the write-storm tests pin
+    it): when a priority class's bounded queue is full, ``write`` BLOCKS
+    the caller in ``Queue.put`` until the writer drains a slot — it
+    never sheds, drops, or reorders within a class. Load-shed is the API
+    layer's job (``RouteLimit`` 503s *before* work is accepted); once a
+    write is admitted past admission control it is executed, in FIFO
+    order within its class, with queue-full pressure propagating to the
+    producer as await-time. ``queue_depths`` overrides the per-class
+    bounds (tests shrink them to make the blocking observable).
+    """
+
+    def __init__(
+        self, store: Store, read_conns: int = 20,
+        queue_depths: dict[int, int] | None = None,
+    ) -> None:
         self.store = store
         self.metrics = None  # optional MetricsRegistry (agent wires it)
         self._exec_hist = None  # resolved lazily from the registry
         self._queue_hist = None
         self._queues = {
-            p: asyncio.Queue(maxsize=d) for p, d in QUEUE_DEPTHS.items()
+            p: asyncio.Queue(maxsize=d)
+            # Merge, don't replace: a partial override must leave the
+            # other priority classes at their defaults, not KeyError at
+            # the first write to an un-listed class.
+            for p, d in {**QUEUE_DEPTHS, **(queue_depths or {})}.items()
         }
         self._kick = asyncio.Event()
         self._writer_task: asyncio.Task | None = None
@@ -107,7 +125,9 @@ class SplitPool:
         self, fn: Callable[[], Any], priority: int = NORMAL
     ) -> Any:
         """Run ``fn`` (a closure over the Store) on the writer, serialized
-        with all other writes, admitted by priority class."""
+        with all other writes, admitted by priority class. Queue-full
+        blocks right here (deterministic backpressure, never a shed or a
+        drop — see the class docstring)."""
         if self._closed:
             raise RuntimeError("pool closed")
         loop = asyncio.get_running_loop()

@@ -196,6 +196,13 @@ class MatcherHandle:
         self.change_id = start_change_id
         self.history: deque[QueryEventChange] = deque(maxlen=MAX_CHANGE_HISTORY)
         self._listeners: list[asyncio.Queue] = []
+        # Listener queues that overflowed: their streams are LOSSY from
+        # that point on, and the API layer must END them (the client then
+        # resumes via ?from= and the durable log replays the gap) rather
+        # than silently continue past a dropped event. dropped_events is
+        # the observability counter behind corro_subs_dropped_events.
+        self._overflowed: set[asyncio.Queue] = set()
+        self.dropped_events = 0
         self._touched: list[tuple] = []
         # Fallback (full re-evaluation) cost control: once an evaluation
         # proves expensive, later change batches coalesce into one deferred
@@ -615,10 +622,26 @@ class MatcherHandle:
             self._persist_events(events, self._touched)
         for ev in events:
             for q in self._listeners:
+                if q in self._overflowed:
+                    # Once lossy, ALWAYS lossy: enqueuing later events
+                    # past a dropped one would let the eviction flush
+                    # deliver post-gap events, advancing the client's
+                    # resume point PAST the drop — the ?from= replay
+                    # (strictly change_id > from) would then skip it
+                    # forever. Every event after the first drop is
+                    # counted dropped and recovered by the replay.
+                    self.dropped_events += 1
+                    continue
                 try:
                     q.put_nowait(ev)
                 except asyncio.QueueFull:
-                    pass
+                    # A laggard that can't drain its queue must not
+                    # silently miss events: mark the queue lossy so the
+                    # stream layer evicts it — the client reconnects
+                    # from its last change id and the durable log
+                    # replays exactly what was dropped.
+                    self._overflowed.add(q)
+                    self.dropped_events += 1
 
     def _start_bg_full(self) -> bool:
         """Launch the full re-evaluation on a worker thread with a fresh
@@ -888,6 +911,12 @@ class MatcherHandle:
     def detach(self, q: asyncio.Queue) -> None:
         if q in self._listeners:
             self._listeners.remove(q)
+        self._overflowed.discard(q)
+
+    def lossy(self, q: asyncio.Queue) -> bool:
+        """True once ``q`` has dropped an event (queue overflow): the
+        stream serving it must end so the client resumes via ?from=."""
+        return q in self._overflowed
 
     def backlog(self, from_change: int | None = None, skip_rows: bool = False):
         """Initial events for a new listener: either a snapshot (columns +

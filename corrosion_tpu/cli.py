@@ -211,6 +211,61 @@ def _build_parser() -> argparse.ArgumentParser:
                     "(JSON always carries them)")
     ln.add_argument("--list-rules", action="store_true")
 
+    # Serving-plane load subsystem (corrosion_tpu/loadgen, docs/SERVING.md):
+    # open-loop load generation against a self-launched in-process agent
+    # cluster, with the fan-out correctness oracle.
+    lg = add("loadgen", help="serving-plane load generator + fan-out oracle")
+    lg_sub = lg.add_subparsers(dest="loadgen_cmd", required=True)
+
+    lgr = lg_sub.add_parser(
+        "run", parents=[common],
+        help="subscription fan-out storm + sustained write storm "
+        "(oracle-checked)",
+    )
+    lgr.add_argument("--subs", type=int, default=2000)
+    lgr.add_argument("--sub-groups", type=int, default=4)
+    lgr.add_argument("--writes", type=int, default=80)
+    lgr.add_argument("--write-rate", type=float, default=10.0,
+                     help="open-loop write arrivals/s (each commit fans "
+                     "out to subs/groups streams — size rate x subs to "
+                     "the harness host)")
+    lgr.add_argument("--read-rate", type=float, default=20.0)
+    lgr.add_argument("--pg-rate", type=float, default=10.0)
+    lgr.add_argument("--agents", type=int, default=1)
+    lgr.add_argument("--drain-timeout", type=float, default=30.0)
+    lgr.add_argument("--dir", default=None,
+                     help="data dir (default: a fresh tempdir)")
+    lgr.add_argument("--out", default=None, help="report JSON path")
+
+    lgs = lg_sub.add_parser(
+        "sweep", parents=[common],
+        help="saturation sweep: ramp arrivals past api_concurrency, "
+        "verify 503 shed + bounded admitted p99",
+    )
+    lgs.add_argument("--rates", default="50,200,400",
+                     help="comma-separated stage arrival rates (Hz)")
+    lgs.add_argument("--stage-duration", type=float, default=2.0)
+    lgs.add_argument("--api-concurrency", type=int, default=4)
+    lgs.add_argument("--burst", type=int, default=16,
+                     help="top-stage arrivals packed per instant "
+                     "(> api_concurrency forces shed engagement)")
+    lgs.add_argument("--bounded-p99-ms", type=float, default=5000.0)
+    lgs.add_argument("--dir", default=None)
+    lgs.add_argument("--out", default=None)
+
+    lgk = lg_sub.add_parser(
+        "soak", parents=[common],
+        help="intake-policy soak: measure the docs/SCALING.md "
+        "rebroadcast_intake collapse rule on the kernel plane",
+    )
+    lgk.add_argument("--nodes", type=int, default=96)
+    lgk.add_argument("--rounds", type=int, default=72)
+    lgk.add_argument("--write-prob", type=float, default=0.08)
+    lgk.add_argument("--intake-margin", type=int, default=8)
+    lgk.add_argument("--starved-intake", type=int, default=1)
+    lgk.add_argument("--seed", type=int, default=0)
+    lgk.add_argument("--out", default=None)
+
     # command/tls.rs:1-94: `corrosion tls {ca,server,client} generate`
     tl = add("tls", help="certificate generation")
     tl.add_argument("tls_kind", choices=["ca", "server", "client"])
@@ -244,6 +299,8 @@ async def _dispatch(args, cfg: Config) -> int:
         return _obs(args)
     if args.command == "chaos":
         return _chaos(args)
+    if args.command == "loadgen":
+        return await _loadgen(args)
     if args.command == "agent":
         return await _run_agent(cfg)
     if args.command == "query":
@@ -465,6 +522,100 @@ def _chaos(args) -> int:
             return 2
         print(rep.render())
         return 0 if rep.ok else 1
+    return 2
+
+
+async def _loadgen(args) -> int:
+    """`corrosion loadgen {run,sweep,soak}` — the serving-plane load
+    subsystem (docs/SERVING.md). Every report funnels through the
+    self-describing emit path; exit 0 = the scenario's promise held
+    (zero oracle violations / shed engaged + p99 bounded / collapse
+    rule demonstrated), 1 = it did not."""
+    import tempfile
+
+    from corrosion_tpu.loadgen import scenarios
+    from corrosion_tpu.loadgen.report import (
+        emit_serving_report, serving_context,
+    )
+
+    def emit(report: dict, ok: bool) -> int:
+        emit_serving_report(report)
+        out = json.dumps(report, indent=2)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+        print(out)
+        return 0 if ok else 1
+
+    if args.loadgen_cmd == "run":
+        with tempfile.TemporaryDirectory() as tmp:
+            run = await scenarios.fanout_storm(
+                args.dir or tmp,
+                subs=args.subs, sub_groups=args.sub_groups,
+                writes=args.writes, write_rate=args.write_rate,
+                read_rate=args.read_rate, pg_rate=args.pg_rate,
+                n_agents=args.agents, drain_timeout_s=args.drain_timeout,
+                progress=sys.stderr,
+            )
+        report = {
+            **serving_context(
+                "fanout_storm", args.agents, args.subs, args.writes,
+                args.write_rate,
+            ),
+            "subs": args.subs,
+            "run": run,
+        }
+        # Zero violations is vacuous if nothing committed or delivered:
+        # a fully broken write path must not exit 0.
+        ok = (
+            run["oracle"]["violations"] == 0
+            and run["oracle"]["commits"] > 0
+            and run["oracle"]["delivered_changes"]
+            + run["oracle"]["delivered_snapshot"] > 0
+        )
+        return emit(report, ok)
+
+    if args.loadgen_cmd == "sweep":
+        rates = tuple(
+            float(r) for r in args.rates.split(",") if r.strip()
+        )
+        with tempfile.TemporaryDirectory() as tmp:
+            sweep = await scenarios.saturation_sweep(
+                args.dir or tmp,
+                api_concurrency=args.api_concurrency, rates=rates,
+                stage_duration_s=args.stage_duration, burst=args.burst,
+                bounded_p99_ms=args.bounded_p99_ms, progress=sys.stderr,
+            )
+        report = {
+            **serving_context(
+                "saturation_sweep", 1, args.api_concurrency, rates,
+                args.burst,
+            ),
+            "sweep": sweep,
+        }
+        ok = (
+            sweep["shed_engaged"]
+            and sweep["admitted_p99_bounded"]
+            and sweep["shed_accounting_consistent"]
+        )
+        return emit(report, ok)
+
+    if args.loadgen_cmd == "soak":
+        soak = scenarios.intake_policy(
+            nodes=args.nodes, rounds=args.rounds,
+            write_prob=args.write_prob,
+            intake_margin=args.intake_margin,
+            starved_intake=args.starved_intake, seed=args.seed,
+            progress=sys.stderr,
+        )
+        report = {
+            **serving_context(
+                "intake_policy", args.nodes, args.rounds,
+                args.write_prob, args.seed,
+            ),
+            "soak": soak,
+        }
+        return emit(report, soak["collapse_rule_holds"])
     return 2
 
 

@@ -207,14 +207,32 @@ class SubscriptionStream:
             return ev
         raise StopAsyncIteration
 
-    async def reconnect(self) -> None:
-        """Resume from the last observed change id."""
+    async def reconnect(
+        self, retries: int = 0, delay_s: float = 0.2
+    ) -> None:
+        """Resume from the last observed change id.
+
+        ``retries`` re-attempts the resubscribe on connection failure
+        (an agent mid-restart refuses connections for a moment; the
+        durable sub-db makes the resume valid once it is back). The
+        stream's resume state (sub_id, last_change_id) is untouched on
+        failure, so a later call retries from the same point.
+        """
         if self.sub_id is None:
             raise ApiError(400, "no sub_id observed yet")
         self.close()
-        fresh = await self._client.resubscribe(
-            self.sub_id, from_change=self.last_change_id
-        )
+        attempt = 0
+        while True:
+            try:
+                fresh = await self._client.resubscribe(
+                    self.sub_id, from_change=self.last_change_id
+                )
+                break
+            except (ConnectionError, OSError):
+                if attempt >= retries:
+                    raise
+                attempt += 1
+                await asyncio.sleep(delay_s)
         self._resp = fresh._resp
         self._lines = fresh._lines
 

@@ -526,7 +526,9 @@ class PlaneAttribution:
         return plane, residual
 
 
-def check_bench_invariants(report: dict, tol: float = 1e-6) -> dict:
+def check_bench_invariants(
+    report: dict, tol: float = 1e-6, extra_provenance: tuple = ()
+) -> dict:
     """Assert the documented step-time invariants on an emitted bench
     report (bench.py module docstring), exactly as they appear in the
     JSON, and return the report unchanged so the emit site can wrap it.
@@ -556,8 +558,16 @@ def check_bench_invariants(report: dict, tol: float = 1e-6) -> dict:
     exception, not ``assert`` — the guarantee must survive ``python -O``);
     the bench emits nothing rather than publishing a report that
     contradicts its own documentation.
+
+    ``extra_provenance`` names additional fields a report class requires
+    beyond the base four — the serving plane (loadgen) passes
+    ``("scenario",)`` so a load report can never be published without
+    saying which standing scenario produced it.
     """
-    for field in ("platform", "nodes", "device_count", "config_fingerprint"):
+    for field in (
+        "platform", "nodes", "device_count", "config_fingerprint",
+        *extra_provenance,
+    ):
         v = report.get(field)
         if v is None or v == "":
             raise ValueError(

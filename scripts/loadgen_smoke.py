@@ -1,0 +1,157 @@
+"""CI loadgen-smoke gate: the serving plane at reduced scale vs a budget.
+
+Runs the standing serving scenarios at CI-feasible scale — the
+subscription fan-out storm (~200 streams + a sustained open-loop write
+storm + pooled HTTP/PG reads) and the saturation sweep (arrival ramp
+past a deliberately small ``api_concurrency``) — through the fan-out
+correctness oracle, emits ONE self-describing report (platform, config
+fingerprint, scenario — ``loadgen.report.emit_serving_report``), writes
+it as a JSON artifact, and exits 1 when the ``serving`` entry of
+bench_budget.json is breached:
+
+- any oracle violation (exactly-once delivery, monotonic change ids) —
+  never tolerance-scaled;
+- a sweep that failed to engage load-shed, or whose client-side 503
+  count disagrees with the server's own ``corro_api_shed_total``;
+- a latency ceiling (tolerance-scaled): admitted-transaction p99,
+  fan-out delivery-lag p99, sweep admitted p99.
+
+Usage:
+    python scripts/loadgen_smoke.py [--out report.json] [--budget FILE]
+    python scripts/loadgen_smoke.py --update   # refresh the budget entry
+
+``--update`` rewrites ONLY the ``serving`` entry of the budget file from
+the current measurement with x3 headroom (the same policy as
+bench_smoke.py; docs/SERVING.md documents the workflow). Latency
+ceilings get a floor so a 0 ms loopback measurement can't make any later
+nonzero one a breach.
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+import argparse
+import asyncio
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+# Reduced CI scale (the heavy 2k/10k-stream storms are `loadgen run`
+# territory and slow-marked tests, not the tier-1 gate).
+SUBS = 200
+WRITES = 120
+WRITE_RATE = 20.0
+SCENARIO = "ci_smoke"
+UPDATE_HEADROOM = 3.0
+# Ceiling floor for --update: loopback latencies (fan-out lag
+# especially) can measure ~0 ms; a 0 ms ceiling would make ANY later
+# nonzero measurement a breach.
+UPDATE_FLOOR_MS = 500.0
+
+CEILING_PATHS = (
+    "run.routes.transactions.latency_ms.p99",
+    "run.oracle.fanout_lag_ms.p99",
+    "sweep.admitted_p99_ms_max",
+)
+
+
+def measure() -> dict:
+    from corrosion_tpu.loadgen import scenarios
+    from corrosion_tpu.loadgen.report import emit_serving_report
+
+    async def go():
+        with tempfile.TemporaryDirectory() as tmp:
+            return await scenarios.full_report(
+                tmp, subs=SUBS, writes=WRITES, write_rate=WRITE_RATE,
+                scenario=SCENARIO, progress=sys.stderr,
+            )
+
+    return emit_serving_report(asyncio.run(go()))
+
+
+def main(argv=None) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", default=str(repo / "bench_budget.json"))
+    ap.add_argument("--out", default="loadgen_smoke_report.json")
+    ap.add_argument(
+        "--update", action="store_true",
+        help="rewrite the budget's `serving` entry from this measurement "
+        f"(x{UPDATE_HEADROOM} headroom) instead of gating",
+    )
+    args = ap.parse_args(argv)
+
+    from corrosion_tpu.loadgen.report import check_serving_budget
+    from corrosion_tpu.sim import benchlib
+
+    measured = measure()
+    budget_path = Path(args.budget)
+    full_budget = (
+        json.loads(budget_path.read_text()) if budget_path.exists() else {}
+    )
+    if args.update:
+        from corrosion_tpu.loadgen.report import _get
+
+        def ceiling(path: str) -> float:
+            cur = _get(measured, path)
+            if cur is None:
+                # e.g. every transaction timed out, so latency_ms never
+                # materialized — refuse to write a budget from a broken
+                # measurement, and say which surface vanished.
+                raise SystemExit(
+                    f"[loadgen-smoke] --update: measurement is missing "
+                    f"{path!r} — cannot refresh the budget from it"
+                )
+            return round(
+                max(float(cur) * UPDATE_HEADROOM, UPDATE_FLOOR_MS), 1
+            )
+
+        full_budget["serving"] = {
+            "platform": measured["platform"],
+            "scenario": SCENARIO,
+            "subs": SUBS,
+            "tolerance": full_budget.get("serving", {}).get(
+                "tolerance", benchlib.DEFAULT_TOLERANCE
+            ),
+            "ceilings_ms": {p: ceiling(p) for p in CEILING_PATHS},
+            "oracle_violations_max": 0,
+            "require_shed_engaged": True,
+        }
+        budget_path.write_text(
+            json.dumps(full_budget, indent=2) + "\n"
+        )
+        print(f"[loadgen-smoke] serving budget refreshed: {budget_path}")
+        print(json.dumps(measured))
+        return 0
+
+    if "serving" not in full_budget:
+        # Measuring without gating is how regressions pass silently.
+        ok, breaches = False, [
+            "serving: entry missing from budget — rerun with --update"
+        ]
+    else:
+        ok, breaches = check_serving_budget(
+            measured, full_budget["serving"]
+        )
+    report = {
+        **measured,
+        "budget": full_budget.get("serving"),
+        "ok": ok,
+        "breaches": breaches,
+    }
+    Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report))
+    if not ok:
+        for b in breaches:
+            print(f"[loadgen-smoke] BREACH {b}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,15 +135,30 @@ def test_convergence_under_any_interleaving(tmp_path):
     # Three writers, overlapping keys; apply each other's changesets in
     # every permutation — all replicas end identical (CRDT law check on the
     # full store, matching tests/test_ops_crdt.py's kernel laws).
+    # Coverage shape: EXHAUSTIVE over the first 4 changesets (24 orders —
+    # the pairwise/triple-wise commutativity the law lives on) plus 48
+    # seeded random orders of all 6; the former all-720-permutations
+    # sweep re-proved the same pairwise swaps hundreds of times over and
+    # cost ~40 s of the tier-1 budget in fresh-store setup alone.
+    import random
+
     stores = [mk_store(tmp_path, i) for i in range(3)]
     sets = []
     for i, s in enumerate(stores):
         for k in (1, 2):
             _, _, _, ch = ins(s, k, f"w{i}k{k}")
             sets.append(ch)
+    rng = random.Random(0)
+    perms = [
+        p + (4, 5) for p in itertools.permutations(range(4))
+    ]
+    for _ in range(48):
+        p = list(range(len(sets)))
+        rng.shuffle(p)
+        perms.append(tuple(p))
     finals = []
-    for perm in itertools.permutations(range(len(sets))):
-        s = Store(str(tmp_path / f"merge{hash(perm) % 10**8}.db"), bytes([9] * 16))
+    for n, perm in enumerate(perms):
+        s = Store(str(tmp_path / f"merge{n}.db"), bytes([9] * 16))
         s.apply_schema(SCHEMA)
         for idx in perm:
             s.apply_changes(sets[idx])

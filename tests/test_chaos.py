@@ -302,6 +302,7 @@ def test_determinism_identical_flight_records(tmp_path):
 # Invariant suite on the standard scenarios (48 rounds shared).
 
 
+@pytest.mark.slow  # tier-1 budget; the chaos CI job runs this file unfiltered
 def test_invariant_suite_dense_crash_wipe_recovers():
     plans = F.named_scenarios(
         SUITE_ROUNDS, I.STD_REGIONS, I.STD_NODES, protect=I.PROTECTED
@@ -312,6 +313,7 @@ def test_invariant_suite_dense_crash_wipe_recovers():
     assert rep.facts["chaos_wiped"] > 0
 
 
+@pytest.mark.slow  # tier-1 budget; the chaos CI job runs this file unfiltered
 def test_partition_heal_sparse_engine():
     """Satellite: partition-heal convergence on the SPARSE engine is
     checked against the sparse serial-merge reference (previously only
@@ -324,6 +326,7 @@ def test_partition_heal_sparse_engine():
     assert rep.recovery["recovery_rounds"] is not None
 
 
+@pytest.mark.slow  # tier-1 budget; the chaos CI job runs this file unfiltered
 def test_partition_heal_mixed_engine():
     """Satellite: partition-heal convergence on the MIXED engine —
     watermarks, CRDT cells (big versions included), and stream
@@ -335,6 +338,7 @@ def test_partition_heal_mixed_engine():
     assert rep.ok, rep.violations
 
 
+@pytest.mark.slow  # tier-1 budget; the chaos CI job runs this file unfiltered
 def test_chunk_engine_loss_and_wipe_recovers():
     plans = F.named_scenarios(
         SUITE_ROUNDS, I.STD_REGIONS, I.STD_NODES, protect=I.PROTECTED
@@ -346,6 +350,7 @@ def test_chunk_engine_loss_and_wipe_recovers():
     assert rep.facts["chaos_lost_msgs"] > 0
 
 
+@pytest.mark.slow  # tier-1 budget; the chaos CI job runs this file unfiltered
 def test_broken_plan_fails_and_shrinks_to_repro(tmp_path):
     """Acceptance: a deliberately non-healing plan fails the invariant
     suite, shrinks to a minimal JSON repro artifact, and the artifact

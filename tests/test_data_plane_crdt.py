@@ -10,6 +10,7 @@ loss, retransmission, and out-of-order arrival in play.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from corrosion_tpu.ops import crdt, gossip
 
@@ -146,6 +147,7 @@ def test_block_enumeration_forced_at_small_scale_matches_flat():
     assert_converged_to_serial_merge(block, cfg_b)
 
 
+@pytest.mark.slow  # tier-1 budget; the chaos CI job runs this file unfiltered
 def test_wide_writer_axis_sync_enumeration_matches_serial_merge():
     """n_writers >= 2048 routes the sync grant enumeration through the
     two-level block decomposition (MXU one-hot matmuls); the merged cell

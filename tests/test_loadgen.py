@@ -1,0 +1,407 @@
+"""Serving-plane load subsystem (corrosion_tpu/loadgen, docs/SERVING.md).
+
+Units for the open-loop schedule and the fan-out oracle's violation
+detection, the serving emit path + budget gate, and reduced-scale
+end-to-end runs of the standing scenarios against real in-process
+agents over TCP loopback: the fan-out storm (zero oracle violations),
+the saturation sweep (shed engages at the configured api_concurrency,
+client and server shed accounting agree, admitted p99 bounded), and —
+slow-marked with the heavy storms — the 2k-subscription acceptance run
+and the intake-policy collapse rule.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from corrosion_tpu.loadgen import schedule as sched_mod
+from corrosion_tpu.loadgen.oracle import FanoutOracle
+from corrosion_tpu.loadgen.report import (
+    check_serving_budget,
+    emit_serving_report,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- schedule ----------------------------------------------------------------
+
+
+def test_open_loop_schedule_deterministic_grid():
+    a = sched_mod.open_loop(10.0, 25)
+    b = sched_mod.open_loop(10.0, 25)
+    assert a == b
+    assert len(a) == 25
+    assert a[0].t == 0.0
+    assert a[1].t == pytest.approx(0.1)
+    assert a[-1].t == pytest.approx(2.4)
+    assert all(x.stage == 0 for x in a)
+
+
+def test_open_loop_burst_packs_instants_at_same_rate():
+    a = sched_mod.open_loop(100.0, 32, burst=16)
+    # 16 arrivals share each instant; instants 0.16 s apart — the
+    # long-run rate is still 100/s.
+    assert [x.t for x in a[:16]] == [0.0] * 16
+    assert a[16].t == pytest.approx(0.16)
+    assert a[-1].t == pytest.approx(0.16)
+
+
+def test_ramp_tags_stages():
+    a = sched_mod.ramp([(10.0, 1.0), (20.0, 1.0)])
+    assert sum(1 for x in a if x.stage == 0) == 10
+    assert sum(1 for x in a if x.stage == 1) == 20
+    # Stage 1 starts after stage 0's window.
+    assert min(x.t for x in a if x.stage == 1) == pytest.approx(1.0)
+
+
+def test_open_loop_rejects_bad_args():
+    with pytest.raises(ValueError):
+        sched_mod.open_loop(0.0, 5)
+    with pytest.raises(ValueError):
+        sched_mod.open_loop(10.0, 5, burst=0)
+
+
+# -- oracle ------------------------------------------------------------------
+
+
+def test_oracle_clean_exactly_once_pass():
+    o = FanoutOracle()
+    sid = o.attach_stream()
+    o.snapshot_done(sid, t=0.0)
+    o.commit(1, ("a",), t_ack=1.0)
+    o.change(sid, "insert", 1, ("a",), change_id=1, t=1.01)
+    rep = o.finish()
+    assert rep["violations"] == 0 and rep["missing"] == 0
+    assert rep["delivered_changes"] == 1
+    assert rep["fanout_lag_ms"]["count"] == 1
+
+
+def test_oracle_detects_duplicate_delivery():
+    o = FanoutOracle()
+    sid = o.attach_stream()
+    o.snapshot_done(sid, t=0.0)
+    o.commit(1, ("a",), t_ack=1.0)
+    o.change(sid, "insert", 1, ("a",), change_id=1, t=1.0)
+    o.change(sid, "insert", 1, ("a",), change_id=2, t=1.1)
+    rep = o.finish()
+    assert rep["violations"] == 1
+    assert "duplicate" in rep["violation_examples"][0]
+
+
+def test_oracle_detects_non_monotonic_change_ids():
+    o = FanoutOracle()
+    sid = o.attach_stream()
+    o.snapshot_done(sid, t=0.0)
+    o.change(sid, "insert", 1, ("a",), change_id=5, t=1.0)
+    o.change(sid, "insert", 2, ("b",), change_id=3, t=1.1)
+    assert any("non_monotonic" in v for v in o.violations)
+
+
+def test_oracle_detects_missing_delivery():
+    o = FanoutOracle()
+    sid = o.attach_stream()
+    o.snapshot_done(sid, t=0.0)
+    o.commit(1, ("a",), t_ack=1.0)
+    assert o.pending() == 1
+    rep = o.finish()
+    assert rep["missing"] == 1
+    assert any("missing" in v for v in rep["violation_examples"])
+
+
+def test_oracle_snapshot_covers_delivery_and_prior_commits_optional():
+    o = FanoutOracle()
+    sid = o.attach_stream()
+    # Acked BEFORE the snapshot finished: no obligation either way.
+    o.commit(1, ("early",), t_ack=0.5)
+    o.snapshot_done(sid, t=1.0)
+    # Acked after: must arrive, and a snapshot(-restart) row satisfies it.
+    o.commit(2, ("late",), t_ack=2.0)
+    assert o.pending() == 1
+    o.snapshot_row(sid, 2, ("late",))
+    assert o.pending() == 0
+    assert o.finish()["violations"] == 0
+
+
+def test_oracle_group_partitioning():
+    o = FanoutOracle()
+    sid = o.attach_stream(group=0)
+    o.snapshot_done(sid, t=0.0)
+    o.commit(1, ("a",), t_ack=1.0, group=1)  # other group: no obligation
+    o.commit(2, ("b",), t_ack=1.0, group=0)
+    assert o.pending() == 1
+    o.change(sid, "insert", 2, ("b",), change_id=1, t=1.1)
+    assert o.finish()["missing"] == 0
+
+
+def test_oracle_early_delivery_resolves_lag_at_commit():
+    # Fan-out regularly beats the writer's HTTP ack: the lag must still
+    # be recorded (clamped at 0), not lost.
+    o = FanoutOracle()
+    sid = o.attach_stream()
+    o.snapshot_done(sid, t=0.0)
+    o.change(sid, "insert", 1, ("a",), change_id=1, t=0.9)
+    assert o.lag_hist.count() == 0
+    o.commit(1, ("a",), t_ack=1.0)
+    assert o.lag_hist.count() == 1
+    assert o.finish()["violations"] == 0
+
+
+# -- emit path + budget gate -------------------------------------------------
+
+
+def test_emit_serving_report_requires_scenario_provenance():
+    base = {
+        "platform": "cpu", "nodes": 1, "device_count": 1,
+        "config_fingerprint": "abc123",
+    }
+    with pytest.raises(ValueError, match="scenario"):
+        emit_serving_report(dict(base))
+    out = emit_serving_report({**base, "scenario": "ci_smoke"})
+    assert out["scenario"] == "ci_smoke"
+
+
+def _measured(**over):
+    m = {
+        "platform": "cpu", "scenario": "ci_smoke", "subs": 200,
+        "run": {
+            "routes": {"transactions": {"latency_ms": {"p99": 100.0}}},
+            "oracle": {
+                "violations": 0, "fanout_lag_ms": {"p99": 1.0},
+            },
+        },
+        "sweep": {
+            "shed_engaged": True, "admitted_p99_ms_max": 500.0,
+            "oracle": {"violations": 0},
+        },
+    }
+    m.update(over)
+    return m
+
+
+_BUDGET = {
+    "platform": "cpu", "scenario": "ci_smoke", "subs": 200,
+    "tolerance": 1.5,
+    "ceilings_ms": {
+        "run.routes.transactions.latency_ms.p99": 200.0,
+        "run.oracle.fanout_lag_ms.p99": 100.0,
+        "sweep.admitted_p99_ms_max": 1000.0,
+    },
+    "oracle_violations_max": 0,
+    "require_shed_engaged": True,
+}
+
+
+def test_serving_budget_clean_pass():
+    ok, breaches = check_serving_budget(_measured(), _BUDGET)
+    assert ok, breaches
+
+
+def test_serving_budget_flags_dimension_mismatch():
+    ok, breaches = check_serving_budget(_measured(subs=32), _BUDGET)
+    assert not ok and any("subs" in b for b in breaches)
+
+
+def test_serving_budget_flags_latency_ceiling_and_missing_key():
+    m = _measured()
+    m["run"]["routes"]["transactions"]["latency_ms"]["p99"] = 10_000.0
+    del m["sweep"]["admitted_p99_ms_max"]
+    ok, breaches = check_serving_budget(m, _BUDGET)
+    assert not ok
+    assert any("transactions" in b for b in breaches)
+    assert any("missing from measurement" in b for b in breaches)
+
+
+def test_serving_budget_oracle_violations_never_tolerated():
+    m = _measured()
+    m["run"]["oracle"]["violations"] = 1
+    ok, breaches = check_serving_budget(m, _BUDGET)
+    assert not ok and any("oracle violations" in b for b in breaches)
+
+
+def test_serving_budget_requires_shed_engagement():
+    m = _measured()
+    m["sweep"]["shed_engaged"] = False
+    ok, breaches = check_serving_budget(m, _BUDGET)
+    assert not ok and any("shed_engaged" in b for b in breaches)
+
+
+# -- scenarios end-to-end (reduced scale) ------------------------------------
+
+
+def test_fanout_storm_small_zero_violations(tmp_path):
+    from corrosion_tpu.loadgen import scenarios
+
+    async def main():
+        return await scenarios.fanout_storm(
+            str(tmp_path), subs=32, writes=30, write_rate=30.0,
+            read_rate=10.0, pg_rate=5.0, drain_timeout_s=15.0,
+        )
+
+    rep = run(main())
+    o = rep["oracle"]
+    assert o["streams"] == 32
+    assert o["commits"] == 30
+    assert o["violations"] == 0, o["violation_examples"]
+    assert o["missing"] == 0
+    # Every stream's group sees its quarter of the commits exactly once:
+    # 30 commits spread over 4 groups x 8 streams each.
+    assert o["delivered_changes"] + o["delivered_snapshot"] >= 30 * 8
+    tx = rep["routes"]["transactions"]
+    assert tx["ok"] == 30 and tx["shed"] == 0 and tx["error"] == 0
+    assert rep["routes"]["queries"]["ok"] > 0
+    assert rep["routes"]["pg"]["ok"] > 0
+    # The whole block is emittable through the one self-describing path.
+    from corrosion_tpu.loadgen.report import serving_context
+
+    emit_serving_report(
+        {**serving_context("fanout_storm", 1, 32), "run": rep}
+    )
+
+
+def test_saturation_sweep_shed_engages_and_accounts(tmp_path):
+    from corrosion_tpu.loadgen import scenarios
+
+    async def main():
+        return await scenarios.saturation_sweep(
+            str(tmp_path), api_concurrency=2, rates=(30.0, 300.0),
+            stage_duration_s=1.0, burst=12,
+        )
+
+    rep = run(main())
+    # The top stage packs 12 concurrent arrivals against a limit of 2:
+    # shed MUST engage there, and the server's own accounting must agree
+    # with the client's 503 count.
+    assert rep["shed_engaged"], rep
+    assert rep["stages"][1]["shed"] > 0
+    assert rep["shed_accounting_consistent"], (
+        rep["shed_total"], rep["server_shed_total"],
+    )
+    assert rep["admitted_p99_bounded"], rep["admitted_p99_ms_max"]
+    # Shed is fast-fail: its p99 must sit well under the bound too.
+    shed_ms = rep["stages"][1]["shed_latency_ms"]["p99"]
+    assert shed_ms < rep["bounded_p99_ms"]
+    json.dumps(rep)  # strict-JSON serializable
+
+
+@pytest.mark.slow
+def test_fanout_storm_2k_subscriptions(tmp_path):
+    """The acceptance bar: >= 2k concurrent subscriptions + a sustained
+    write storm, zero oracle violations. Slow-marked out of the tier-1
+    lane; the loadgen-smoke CI job runs the same shape via the CLI."""
+    from corrosion_tpu.loadgen import scenarios
+
+    async def main():
+        return await scenarios.fanout_storm(
+            str(tmp_path), subs=2000, writes=60, write_rate=10.0,
+            drain_timeout_s=60.0,
+        )
+
+    rep = run(main())
+    o = rep["oracle"]
+    assert o["streams"] == 2000
+    assert o["violations"] == 0, o["violation_examples"]
+    assert o["missing"] == 0
+    assert o["delivered_changes"] >= 60 * (2000 // 4)
+
+
+@pytest.mark.slow
+def test_intake_policy_collapse_rule():
+    """docs/SCALING.md queue-policy rule, measured: backlog bounded with
+    intake sized to the write rate, divergent when starved below it."""
+    from corrosion_tpu.loadgen import scenarios
+
+    rep = scenarios.intake_policy()
+    assert rep["collapse_rule_holds"], rep
+    assert rep["divergence_ratio"] > 3.0
+    assert (
+        rep["starved"]["tail_slope_per_round"]
+        > rep["write_rate_per_round"]
+    )
+    assert rep["sized"]["staleness_last"] < rep["bounded_ceiling"]
+
+
+# -- listener-overflow eviction (agent/subs.py + api.py) ---------------------
+
+
+def test_matcher_overflow_marks_queue_lossy(tmp_path):
+    """A listener queue that overflows is marked lossy and counts its
+    drops — silent event loss is no longer a legal outcome."""
+    from corrosion_tpu.agent.store import Store
+    from corrosion_tpu.agent.subs import MatcherHandle
+    from corrosion_tpu.core.values import CHANGE_INSERT, QueryEventChange
+
+    store = Store(str(tmp_path / "s.db"), b"\x03" * 16)
+    store.apply_schema(
+        "CREATE TABLE t (id INTEGER NOT NULL PRIMARY KEY, v TEXT);"
+    )
+    h = MatcherHandle(store, "SELECT id, v FROM t")
+    q = h.attach()
+    assert not h.lossy(q)
+    h._publish([
+        QueryEventChange(
+            kind=CHANGE_INSERT, rowid=i, cells=[i, "x"], change_id=i + 1
+        )
+        for i in range(1030)
+    ])
+    assert h.lossy(q)
+    assert h.dropped_events == 1030 - 1024
+    h.detach(q)
+    assert not h.lossy(q)
+    h.close()
+    store.close()
+
+
+def test_lossy_stream_evicted_and_pump_resumes(tmp_path):
+    """End-to-end eviction contract: once a stream's queue is lossy the
+    server flushes what IS queued and ends the stream; the pump
+    reconnects from the last change id and the oracle stays clean (no
+    duplicate, no miss) — dropped events come back via the durable
+    replay."""
+    from corrosion_tpu.agent.testing import launch_test_agent
+    from corrosion_tpu.loadgen.harness import SubscriptionPump
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        pump = None
+        try:
+            oracle = FanoutOracle()
+            pump = SubscriptionPump(
+                a.client, "SELECT id, text FROM tests", oracle
+            )
+            await pump.start()
+            loop = asyncio.get_running_loop()
+
+            async def write(i):
+                await a.client.execute(
+                    [["INSERT INTO tests (id, text) VALUES (?, ?)",
+                      [i, f"w{i}"]]]
+                )
+                oracle.commit(i, (f"w{i}",), t_ack=loop.time())
+
+            for i in range(5):
+                await write(i)
+            handle = a.agent.subs.get(pump.stream.sub_id)
+            # Force the eviction condition (a real overflow needs >1024
+            # undrained events — the MECHANISM under test is identical).
+            handle._overflowed.add(handle._listeners[0])
+            for i in range(5, 12):
+                await write(i)
+            deadline = loop.time() + 10.0
+            while (
+                oracle.pending(limit=1) or not oracle._streams[0].reconnects
+            ) and loop.time() < deadline:
+                await asyncio.sleep(0.05)
+            rep = oracle.finish()
+            assert rep["reconnects"] >= 1, "stream was never evicted"
+            assert rep["violations"] == 0, rep["violation_examples"]
+            assert rep["missing"] == 0
+        finally:
+            if pump is not None:
+                await pump.stop()
+            await a.stop()
+
+    run(main())

@@ -59,6 +59,7 @@ def _assert_identical(final_u, final_s, curves_u=None, curves_s=None):
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+@pytest.mark.slow  # tier-1 budget; the multichip CI job runs this file unfiltered
 def test_sharded_run_is_bit_identical():
     cfg, topo, sched = _wan_setup()
     final_u, curves_u = simulate(cfg, topo, sched, seed=5)
@@ -122,6 +123,7 @@ def test_sharded_state_is_actually_distributed():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+@pytest.mark.slow  # tier-1 budget; the multichip CI job runs this file unfiltered
 def test_sparse_plane_sharded_bit_identical():
     """The round-5 sparse writer plane (rotation + deviation tables +
     cold sync) under the node-sharded mesh placement: bit-identical to

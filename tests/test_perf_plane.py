@@ -717,6 +717,7 @@ def test_donation_keeps_compile_cache_count_at_one():
     assert engine_mod._scan_rounds_donated._cache_size() == 1
 
 
+@pytest.mark.slow  # tier-1 budget; the bench-smoke CI kernel suite runs it (-k donated)
 def test_donated_round_entry_points_bit_identical_and_released():
     """broadcast/sync/cluster_round donated twins: same results as the
     plain entries from an identical input, and the donated input's

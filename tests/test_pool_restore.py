@@ -238,3 +238,54 @@ def test_agent_online_restore_via_admin(tmp_path):
             await a.stop()
 
     run(main())
+
+
+def test_write_queue_full_blocks_deterministically(tmp_path):
+    """agent/pool.py backpressure contract: a full priority queue BLOCKS
+    the producer in ``put`` — never sheds, drops, or reorders within the
+    class — and drains FIFO once the writer frees slots. (Load-shed is
+    the API layer's job; the pool's is deterministic backpressure.)"""
+    import threading
+
+    async def main():
+        store = Store(str(tmp_path / "bp.db"), b"\x02" * 16)
+        pool = SplitPool(
+            store, queue_depths={HIGH: 1, NORMAL: 2, LOW: 1}
+        )
+        pool.start()
+        gate = threading.Event()
+        started = threading.Event()
+        results = []
+
+        def slow():
+            started.set()
+            assert gate.wait(10), "test gate never opened"
+            return "slow"
+
+        t_slow = asyncio.ensure_future(pool.write(slow))
+        await asyncio.to_thread(started.wait, 5)  # writer thread is busy
+        t1 = asyncio.ensure_future(
+            pool.write(lambda: results.append(1) or 1)
+        )
+        t2 = asyncio.ensure_future(
+            pool.write(lambda: results.append(2) or 2)
+        )
+        await asyncio.sleep(0.05)
+        assert pool.queue_depths()["normal"] == 2  # class queue is FULL
+        t3 = asyncio.ensure_future(
+            pool.write(lambda: results.append(3) or 3)
+        )
+        await asyncio.sleep(0.2)
+        # The third write is neither failed nor executed nor enqueued —
+        # it is BLOCKED in put (deterministic backpressure, no shed).
+        assert not t3.done()
+        assert pool.queue_depths()["normal"] == 2
+        assert results == []
+        gate.set()
+        assert await t_slow == "slow"
+        assert [await t1, await t2, await t3] == [1, 2, 3]
+        assert results == [1, 2, 3]  # FIFO within the priority class
+        await pool.close()
+        store.close()
+
+    run(main())

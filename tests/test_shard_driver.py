@@ -133,6 +133,7 @@ def test_sparse_bit_identical_across_device_counts():
         _assert_xshard_matches_model(got[3], cfg.gossip, mesh)
 
 
+@pytest.mark.slow  # tier-1 budget; the multichip CI job runs this file unfiltered
 def test_chunk_bit_identical_across_device_counts():
     ccfg, origin, last_seq, _ = anti_entropy_chunks(
         n=64, streams=2, last_seq=127, rounds=0
@@ -212,6 +213,7 @@ def test_per_device_state_scales_o_n_over_d():
     )
 
 
+@pytest.mark.slow  # tier-1 budget; the multichip CI job runs this file unfiltered
 def test_donated_rounds_release_sharded_buffers():
     """The PR 5 donation contract survives sharding: a donated round on
     a node-sharded ClusterState releases the (sharded) input buffers and
@@ -256,6 +258,7 @@ def test_donated_rounds_release_sharded_buffers():
     assert len(parallel.per_device_state_bytes(donated)) == 8
 
 
+@pytest.mark.slow  # tier-1 budget; the multichip CI job runs this file unfiltered
 def test_donated_scan_under_sharding_bit_identical():
     """The chunked engine run scans through the _donated twins; under
     the shard_map broadcast driver it must still match the unsharded
