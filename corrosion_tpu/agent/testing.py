@@ -9,6 +9,7 @@ loopback, like the reference's integration tests (SURVEY.md §4).
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from dataclasses import dataclass
 
@@ -57,6 +58,50 @@ async def launch_test_agent(
     await agent.start()
     host, port = agent.api_addr
     return TestAgent(agent=agent, client=CorrosionApiClient(host, port))
+
+
+async def launch_test_cluster(
+    data_dir: str,
+    n: int,
+    wait_membership: bool = True,
+    membership_timeout: float = 20.0,
+    **cfg_overrides,
+) -> list[TestAgent]:
+    """``n`` agents over loopback, chained via bootstrap through the
+    first — the cluster-launch loop the loadgen scenarios, the fidelity
+    harness, and the CLI all share. With ``wait_membership`` (default)
+    it returns only once every agent believes the other ``n - 1`` alive,
+    so callers can start measuring immediately. Launched agents are
+    stopped on a launch/poll failure (no orphaned listeners)."""
+    agents: list[TestAgent] = []
+    try:
+        for i in range(n):
+            agents.append(await launch_test_agent(
+                os.path.join(data_dir, f"agent{i}"),
+                bootstrap=[agents[0].gossip_addr] if agents else None,
+                **cfg_overrides,
+            ))
+        if wait_membership and n > 1:
+            await poll_until(
+                lambda: asyncio.sleep(0, all(
+                    len(a.agent.members.alive()) == n - 1 for a in agents
+                )),
+                timeout=membership_timeout,
+            )
+    except BaseException:
+        await stop_cluster(agents)
+        raise
+    return agents
+
+
+async def stop_cluster(agents) -> None:
+    """Best-effort stop of every agent (teardown must not mask the
+    test's own failure)."""
+    for ta in agents:
+        try:
+            await ta.stop()
+        except Exception:
+            pass
 
 
 async def poll_until(cond, timeout: float = 15.0, interval: float = 0.1):

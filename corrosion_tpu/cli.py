@@ -266,6 +266,65 @@ def _build_parser() -> argparse.ArgumentParser:
     lgk.add_argument("--seed", type=int, default=0)
     lgk.add_argument("--out", default=None)
 
+    # Fidelity plane (corrosion_tpu/fidelity, docs/FIDELITY.md): the
+    # calibrated round-length model and the mixed-mode live-vs-kernel
+    # divergence measurement.
+    fd = add("fidelity", help="calibrated round model + live-vs-kernel "
+             "divergence measurement")
+    fd_sub = fd.add_subparsers(dest="fidelity_cmd", required=True)
+
+    fdc = fd_sub.add_parser(
+        "calibrate", parents=[common],
+        help="derive a corro-round-model/1 JSON from a live loopback "
+        "cluster (or a transport-characterization artifact)",
+    )
+    fdc.add_argument("--out", default="round_model.json")
+    fdc.add_argument("--agents", type=int, default=3)
+    fdc.add_argument("--probes", type=int, default=40,
+                     help="SWIM probe samples per directed agent pair")
+    fdc.add_argument("--dir", default=None,
+                     help="data dir (default: a fresh tempdir)")
+    fdc.add_argument("--from-characterization", default=None,
+                     help="derive from a transport_characterization JSON "
+                     "artifact instead of launching agents")
+    fdc.add_argument("--flush-ms", type=float, default=None,
+                     help="broadcast flush tick for "
+                     "--from-characterization (default: the reference's "
+                     "500 ms)")
+
+    fdm = fd_sub.add_parser(
+        "compare", parents=[common],
+        help="run the standing scenarios live AND as kernel replays; "
+        "report calibrated-vs-uncalibrated divergence",
+    )
+    fdm.add_argument("--scenario", default="all",
+                     choices=["steady", "burst", "dcn", "all"])
+    fdm.add_argument("--agents", type=int, default=3)
+    fdm.add_argument("--writes", type=int, default=24)
+    fdm.add_argument("--dcn-rounds", type=int, default=64)
+    fdm.add_argument("--model", default=None,
+                     help="pre-built round-model JSON for the MIXED-MODE "
+                     "scenarios (steady/burst; default: calibrate inline "
+                     "on the launched cluster). The dcn scenario always "
+                     "uses the synthetic WAN ring model — loopback "
+                     "calibrations have no WAN geography to offer it")
+    fdm.add_argument("--seed", type=int, default=0)
+    fdm.add_argument("--dir", default=None)
+    fdm.add_argument("--out", default=None, help="report JSON path")
+
+    fdr = fd_sub.add_parser(
+        "replay", parents=[common],
+        help="replay a saved trace JSONL through the kernel under a "
+        "round model",
+    )
+    fdr.add_argument("trace", help="trace JSONL (sim.trace.Trace.save)")
+    fdr.add_argument("--model", default=None,
+                     help="round-model JSON (default: the uncalibrated "
+                     "500 ms identity)")
+    fdr.add_argument("--observers", type=int, default=0)
+    fdr.add_argument("--seed", type=int, default=0)
+    fdr.add_argument("--json", action="store_true")
+
     # command/tls.rs:1-94: `corrosion tls {ca,server,client} generate`
     tl = add("tls", help="certificate generation")
     tl.add_argument("tls_kind", choices=["ca", "server", "client"])
@@ -301,6 +360,8 @@ async def _dispatch(args, cfg: Config) -> int:
         return _chaos(args)
     if args.command == "loadgen":
         return await _loadgen(args)
+    if args.command == "fidelity":
+        return await _fidelity(args)
     if args.command == "agent":
         return await _run_agent(cfg)
     if args.command == "query":
@@ -616,6 +677,150 @@ async def _loadgen(args) -> int:
             "soak": soak,
         }
         return emit(report, soak["collapse_rule_holds"])
+    return 2
+
+
+async def _fidelity(args) -> int:
+    """`corrosion fidelity {calibrate,compare,replay}` — the fidelity
+    plane's CLI (docs/FIDELITY.md). `compare` exits 0 iff every
+    mixed-mode scenario's calibrated replay lands strictly closer to the
+    live CDF than the uncalibrated one AND the DCN invariant cross-check
+    holds; 1 otherwise; 2 = usage."""
+    import tempfile
+
+    from corrosion_tpu.fidelity.calibrate import (
+        REFERENCE_ROUND_MS, RoundModel, calibrate_live,
+        from_characterization,
+    )
+
+    if args.fidelity_cmd == "calibrate":
+        if args.from_characterization:
+            try:
+                with open(args.from_characterization) as f:
+                    char = json.load(f)
+                model = from_characterization(
+                    char,
+                    # `is None`, not `or`: an explicit --flush-ms 0 must
+                    # reach derive_model's loud positivity check, never
+                    # silently become the 500 ms default.
+                    flush_ms=(
+                        args.flush_ms if args.flush_ms is not None
+                        else REFERENCE_ROUND_MS
+                    ),
+                )
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"fidelity calibrate: {e!r}", file=sys.stderr)
+                return 2
+        else:
+            if args.flush_ms is not None:
+                print(
+                    "fidelity calibrate: --flush-ms only applies with "
+                    "--from-characterization (live calibration reads the "
+                    "launched agents' configured tick)", file=sys.stderr,
+                )
+                return 2
+            from corrosion_tpu.agent.testing import (
+                launch_test_cluster, stop_cluster,
+            )
+
+            with tempfile.TemporaryDirectory() as tmp:
+                agents = await launch_test_cluster(
+                    args.dir or tmp, args.agents
+                )
+                try:
+                    model = await calibrate_live(agents, probes=args.probes)
+                finally:
+                    await stop_cluster(agents)
+        model.save(args.out)
+        print(f"wrote {args.out}: {model.describe()}")
+        return 0
+
+    if args.fidelity_cmd == "compare":
+        from corrosion_tpu.fidelity import scenarios as fid_scenarios
+        from corrosion_tpu.fidelity.report import emit_fidelity_report
+
+        try:
+            model = RoundModel.load(args.model) if args.model else None
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"fidelity compare: bad --model: {e!r}", file=sys.stderr)
+            return 2
+        out: dict = {"scenarios": {}}
+        with tempfile.TemporaryDirectory() as tmp:
+            base = args.dir or tmp
+            if args.scenario in ("steady", "all"):
+                out["scenarios"]["steady"] = await fid_scenarios.steady_load(
+                    base, writes=args.writes, n_agents=args.agents,
+                    model=model, seed=args.seed, progress=sys.stderr,
+                )
+            if args.scenario in ("burst", "all"):
+                out["scenarios"]["burst"] = await fid_scenarios.burst_drain(
+                    base, writes=args.writes, n_agents=args.agents,
+                    model=model, seed=args.seed, progress=sys.stderr,
+                )
+            if args.scenario in ("dcn", "all"):
+                out["scenarios"]["dcn"] = fid_scenarios.dcn_partition(
+                    rounds=args.dcn_rounds, seed=args.seed,
+                    progress=sys.stderr,
+                )
+        from corrosion_tpu.fidelity.calibrate import trace_fingerprint
+        from corrosion_tpu.fidelity.report import fidelity_context
+
+        fp = trace_fingerprint([
+            (i, blk.get("trace_fingerprint", name), i)
+            for i, (name, blk) in enumerate(sorted(out["scenarios"].items()))
+        ])
+        report = {
+            **fidelity_context(
+                f"cli_{args.scenario}", args.agents, fp,
+                args.writes, args.dcn_rounds, args.seed,
+            ),
+            **out,
+        }
+        emit_fidelity_report(report)
+        text = json.dumps(report, indent=2)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        ok = all(
+            blk.get("calibrated_closer", True)
+            and blk.get("invariants_ok", True)
+            for blk in report["scenarios"].values()
+        )
+        return 0 if ok else 1
+
+    if args.fidelity_cmd == "replay":
+        from corrosion_tpu.fidelity.calibrate import identity_model
+        from corrosion_tpu.fidelity.compare import (
+            bucket_hist, hist_cdf, kernel_replay,
+        )
+        from corrosion_tpu.sim.trace import Trace
+
+        try:
+            trace = Trace.load(args.trace)
+            model = (
+                RoundModel.load(args.model) if args.model
+                else identity_model()
+            )
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"fidelity replay: {e!r}", file=sys.stderr)
+            return 2
+        rep = kernel_replay(
+            trace, model.round_ms,
+            n_nodes=len(trace.actors) + args.observers,
+            model=model, seed=args.seed,
+            vis_offset_rounds=model.vis_offset_rounds,
+        )
+        lat = rep.pop("lat_rounds")
+        rep["hist"] = bucket_hist(lat + model.vis_offset_rounds)
+        rep["cdf"] = [round(c, 6) for c in hist_cdf(rep["hist"])]
+        rep["model"] = model.describe()
+        if args.json:
+            print(json.dumps(rep))
+        else:
+            for k, v in rep.items():
+                print(f"{k}: {v}")
+        return 0 if rep["unseen"] == 0 else 1
     return 2
 
 

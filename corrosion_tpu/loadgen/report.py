@@ -42,13 +42,7 @@ def serving_context(scenario: str, nodes: int, *fingerprint_parts) -> dict:
     }
 
 
-def _get(measured: dict, dotted: str):
-    cur = measured
-    for part in dotted.split("."):
-        if not isinstance(cur, dict) or part not in cur:
-            return None
-        cur = cur[part]
-    return cur
+_get = benchlib.get_path
 
 
 def check_serving_budget(

@@ -28,7 +28,7 @@ import asyncio
 import os
 import resource
 
-from corrosion_tpu.agent.testing import launch_test_agent
+from corrosion_tpu.agent.testing import launch_test_cluster, stop_cluster
 from corrosion_tpu.loadgen.harness import LoadHarness, SubscriptionPump
 from corrosion_tpu.loadgen.oracle import FanoutOracle
 from corrosion_tpu.loadgen.pgread import PgReadClient
@@ -48,26 +48,16 @@ def _raise_nofile() -> None:
         pass
 
 
+# Cluster launch/teardown now live in agent/testing (shared with the
+# fidelity harness and the CLI). Load scenarios skip the membership
+# barrier — a 1-agent storm has no peers and the pumps attach anyway.
 async def _launch_cluster(data_dir: str, n_agents: int, **cfg):
-    """n in-process agents over loopback, chained via bootstrap."""
-    agents = []
-    for i in range(n_agents):
-        bootstrap = [agents[0].gossip_addr] if agents else None
-        agents.append(
-            await launch_test_agent(
-                os.path.join(data_dir, f"agent{i}"), bootstrap=bootstrap,
-                **cfg,
-            )
-        )
-    return agents
+    return await launch_test_cluster(
+        data_dir, n_agents, wait_membership=False, **cfg
+    )
 
 
-async def _stop_cluster(agents) -> None:
-    for ta in agents:
-        try:
-            await ta.stop()
-        except Exception:
-            pass
+_stop_cluster = stop_cluster
 
 
 def _payload(k: int) -> str:

@@ -22,6 +22,18 @@ PLANE_STAGES = ("broadcast", "swim", "sync", "track")
 DEFAULT_TOLERANCE = 1.5
 
 
+def get_path(measured: dict, dotted: str):
+    """Dotted-path lookup into a nested measurement dict (None when any
+    segment is missing) — the budget gates' shared ceiling resolver
+    (serving + fidelity; see their ``check_*_budget``)."""
+    cur = measured
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
 def config_fingerprint(*parts) -> str:
     """Stable short hash of the measured configuration. Dataclass /
     NamedTuple reprs are deterministic (field order is declaration

@@ -574,6 +574,65 @@ def shrink_plan(plan: FaultPlan, still_fails, max_evals: int = 32):
 
 
 # ---------------------------------------------------------------------------
+# Constant-rate axes — the fidelity plane's model→axes compiler entry.
+
+
+def axes_from_rates(
+    rounds: int,
+    loss_by_region=None,
+    probe_loss: float = 0.0,
+    eps: float = 1e-9,
+) -> CompiledFaults:
+    """Lower constant per-round rates to :class:`CompiledFaults` — the
+    entry the fidelity plane's calibrated :class:`RoundModel` compiles
+    through (``fidelity/calibrate.py``), so calibration data flows into
+    the engines via the chaos plane's already-tested axes instead of any
+    new traced code.
+
+    ``loss_by_region`` is a length-R array of receiver-region
+    delivery-miss probabilities (a message whose wall-clock latency
+    straddles the round boundary misses this round's flush and is
+    recovered by rebroadcast/anti-entropy — exactly the loss axis's
+    semantics), or a [rounds, R] matrix when the rate varies per round
+    (the fidelity model's apply-backlog term under bursts);
+    ``probe_loss`` is the SWIM probe-plane loss derived from probe
+    timeout tails. Rates at or below ``eps`` compile to ABSENT axes
+    (``None``), preserving the engines' static zero-cost fault-free
+    skip: the identity model's schedule is bit-identical to no model at
+    all. Deterministic: equal inputs compile to bit-identical arrays.
+    """
+    if rounds <= 0:
+        raise ValueError(f"rounds must be >= 1, got {rounds}")
+    c = CompiledFaults(rounds=rounds, heal_round=0, heals=True)
+    if loss_by_region is not None:
+        arr = np.asarray(loss_by_region, np.float32)
+        if arr.ndim == 2 and arr.shape[0] != rounds:
+            raise ValueError(
+                f"per-round loss_by_region must have {rounds} rows, got "
+                f"shape {arr.shape}"
+            )
+        if arr.ndim not in (1, 2):
+            raise ValueError(
+                f"loss_by_region must be [regions] or [rounds, regions], "
+                f"got shape {arr.shape}"
+            )
+        if arr.size and (arr.min() < 0.0 or arr.max() > 1.0):
+            raise ValueError(
+                f"loss_by_region probabilities must be in [0, 1]: {arr}"
+            )
+        if arr.size and float(arr.max()) > eps:
+            c.loss = (
+                np.repeat(arr[None, :], rounds, axis=0)
+                if arr.ndim == 1 else arr.copy()
+            )
+    if not 0.0 <= probe_loss <= 1.0:
+        raise ValueError(f"probe_loss must be in [0, 1], got {probe_loss}")
+    if probe_loss > eps:
+        c.probe_loss = np.full(rounds, np.float32(probe_loss), np.float32)
+    return c
+
+
+# ---------------------------------------------------------------------------
 # Schedule integration.
 
 

@@ -33,12 +33,34 @@ class Trace:
 
     def record(self, agent) -> None:
         """Attach to a live Agent: every committed local write appends an
-        event (hook installed on Agent.on_local_write)."""
+        event (hook installed on Agent.on_local_write).
+
+        The hook CHAINS with any previously installed one — a second
+        recorder (or a user's own hook) must not silently disable the
+        first, so the new hook calls the previous hook before appending.
+        Detach with :meth:`unrecord`.
+        """
+        prev = getattr(agent, "on_local_write", None)
 
         def hook(actor_id: str, version: int, ts) -> None:
+            if prev is not None:
+                prev(actor_id, version, ts)
             self.events.append((ts_physical_ms(ts), actor_id, version))
 
+        hook._trace_prev = prev  # unrecord support
+        hook._trace_owner = self
         agent.on_local_write = hook
+
+    def unrecord(self, agent) -> bool:
+        """Detach this trace's hook from ``agent`` if it is the most
+        recently installed one, restoring the previous hook. Returns
+        False (and leaves the chain alone) when another hook was
+        installed on top — unwinding out of order would drop it."""
+        hook = getattr(agent, "on_local_write", None)
+        if getattr(hook, "_trace_owner", None) is not self:
+            return False
+        agent.on_local_write = hook._trace_prev
+        return True
 
     def merge(self, other: "Trace") -> "Trace":
         out = Trace(events=sorted(self.events + other.events))
@@ -74,28 +96,45 @@ def schedule_from_trace(
     round r's wall-time window. Versions must be each actor's contiguous
     1..n sequence (they are — the agent allocates them that way); the
     count-per-bucket encoding preserves exactly that order.
+
+    Robust to degenerate inputs: a zero-duration trace (every event in one
+    ``round_ms`` window) buckets into a single write round, and sub-ms
+    ``round_ms`` values bucket with the same float arithmetic used to size
+    the array — the round count is derived from the max bucket index, so a
+    boundary event can never index past the array.
     """
     from corrosion_tpu.sim.engine import Schedule
 
     if not trace.events:
         raise ValueError("empty trace")
+    if not round_ms > 0.0:
+        raise ValueError(f"round_ms must be positive, got {round_ms}")
+    if drain_rounds < 0:
+        raise ValueError(f"drain_rounds must be >= 0, got {drain_rounds}")
     events = sorted(trace.events)
     actors = trace.actors
     a_idx = {a: i for i, a in enumerate(actors)}
-    # Sanity: contiguous per-actor version sequences.
+    # Sanity: contiguous per-actor version sequences — from the FIRST
+    # recorded version, not necessarily 1 (a recorder attached mid-life
+    # of an agent starts at whatever version the agent is up to).
     seen: dict[str, int] = {}
     for _, a, v in events:
-        expect = seen.get(a, 0) + 1
-        if v != expect:
+        if a in seen and v != seen[a] + 1:
             raise ValueError(
-                f"trace gap: actor {a[:8]} version {v}, expected {expect}"
+                f"trace gap: actor {a[:8]} version {v}, expected "
+                f"{seen[a] + 1}"
             )
         seen[a] = v
     t0 = events[0][0]
-    rounds = int((events[-1][0] - t0) // round_ms) + 1
+    # Bucket every event FIRST, then size the array from the max bucket:
+    # deriving the round count independently (duration // round_ms) can
+    # disagree with per-event float floor-division at the last boundary
+    # for fractional round_ms, and a zero-duration trace must still give
+    # one write round.
+    buckets = [int((t - t0) // round_ms) for t, _a, _v in events]
+    rounds = max(buckets) + 1
     writes = np.zeros((rounds + drain_rounds, len(actors)), np.uint32)
-    for t, a, _v in events:
-        r = int((t - t0) // round_ms)
+    for (_t, a, _v), r in zip(events, buckets):
         writes[r, a_idx[a]] += 1
     return actors, Schedule(writes=writes).make_samples(samples)
 

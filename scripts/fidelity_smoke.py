@@ -1,0 +1,166 @@
+"""CI fidelity-smoke gate: the mixed-mode divergence report vs a budget.
+
+Runs the three standing fidelity scenarios at CI-feasible scale — the
+steady-load and burst-drain mixed-mode comparisons (a live 3-agent
+loopback cluster vs the kernel replay of its recorded workload,
+calibrated and uncalibrated) and the DCN-scale partition-and-heal
+kernel scenario — emits ONE self-describing report (platform, nodes,
+config fingerprint, scenario, trace fingerprint —
+``fidelity.report.emit_fidelity_report``), writes it as a JSON artifact,
+and exits 1 when the ``fidelity`` entry of bench_budget.json is
+breached:
+
+- the calibrated replay failing to land STRICTLY closer to the live
+  visibility CDF than the uncalibrated replay (per scenario) — never
+  tolerance-scaled: this ordering is the subsystem's reason to exist;
+- the DCN scenario's chaos-invariant cross-check failing — never
+  tolerance-scaled;
+- any (live or calibrated-replay) write that never became visible;
+- a divergence ceiling (tolerance-scaled): calibrated CDF distance and
+  p99 bucket delta per mixed-mode scenario, the DCN recovery delta.
+
+Usage:
+    python scripts/fidelity_smoke.py [--out report.json] [--budget FILE]
+    python scripts/fidelity_smoke.py --update   # refresh the budget entry
+
+``--update`` rewrites ONLY the ``fidelity`` entry of the budget file
+from the current measurement with x3 headroom (the same policy as
+bench_smoke.py / loadgen_smoke.py; docs/FIDELITY.md documents the
+workflow). Ceilings get floors so a quiet-box measurement can't make any
+later noisier one a breach.
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+import argparse
+import asyncio
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+# Reduced CI scale.
+STEADY_WRITES = 24
+BURST_WRITES = 24
+DCN_ROUNDS = 64
+SCENARIO = "ci_smoke"
+UPDATE_HEADROOM = 3.0
+# Ceiling floors for --update: a quiet loopback box can measure tiny
+# divergences; a near-zero ceiling would make ANY later run a breach.
+FLOOR = {
+    "cdf_distance": 1.0,  # EMD in bucket units (compare.divergence_verdict)
+    "p99_bucket_delta": 1.0,
+    "recovery_delta_rounds": 4.0,
+}
+
+CEILING_PATHS = (
+    ("scenarios.steady.calibrated.cdf_distance", "cdf_distance"),
+    ("scenarios.steady.calibrated.p99_bucket_delta", "p99_bucket_delta"),
+    ("scenarios.burst.calibrated.cdf_distance", "cdf_distance"),
+    ("scenarios.burst.calibrated.p99_bucket_delta", "p99_bucket_delta"),
+    ("scenarios.dcn.recovery_delta_rounds", "recovery_delta_rounds"),
+)
+
+
+def measure() -> dict:
+    from corrosion_tpu.fidelity import scenarios
+    from corrosion_tpu.fidelity.report import emit_fidelity_report
+
+    async def go():
+        with tempfile.TemporaryDirectory() as tmp:
+            return await scenarios.full_report(
+                tmp, scenario=SCENARIO, steady_writes=STEADY_WRITES,
+                burst_writes=BURST_WRITES, dcn_rounds=DCN_ROUNDS,
+                progress=sys.stderr,
+            )
+
+    return emit_fidelity_report(asyncio.run(go()))
+
+
+def main(argv=None) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", default=str(repo / "bench_budget.json"))
+    ap.add_argument("--out", default="fidelity_smoke_report.json")
+    ap.add_argument(
+        "--update", action="store_true",
+        help="rewrite the budget's `fidelity` entry from this measurement "
+        f"(x{UPDATE_HEADROOM} headroom) instead of gating",
+    )
+    args = ap.parse_args(argv)
+
+    from corrosion_tpu.fidelity.report import _get, check_fidelity_budget
+    from corrosion_tpu.sim import benchlib
+
+    measured = measure()
+    budget_path = Path(args.budget)
+    full_budget = (
+        json.loads(budget_path.read_text()) if budget_path.exists() else {}
+    )
+    if args.update:
+
+        def ceiling(path: str, kind: str) -> float:
+            cur = _get(measured, path)
+            if cur is None:
+                # e.g. a replay never converged so the metric never
+                # materialized — refuse to write a budget from a broken
+                # measurement, and say which surface vanished.
+                raise SystemExit(
+                    f"[fidelity-smoke] --update: measurement is missing "
+                    f"{path!r} — cannot refresh the budget from it"
+                )
+            return round(
+                max(abs(float(cur)) * UPDATE_HEADROOM, FLOOR[kind]), 4
+            )
+
+        full_budget["fidelity"] = {
+            "platform": measured["platform"],
+            "scenario": SCENARIO,
+            "tolerance": full_budget.get("fidelity", {}).get(
+                "tolerance", benchlib.DEFAULT_TOLERANCE
+            ),
+            "ceilings": {p: ceiling(p, k) for p, k in CEILING_PATHS},
+            # The ordering and correctness keys are ABSOLUTE — --update
+            # must never loosen them.
+            "require_calibrated_closer": True,
+            "require_invariants_ok": True,
+            "unseen_max": 0,
+        }
+        budget_path.write_text(
+            json.dumps(full_budget, indent=2) + "\n"
+        )
+        print(f"[fidelity-smoke] fidelity budget refreshed: {budget_path}")
+        print(json.dumps(measured))
+        return 0
+
+    if "fidelity" not in full_budget:
+        # Measuring without gating is how regressions pass silently.
+        ok, breaches = False, [
+            "fidelity: entry missing from budget — rerun with --update"
+        ]
+    else:
+        ok, breaches = check_fidelity_budget(
+            measured, full_budget["fidelity"]
+        )
+    report = {
+        **measured,
+        "budget": full_budget.get("fidelity"),
+        "ok": ok,
+        "breaches": breaches,
+    }
+    Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report))
+    if not ok:
+        for b in breaches:
+            print(f"[fidelity-smoke] BREACH {b}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

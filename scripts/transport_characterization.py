@@ -19,11 +19,20 @@ probe plane is structurally isolated. This script MEASURES that:
    measures time for the next sync frame to re-establish and complete
    (pool re-dial + circuit-breaker behavior).
 
-Output: one JSON line with probe RTT percentiles idle vs under bulk
-sync, bulk throughput, and reconnect latency. The claim checked: probe
-p99 under bulk load stays within ~2x idle (no cross-plane head-of-line
-coupling), which a shared-connection design cannot guarantee under
-loss. Documented in docs/SCALING.md "Transport split".
+Output: one SELF-DESCRIBING JSON line (platform, nodes, device_count,
+config fingerprint, scenario — asserted by
+``telemetry.check_bench_invariants``, the PR 6 emit-site rule) with
+probe RTT percentiles idle vs under bulk sync, bulk throughput, and
+reconnect latency. The claim checked: probe p99 under bulk load stays
+within ~2x idle (no cross-plane head-of-line coupling), which a
+shared-connection design cannot guarantee under loss. Documented in
+docs/SCALING.md "Transport split".
+
+This artifact is also a CALIBRATION INPUT: ``corrosion fidelity
+calibrate --from-characterization`` derives a round model from the
+under-bulk probe percentiles and loss tail
+(``fidelity.calibrate.from_characterization``) — which is why its
+provenance is now held to the same standard as the outputs it feeds.
 """
 
 from __future__ import annotations
@@ -134,7 +143,18 @@ async def main() -> None:
             def pct(xs, q):
                 return round(float(np.percentile(xs, q)), 2) if xs else None
 
-            print(json.dumps({
+            # The one self-describing emit site: provenance asserted
+            # exactly like every bench/serving/fidelity JSON, so the
+            # calibration's input measurement is as trustworthy as the
+            # divergence gate it feeds.
+            from corrosion_tpu.sim import benchlib, telemetry
+
+            report = telemetry.check_bench_invariants({
+                **benchlib.bench_context(
+                    "transport_characterization", rows, a.agent.cfg.fanout,
+                ),
+                "scenario": "transport_characterization",
+                "nodes": 2,
                 "rows": rows,
                 "seed_s": round(seed_s, 1),
                 "bulk_catchup_s": round(bulk_s, 1),
@@ -151,7 +171,8 @@ async def main() -> None:
                     1.0 - len(under_load) / 200.0, 3
                 ),
                 "reconnect_to_delivery_s": round(reconnect_s, 2),
-            }))
+            }, extra_provenance=("scenario",))
+            print(json.dumps(report))
         finally:
             await b.stop()
             await a.stop()

@@ -1,0 +1,450 @@
+"""Fidelity plane: round-model calibration + mixed-mode divergence.
+
+Fast units pin the model math (derivation, ring occupancy, capacity
+deferral, divergence metrics, budget gate) and the static-skip promise
+(identity model => bit-identical engine traces). The live-cluster
+mixed-mode comparisons are slow-marked out of the tier-1 lane and run
+unfiltered in the `fidelity` CI job (docs/FIDELITY.md).
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.fidelity.calibrate import (
+    MODEL_SCHEMA,
+    RING_REPR_MS,
+    RoundModel,
+    derive_model,
+    from_characterization,
+    from_ring_occupancy,
+    identity_model,
+    trace_fingerprint,
+)
+from corrosion_tpu.fidelity.compare import (
+    bucket_hist,
+    divergence_verdict,
+    hist_cdf,
+)
+from corrosion_tpu.fidelity.report import (
+    check_fidelity_budget,
+    emit_fidelity_report,
+)
+from corrosion_tpu.sim.engine import Schedule
+from corrosion_tpu.sim.faults import axes_from_rates
+
+
+def _model(**kw):
+    base = dict(
+        rtt_samples_by_pair={(0, 0): [1.0, 2.0, 4.0, 9.0]},
+        flush_ms=50.0,
+        apply_ms=50.0,
+        apply_rate_per_s=100.0,
+        probe_attempts=200,
+        probe_timeouts=6,
+        provenance={"source": "test"},
+    )
+    base.update(kw)
+    return derive_model(**base)
+
+
+# ---------------------------------------------------------------------------
+# RoundModel derivation + serialization.
+
+
+def test_derive_model_pins_round_and_miss():
+    m = _model()
+    # round = flush + apply + one-way p50 (p50 of rtts = 3 -> 1.5).
+    assert m.round_ms == pytest.approx(101.5)
+    # miss = E[min(one_way / round, 1)] over samples.
+    expect = np.mean([x / 2.0 / 101.5 for x in (1.0, 2.0, 4.0, 9.0)])
+    assert m.pair_miss[0][0] == pytest.approx(expect, abs=1e-5)
+    assert m.probe_loss == pytest.approx(0.03)
+    assert not m.is_identity
+
+
+def test_model_json_roundtrip_with_provenance():
+    m = _model()
+    d = json.loads(m.to_json())
+    assert d["schema"] == MODEL_SCHEMA
+    m2 = RoundModel.from_json(m.to_json())
+    assert m2.to_dict() == m.to_dict()
+    # A model without provenance is rejected: a calibration whose
+    # inputs are unstated cannot back a wall-clock claim.
+    d["provenance"] = {}
+    with pytest.raises(ValueError, match="provenance"):
+        RoundModel.from_dict(d)
+    d["schema"] = "bogus/9"
+    with pytest.raises(ValueError, match="corro-round-model"):
+        RoundModel.from_dict(d)
+
+
+def test_compile_axes_bit_identical_across_calls():
+    m = _model()
+    a, b = m.compile_axes(24), m.compile_axes(24)
+    for xa, xb in ((a.loss, b.loss), (a.probe_loss, b.probe_loss)):
+        assert (xa is None) == (xb is None)
+        if xa is not None:
+            assert xa.dtype == xb.dtype and xa.shape == xb.shape
+            assert (xa == xb).all(), "compile must be bit-deterministic"
+    assert a.loss is not None and a.loss.shape == (24, 1)
+    assert a.probe_loss is not None
+
+
+def test_identity_model_compiles_to_absent_axes():
+    ident = identity_model()
+    assert ident.is_identity
+    c = ident.compile_axes(8)
+    assert c.loss is None and c.probe_loss is None
+    sched = Schedule(writes=np.ones((8, 2), np.uint32)).make_samples(8)
+    out = ident.apply(sched, n_nodes=4)
+    assert out.loss is None and out.probe_loss is None
+    assert (out.writes == sched.writes).all()
+
+
+def test_identity_model_engine_trace_bit_identical():
+    # The chaos plane's static-skip promise, re-pinned through the new
+    # entry path: a fault-free (identity) model leaves engine traces
+    # bit-identical to no-model runs.
+    from corrosion_tpu.models.baselines import _cfg
+    from corrosion_tpu.sim.engine import simulate
+
+    cfg, topo = _cfg(12, writers=[0, 1], sync_interval=3, n_cells=0)
+    writes = np.zeros((10, 2), np.uint32)
+    writes[:4] = 1
+    s0 = Schedule(writes=writes.copy()).make_samples(8)
+    s1 = identity_model().apply(
+        Schedule(writes=writes.copy()).make_samples(8), n_nodes=12
+    )
+    f0, c0 = simulate(cfg, topo, s0, seed=3)
+    f1, c1 = simulate(cfg, topo, s1, seed=3)
+    for k in c0:
+        assert (np.asarray(c0[k]) == np.asarray(c1[k])).all(), k
+    assert (np.asarray(f0.vis_round) == np.asarray(f1.vis_round)).all()
+    assert (np.asarray(f0.data.contig) == np.asarray(f1.data.contig)).all()
+
+
+def test_from_ring_occupancy_math():
+    occ = np.zeros((2, 2, len(RING_REPR_MS)), np.int64)
+    occ[0, 0, 0] = occ[1, 1, 0] = 4  # intra-region: ring 0
+    occ[0, 1, 4] = occ[1, 0, 4] = 4  # cross-region: ring 4 (150 ms repr)
+    m = from_ring_occupancy(occ, flush_ms=500.0)
+    # one-way p50 = median over pair means (2.5, 150, 150, 2.5)/2.
+    assert m.round_ms == pytest.approx(500.0 + np.median(
+        [2.5, 150.0, 150.0, 2.5]
+    ) / 2.0)
+    assert m.pair_miss[0][1] == pytest.approx(
+        min(75.0 / m.round_ms, 1.0), abs=1e-5
+    )
+    assert m.regions == 2
+    # loss_by_region folds sources per receiver.
+    lb = m.loss_by_region()
+    assert lb.shape == (2,) and lb[0] == pytest.approx(
+        (m.pair_miss[0][0] + m.pair_miss[0][1]) / 2.0, abs=1e-6
+    )
+    with pytest.raises(ValueError, match="ring sample"):
+        from_ring_occupancy(
+            np.zeros((2, 2, len(RING_REPR_MS))), flush_ms=500.0
+        )
+
+
+def test_from_characterization_requires_percentiles():
+    m = from_characterization(
+        {"probe_rtt_under_bulk_ms": {"p50": 1.0, "p99": 8.0},
+         "probe_loss_under_bulk": 0.05},
+        flush_ms=50.0,
+    )
+    assert m.probe_loss == pytest.approx(0.05)
+    assert m.regions == 1 and m.flush_ms == 50.0
+    with pytest.raises(ValueError, match="p50/p99"):
+        from_characterization({}, flush_ms=50.0)
+
+
+# ---------------------------------------------------------------------------
+# Capacity deferral.
+
+
+def test_defer_schedule_spreads_burst_keeps_samples():
+    m = _model()  # 100/s at ~101.5 ms rounds -> ~10.15 writes/round
+    writes = np.zeros((6, 2), np.uint32)
+    writes[0] = (20, 10)  # 30-write burst in round 0
+    sched = Schedule(writes=writes.copy()).make_samples(30)
+    sample_round = sched.sample_round.copy()
+    out = m.defer_schedule(sched)
+    # Totals and per-writer order preserved; per-round admission capped.
+    assert out.writes.sum(axis=0).tolist() == [20, 10]
+    cap = m.apply_rate_per_s * m.round_ms / 1000.0
+    assert out.writes.sum(axis=1).max() <= int(np.ceil(cap))
+    # Samples untouched: latency still measures from true commit round.
+    assert (out.sample_round == sample_round).all()
+    assert (out.sample_ver == sched.sample_ver).all()
+    # FIFO: the backlog drains in the earliest following rounds.
+    assert out.writes[0].sum() > 0 and out.writes.sum() == 30
+
+
+def test_defer_schedule_noop_under_capacity_and_unmeasured():
+    m = _model()
+    writes = np.ones((5, 2), np.uint32)  # 2/round << capacity
+    sched = Schedule(writes=writes.copy()).make_samples(10)
+    assert m.defer_schedule(sched) is sched
+    m0 = _model(apply_rate_per_s=0.0)
+    burst = Schedule(writes=np.full((2, 2), 50, np.uint32)).make_samples(8)
+    assert m0.defer_schedule(burst) is burst
+
+
+def test_defer_schedule_extends_rounds_for_deep_backlog():
+    m = _model(apply_rate_per_s=20.0)  # ~2 writes/round capacity
+    writes = np.zeros((2, 1), np.uint32)
+    writes[0, 0] = 20
+    sched = Schedule(writes=writes.copy()).make_samples(20)
+    out = m.defer_schedule(sched)
+    assert out.rounds > 2 and out.writes.sum() == 20
+    # Extension with fault axes already attached must refuse (axes are
+    # per-round; defer BEFORE applying plans).
+    sched2 = Schedule(writes=writes.copy()).make_samples(20)
+    sched2 = m.apply(sched2, n_nodes=2)
+    with pytest.raises(ValueError, match="defer BEFORE"):
+        m.defer_schedule(sched2)
+
+
+# ---------------------------------------------------------------------------
+# Divergence metrics + budget gate.
+
+
+def test_axes_from_rates_accepts_per_round_matrix():
+    loss = np.zeros((4, 2), np.float32)
+    loss[1] = (0.5, 0.25)
+    c = axes_from_rates(4, loss_by_region=loss)
+    assert c.loss is not None and (c.loss == loss).all()
+    with pytest.raises(ValueError, match="rows"):
+        axes_from_rates(3, loss_by_region=loss)
+    with pytest.raises(ValueError, match="0, 1"):
+        axes_from_rates(2, loss_by_region=np.array([1.5]))
+    assert axes_from_rates(4, loss_by_region=np.zeros(2)).loss is None
+
+
+def test_divergence_verdict_emd_and_deltas():
+    live = bucket_hist([0.5, 0.9, 1.5, 1.2, 0.4])
+    near = bucket_hist([0.8, 1.1, 1.0, 1.6, 0.3])
+    far = bucket_hist([9.0, 9.5, 10.0, 8.7, 9.9])
+    v_near, v_far = (
+        divergence_verdict(live, near), divergence_verdict(live, far)
+    )
+    # EMD = sum of |dCDF| = expected bucket displacement; a replay 4
+    # buckets off for all its mass must never beat one within 1 bucket.
+    assert v_near["cdf_distance"] < v_far["cdf_distance"]
+    assert v_far["cdf_distance"] == pytest.approx(
+        sum(v_far["per_bucket_cdf_diff"]), abs=1e-4
+    )
+    assert v_far["kolmogorov"] == max(v_far["per_bucket_cdf_diff"])
+    assert v_near["p99_bucket_delta"] <= 1
+    assert v_far["p50_bucket_delta"] >= 3
+    cdf = hist_cdf(live)
+    assert cdf[-1] == pytest.approx(1.0)
+    with pytest.raises(ValueError, match="non-empty"):
+        divergence_verdict([0] * 8, live)
+
+
+def test_side_report_degrades_without_crashing_on_empty_hists():
+    # A run where nothing delivered must still produce a report block
+    # (the gate's unseen/missing-ceiling breaches flag it) — the
+    # standing lane emits its artifact even for a broken run.
+    from corrosion_tpu.fidelity.compare import _side_report
+
+    live = {"lat_ms": [], "ttc_ms": None}
+    rep = {
+        "round_ms": 100.0, "rounds": 10, "pairs": 8, "unseen": 8,
+        "lat_rounds": np.zeros(0), "vis_offset_rounds": 0.5,
+        "ttc_ms": None,
+    }
+    out = _side_report(live, rep, cal_round_ms=100.0)
+    assert out["unseen"] == 8 and "cdf_distance" not in out
+    # And the healthy side against an empty live is equally tolerant.
+    rep2 = dict(rep, lat_rounds=np.ones(4), unseen=0)
+    out2 = _side_report(live, rep2, cal_round_ms=100.0)
+    assert sum(out2["hist"]) == 4 and "cdf_distance" not in out2
+
+
+def test_from_characterization_rejects_out_of_range_loss():
+    with pytest.raises(ValueError, match="probe_loss"):
+        from_characterization(
+            {"probe_rtt_under_bulk_ms": {"p50": 1.0, "p99": 2.0},
+             "probe_loss_under_bulk": 1.7},
+            flush_ms=50.0,
+        )
+
+
+def _measured(**overrides):
+    base = {
+        "platform": "cpu",
+        "scenario": "ci_smoke",
+        "scenarios": {
+            "steady": {
+                "calibrated": {"cdf_distance": 0.4, "p99_bucket_delta": 1,
+                               "unseen": 0},
+                "uncalibrated": {"cdf_distance": 2.2},
+                "calibrated_closer": True,
+                "live": {"unseen": 0},
+            },
+            "dcn": {"invariants_ok": True, "recovery_delta_rounds": 1,
+                    "calibrated": {"unseen": 0}},
+        },
+    }
+    base.update(overrides)
+    return base
+
+
+def test_fidelity_budget_gate_units():
+    budget = {
+        "platform": "cpu", "scenario": "ci_smoke", "tolerance": 1.5,
+        "ceilings": {"scenarios.steady.calibrated.cdf_distance": 0.5},
+    }
+    ok, br = check_fidelity_budget(_measured(), budget)
+    assert ok and not br
+    # Tolerance scales ceilings (0.4 <= 0.5*1.5) but NOT the ordering.
+    tight = dict(budget, ceilings={
+        "scenarios.steady.calibrated.cdf_distance": 0.2,
+    })
+    ok, br = check_fidelity_budget(_measured(), tight)
+    assert not ok and "cdf_distance" in br[0]
+    # A missing ceiling path is a breach (vanished surface).
+    missing = dict(budget, ceilings={"scenarios.gone.cdf_distance": 1.0})
+    ok, br = check_fidelity_budget(_measured(), missing)
+    assert not ok and "missing" in br[0]
+    # calibrated-beats-uncalibrated: never tolerance-scaled, any margin
+    # of failure breaches even under a huge tolerance.
+    m = _measured()
+    m["scenarios"]["steady"]["calibrated_closer"] = False
+    ok, br = check_fidelity_budget(m, {"tolerance": 1000.0})
+    assert not ok and "strictly closer" in br[0]
+    # DCN invariant cross-check: absolute.
+    m = _measured()
+    m["scenarios"]["dcn"]["invariants_ok"] = False
+    ok, br = check_fidelity_budget(m, {})
+    assert not ok and "invariant" in br[0]
+    # unseen pairs: absolute.
+    m = _measured()
+    m["scenarios"]["steady"]["calibrated"]["unseen"] = 3
+    ok, br = check_fidelity_budget(m, {})
+    assert not ok and "unseen" in br[0]
+    # Dimension mismatch names --update.
+    ok, br = check_fidelity_budget(
+        _measured(), {"platform": "axon"}
+    )
+    assert not ok and "--update" in br[0]
+
+
+def test_emit_fidelity_report_requires_trace_fingerprint():
+    good = {
+        "platform": "cpu", "nodes": 3, "device_count": 1,
+        "config_fingerprint": "ab12", "scenario": "x",
+        "trace_fingerprint": trace_fingerprint([(0, "a", 1)]),
+    }
+    assert emit_fidelity_report(dict(good)) == good
+    bad = dict(good)
+    bad.pop("trace_fingerprint")
+    with pytest.raises(ValueError, match="trace_fingerprint"):
+        emit_fidelity_report(bad)
+
+
+def test_trace_fingerprint_stable_and_order_free():
+    a = [(1, "x", 1), (2, "y", 1)]
+    assert trace_fingerprint(a) == trace_fingerprint(list(reversed(a)))
+    assert trace_fingerprint(a) != trace_fingerprint(a[:1])
+
+
+# ---------------------------------------------------------------------------
+# WAN ring model + DCN scenario (kernel-side).
+
+
+def test_wan_ring_model_shape_and_symmetry():
+    from corrosion_tpu.fidelity.scenarios import wan_ring_model
+
+    m = wan_ring_model()
+    assert m.regions == 4 and not m.is_identity
+    miss = np.asarray(m.pair_miss)
+    assert (miss == miss.T).all(), "geo rings are symmetric"
+    assert (np.diag(miss) < miss.max()).all(), "intra-region is nearest"
+    c1, c2 = m.compile_axes(16), m.compile_axes(16)
+    assert (c1.loss == c2.loss).all()
+
+
+@pytest.mark.slow
+def test_dcn_partition_scenario_invariant_cross_check():
+    from corrosion_tpu.fidelity.scenarios import dcn_partition
+
+    rep = dcn_partition(rounds=48, seed=0)
+    assert rep["invariants_ok"], rep["invariant_violations"]
+    assert rep["both_recovered"]
+    assert rep["calibrated"]["unseen"] == 0
+    assert rep["recovery_delta_rounds"] is not None
+
+
+# ---------------------------------------------------------------------------
+# Mixed-mode live-vs-kernel (slow: real agents over loopback).
+
+
+@pytest.mark.slow
+def test_mixed_mode_steady_calibrated_beats_uncalibrated(tmp_path):
+    from corrosion_tpu.fidelity import scenarios
+
+    rep = asyncio.run(
+        scenarios.steady_load(str(tmp_path), writes=18, rate_hz=12.0)
+    )
+    assert rep["live"]["unseen"] == 0
+    assert rep["calibrated"]["unseen"] == 0
+    assert rep["calibrated_closer"], (
+        rep["calibrated"]["cdf_distance"],
+        rep["uncalibrated"]["cdf_distance"],
+    )
+    # The headline acceptance shape: within one bucket at p99.
+    assert rep["calibrated"]["p99_bucket_delta"] <= 1
+    # The model was measured, not assumed.
+    m = rep["model"]
+    assert m["provenance"]["source"] == "live"
+    assert m["provenance"]["probe_attempts"] > 0
+    assert 10.0 < m["round_ms"] < 500.0
+
+
+@pytest.mark.slow
+def test_mixed_mode_burst_calibrated_beats_uncalibrated(tmp_path):
+    from corrosion_tpu.fidelity import scenarios
+
+    rep = asyncio.run(scenarios.burst_drain(str(tmp_path), writes=18))
+    assert rep["live"]["unseen"] == 0
+    assert rep["calibrated_closer"], (
+        rep["calibrated"]["cdf_distance"],
+        rep["uncalibrated"]["cdf_distance"],
+    )
+    # Same trace on both sides, pinned by fingerprint.
+    assert rep["trace_fingerprint"]
+
+
+@pytest.mark.slow
+def test_fidelity_cli_calibrate_and_replay(tmp_path, capsys):
+    # calibrate -> model JSON on disk -> replay a saved trace under it.
+    from corrosion_tpu.cli import main as cli_main
+    from corrosion_tpu.sim.trace import Trace
+
+    model_path = str(tmp_path / "model.json")
+    rc = cli_main([
+        "fidelity", "calibrate", "--agents", "2", "--probes", "8",
+        "--out", model_path,
+    ])
+    assert rc == 0
+    m = RoundModel.load(model_path)
+    assert m.provenance["source"] == "live"
+
+    trace_path = str(tmp_path / "trace.jsonl")
+    Trace(events=[
+        (0, "aa", 1), (40, "bb", 1), (80, "aa", 2), (400, "bb", 2),
+    ]).save(trace_path)
+    rc = cli_main([
+        "fidelity", "replay", trace_path, "--model", model_path,
+        "--observers", "1", "--json",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["unseen"] == 0 and sum(out["hist"]) > 0

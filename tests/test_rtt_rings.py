@@ -61,6 +61,61 @@ def test_sync_prefers_low_ring_peer_on_need_tie():
     assert pulls_far <= 3, f"ring-5 must only win when ring 0 unsampled ({pulls_far})"
 
 
+def test_host_rtt_ring_exact_edges():
+    # Exact ring-edge RTTs: edges are EXCLUSIVE upper bounds (rtt < edge
+    # -> ring i, members.rs:101-136), so a sample AT an edge lands in
+    # the next ring. The rings are now a fidelity-plane calibration
+    # input (fidelity/calibrate.py), so these boundaries are pinned.
+    from corrosion_tpu.agent.membership import RING_BUCKETS_MS
+
+    for i, edge in enumerate(RING_BUCKETS_MS[:-1]):
+        assert rtt_ring(edge) == i + 1, f"edge {edge} must open ring {i + 1}"
+        assert rtt_ring(edge - 0.001) == i
+    # The last edge (300) is inside the open-ended top ring.
+    assert rtt_ring(RING_BUCKETS_MS[-1]) == len(RING_BUCKETS_MS) - 1
+    assert rtt_ring(0.0) == 0
+
+
+def test_member_empty_sample_buffer_and_churn_recalc():
+    m = MemberState(actor_id="x", addr=("h", 1))
+    # Empty sample buffer: no ring assignment yet (callers treat None as
+    # "unknown", sorted last by Members.by_ring).
+    assert m.rtts == [] and m.ring is None
+    # Fill the 20-sample circular buffer with ring-0 RTTs.
+    for _ in range(20):
+        m.add_rtt(2.0)
+    assert m.ring == 0 and len(m.rtts) == 20
+    # Churn: the link degrades; new samples must ROTATE the old ones out
+    # (cap 20) and the ring must recalculate from the surviving window,
+    # not the all-time history.
+    for _ in range(20):
+        m.add_rtt(250.0)
+    assert len(m.rtts) == 20
+    assert all(r == 250.0 for r in m.rtts), "old samples must rotate out"
+    assert m.ring == 5
+    # Partial churn: a mixed window averages (members.rs keeps a mean
+    # over the ring buffer) — 10x2.0 + 10x120.0 -> mean 61 -> ring 3.
+    m2 = MemberState(actor_id="y", addr=("h", 2))
+    for _ in range(10):
+        m2.add_rtt(2.0)
+    for _ in range(10):
+        m2.add_rtt(120.0)
+    assert m2.ring == 3
+
+
+def test_ring_repr_table_matches_ring_edges():
+    # The fidelity plane's representative-RTT table must stay consistent
+    # with the host ring classifier: each representative must classify
+    # into its own ring.
+    from corrosion_tpu.fidelity.calibrate import RING_REPR_MS
+
+    for ring, repr_ms in enumerate(RING_REPR_MS):
+        assert rtt_ring(repr_ms) == ring, (
+            f"RING_REPR_MS[{ring}]={repr_ms} classifies as "
+            f"ring {rtt_ring(repr_ms)}"
+        )
+
+
 def test_host_rtt_buckets_match_reference_edges():
     assert rtt_ring(2.0) == 0
     assert rtt_ring(10.0) == 1

@@ -68,6 +68,131 @@ def test_trace_record_replay_end_to_end(tmp_path):
     assert lat["unseen"] == 0
 
 
+class _FakeAgent:
+    """Just the hook attribute surface Trace.record touches."""
+
+    def __init__(self):
+        self.on_local_write = None
+
+
+def test_two_recorders_chain_instead_of_clobbering():
+    # Regression: Trace.record used to assign the hook wholesale, so a
+    # second recorder (or any user hook) silently disabled the first.
+    from corrosion_tpu.core.hlc import make_ts
+
+    agent = _FakeAgent()
+    user_calls = []
+    agent.on_local_write = lambda a, v, ts: user_calls.append((a, v))
+    t1, t2 = Trace(), Trace()
+    t1.record(agent)
+    t2.record(agent)
+    agent.on_local_write("aa", 1, make_ts(1000))
+    agent.on_local_write("aa", 2, make_ts(1500))
+    assert t1.events == [(1000, "aa", 1), (1500, "aa", 2)]
+    assert t2.events == t1.events, "both recorders must see every write"
+    assert user_calls == [("aa", 1), ("aa", 2)], "user hook must survive"
+    # unrecord unwinds LIFO: t2 detaches cleanly, then t1 is on top; a
+    # trace NOT on top refuses to unwind (it would drop the newer hook).
+    assert not t1.unrecord(agent), "t1 is not on top while t2 records"
+    assert t2.unrecord(agent)
+    assert t1.unrecord(agent)
+    agent.on_local_write("aa", 3, make_ts(2000))
+    assert user_calls[-1] == ("aa", 3), "original user hook restored"
+    assert t1.events[-1][2] == 2, "detached recorders stop recording"
+
+
+def test_unrecord_restores_chain_order():
+    from corrosion_tpu.core.hlc import make_ts
+
+    agent = _FakeAgent()
+    t1, t2 = Trace(), Trace()
+    t1.record(agent)
+    t2.record(agent)
+    assert t2.unrecord(agent)
+    assert t1.unrecord(agent)
+    assert agent.on_local_write is None
+    t1.record(agent)
+    agent.on_local_write("bb", 1, make_ts(7))
+    assert t1.events[-1] == (7, "bb", 1)
+
+
+def test_schedule_from_trace_zero_duration_trace():
+    # Every event in one round_ms window: a valid 1-write-round schedule
+    # (plus the drain tail), not a degenerate shape.
+    t = Trace(events=[(5000, "aa", 1), (5000, "bb", 1), (5001, "aa", 2)])
+    actors, sched = schedule_from_trace(t, round_ms=500, drain_rounds=3)
+    assert sched.writes.shape == (1 + 3, 2)
+    assert sched.writes[0].tolist() == [2, 1]
+    assert sched.writes[1:].sum() == 0
+    assert len(sched.sample_round) == 3
+
+
+def test_schedule_from_trace_sub_ms_round():
+    # Sub-ms round_ms: bucket arithmetic is float; the last event's
+    # bucket must stay inside the array (rounds derives from the max
+    # bucket index, not an independent duration division).
+    t = Trace(events=[(0, "aa", 1), (1, "aa", 2), (999, "aa", 3)])
+    actors, sched = schedule_from_trace(t, round_ms=0.333, drain_rounds=2)
+    assert sched.writes.sum() == 3
+    assert sched.writes.shape[1] == 1
+    # And a plainly invalid round_ms is rejected loudly.
+    for bad in (0.0, -5.0):
+        try:
+            schedule_from_trace(t, round_ms=bad)
+            raise AssertionError("non-positive round_ms must raise")
+        except ValueError as e:
+            assert "round_ms" in str(e)
+
+
+def test_schedule_from_trace_mid_life_attach_base_version():
+    # A recorder attached mid-life of an agent starts at version k+1;
+    # contiguity is required from the FIRST recorded version, not 1.
+    t = Trace(events=[(0, "aa", 13), (10, "aa", 14), (700, "aa", 15)])
+    actors, sched = schedule_from_trace(t, round_ms=500, drain_rounds=1)
+    assert sched.writes[:, 0].tolist() == [2, 1, 0]
+
+
+def test_schedule_from_trace_bucket_counts_preserve_version_order():
+    # Property: for any trace, the count-per-bucket encoding preserves
+    # each actor's version order — walking the buckets in round order
+    # and numbering writes contiguously reproduces exactly the per-actor
+    # version sequence of the sorted events, for any round_ms.
+    rng = np.random.default_rng(0)
+    for case in range(30):
+        n_actors = int(rng.integers(1, 5))
+        actors_in = [f"a{i}" for i in range(n_actors)]
+        events = []
+        t = 0
+        heads = {a: 0 for a in actors_in}
+        for _ in range(int(rng.integers(1, 40))):
+            t += int(rng.integers(0, 700))
+            a = actors_in[int(rng.integers(0, n_actors))]
+            heads[a] += 1
+            events.append((t, a, heads[a]))
+        round_ms = float(rng.choice([0.4, 1.0, 250.0, 500.0, 1000.0]))
+        trace = Trace(events=events)
+        actors, sched = schedule_from_trace(
+            trace, round_ms=round_ms, drain_rounds=1
+        )
+        # Total per actor preserved...
+        for i, a in enumerate(actors):
+            assert sched.writes[:, i].sum() == heads[a]
+        # ...and bucket-order numbering reproduces the event order: the
+        # k-th bucketed write of actor a IS version k (versions started
+        # at 1 here), committed no later than its bucket's successors.
+        for i, a in enumerate(actors):
+            seq = []
+            for r in range(sched.writes.shape[0]):
+                seq.extend([r] * int(sched.writes[r, i]))
+            ev_rounds = [
+                int((tt - events[0][0]) // round_ms)
+                for tt, aa, _v in sorted(events) if aa == a
+            ]
+            # sorted(events) orders ties by actor/version; per actor the
+            # bucket sequence must match the event bucket sequence.
+            assert seq == ev_rounds, (case, a, round_ms)
+
+
 def test_schedule_from_trace_buckets_and_validates():
     t = Trace(events=[
         (1000, "aa", 1), (1200, "aa", 2), (1800, "aa", 3), (2600, "bb", 1),
