@@ -22,7 +22,12 @@ from dataclasses import dataclass, field
 
 from corrosion_tpu.agent.membership import Members, Swim
 from corrosion_tpu.agent.store import Store
-from corrosion_tpu.agent.transport import Session, Transport
+from corrosion_tpu.agent.transport import (
+    Session,
+    Transport,
+    attach_trace,
+    extract_trace,
+)
 from corrosion_tpu.core.bookkeeping import (
     Bookie,
     CLEARED,
@@ -40,6 +45,7 @@ from corrosion_tpu.utils.locks import LockRegistry
 from corrosion_tpu.utils.metrics import MetricsRegistry
 from corrosion_tpu.utils.spawn import TaskRegistry
 from corrosion_tpu.utils.tracing import Tracer
+from corrosion_tpu.utils.tracing import current_span as tracing_current_span
 from corrosion_tpu.utils.tripwire import Tripwire
 
 
@@ -112,6 +118,17 @@ class AgentConfig:
     # OTLP/HTTP collector base URL (spans POST to <url>/v1/traces as
     # OTLP/JSON, batched — main.rs:64-117's exporter). "" = disabled.
     otlp_endpoint: str = ""
+    # Causal write tracing (docs/OBSERVABILITY.md "Causal tracing"): give
+    # every /v1/transactions write a trace id at API ingest and propagate
+    # it through commit, inter-node rebroadcast (a traceparent header in
+    # the bcast frame), and subscription fan-out. OFF by default: the
+    # write path allocates no spans at all unless enabled (pinned by
+    # tests), so the serving bench is untouched.
+    trace_writes: bool = False
+    # Trace-id-keyed sampling rate for write spans (tracing.trace_sampled)
+    # — deterministic per trace id, so every hop of a kept trace keeps it
+    # and a 2k-subscription storm can thin its span volume consistently.
+    trace_sample: float = 1.0
 
 
 @dataclass
@@ -203,7 +220,9 @@ class Agent:
             service=f"corrosion-{self.actor_id[:8]}",
             export_path=cfg.trace_export_path or None,
             otlp_endpoint=cfg.otlp_endpoint or None,
+            sample=cfg.trace_sample,
         )
+        self._trace_writes = cfg.trace_writes
         self._prom_server = None
         self.pool = None  # SplitPool, started with the event loop
         # Hot-path metric handles, resolved once.
@@ -342,6 +361,10 @@ class Agent:
         if self.subs is not None:
             # Restore persisted subscriptions (agent.rs:373-419).
             self.subs.restore()
+            if self._trace_writes:
+                # Fan-out spans ride the same tracer as the write path;
+                # left unwired (the default) match_changes costs nothing.
+                self.subs.tracer = self.tracer
         # Rejoin via persisted member states (agent.rs:772-831): a restarted
         # node reaches its old cluster even when the bootstrap seeds are
         # gone. The failure detector prunes any that died while we were
@@ -481,8 +504,34 @@ class Agent:
         """API-path local write: the SQLite transaction runs on the
         SplitPool's writer at HIGH priority (pool.write_priority ≈
         `pool.write_priority()` at public/mod.rs:41), keeping the event
-        loop free; bookkeeping/subs/broadcast stay loop-confined."""
+        loop free; bookkeeping/subs/broadcast stay loop-confined.
+
+        With causal write tracing on, a ``commit`` span (child of the API
+        layer's ``api_write`` root when one is ambient) covers the store
+        transaction through bookkeeping persistence; its traceparent is
+        stamped onto every broadcast frame so remote hops chain onto it.
+        The default path allocates no spans."""
         t0 = time.monotonic()
+        # Child of the ambient api_write root ONLY: when the root was
+        # dropped (sampling said no, or a non-API caller), minting a
+        # fresh root here would re-roll the sampling decision on a new
+        # random id — orphan commit/fan-out/hop trees for writes the
+        # sampler already dropped, defeating the thinning. With an
+        # ambient parent, maybe_span re-checks the SAME trace id, so
+        # the whole tree keeps or drops together.
+        span = (
+            self.tracer.maybe_span("commit")
+            if self._trace_writes and tracing_current_span() is not None
+            else None
+        )
+        if span is None:
+            return await self._execute_async_inner(statements, t0, None)
+        with span:
+            return await self._execute_async_inner(statements, t0, span)
+
+    async def _execute_async_inner(
+        self, statements, t0, span
+    ) -> ExecResponse:
         if self.pool is not None:
             results, dbv, last_seq, changes = await self.pool.write_priority(
                 lambda: self.store.execute_transaction(statements)
@@ -492,7 +541,7 @@ class Agent:
                 statements
             )
         resp, persist, frames = self._finish_local_write(
-            results, dbv, last_seq, changes, t0
+            results, dbv, last_seq, changes, t0, span=span
         )
         if persist is not None:
             # Persist BEFORE dissemination: a frame on the wire whose
@@ -504,7 +553,9 @@ class Agent:
             self._queue_broadcast(frame)
         return resp
 
-    def _finish_local_write(self, results, dbv, last_seq, changes, t0):
+    def _finish_local_write(
+        self, results, dbv, last_seq, changes, t0, span=None
+    ):
         """Loop-confined bookkeeping; returns (response, persist_closure,
         broadcast_frames). The closure is store-only work the caller runs on
         the pool writer (or inline for the sync path) — and MUST complete
@@ -519,6 +570,10 @@ class Agent:
                 version, Current(db_version=dbv, last_seq=last_seq, ts=ts)
             )
             self._m_committed.inc()
+            if span is not None:
+                span.set_attr("actor", self.actor_id[:8])
+                span.set_attr("version", version)
+                span.set_attr("changes", len(changes))
             if self.on_local_write is not None:
                 # Trace hook: real write traffic recorded for kernel replay
                 # (sim/trace.py; SURVEY §7 step 7's dispatch-seam bridge).
@@ -535,10 +590,16 @@ class Agent:
                     self.subs.persist_watermarks_sync(dirty)
 
             # Chunk for dissemination (public/mod.rs:128-187); queued by
-            # the caller after the bookkeeping row is durable.
+            # the caller after the bookkeeping row is durable. Traced
+            # writes stamp the commit span's traceparent on every frame
+            # (transport.TRACE_KEY) so the first gossip hop parents on it.
+            tp = span.traceparent if span is not None else None
             frames = [
-                self._changeset_frame(
-                    self.actor_id, version, chunk, (s, e), last_seq, ts
+                attach_trace(
+                    self._changeset_frame(
+                        self.actor_id, version, chunk, (s, e), last_seq, ts
+                    ),
+                    tp,
                 )
                 for chunk, (s, e) in chunk_changes(changes, last_seq)
             ]
@@ -790,6 +851,13 @@ class Agent:
         only costs the double work, never correctness."""
         now_ms = int(time.time() * 1000)
         pending: list[tuple[str, int, list[Change], int, int]] = []
+        # Causal-trace hop spans: one ``ingest_apply`` per traced
+        # changeset actually applied this batch, parented on the
+        # upstream hop via the frame's traceparent header. Opened with
+        # Span.start() (not the context manager — batch lifetimes
+        # overlap non-LIFO) and closed after the final flush, so each
+        # span covers queue-drain through store apply + fan-out.
+        hop_spans: list = []
 
         async def flush() -> None:
             if not pending:
@@ -835,6 +903,16 @@ class Agent:
             booked = self.bookie.for_actor(actor)
             if booked.contains(version, seqs):
                 continue  # already known (agent.rs:1817-1843 dedupe)
+            span = None
+            if self._trace_writes:
+                tp = extract_trace(msg)
+                if tp is not None:
+                    span = self.tracer.maybe_span(
+                        "ingest_apply", traceparent=tp,
+                        actor=actor[:8], version=version, source=source,
+                    )
+                    if span is not None:
+                        hop_spans.append(span.start())
             self._m_recv_lag.observe(
                 max(now_ms - ts_physical_ms(msg["ts"]), 0) / 1000.0,
                 source=source,
@@ -853,9 +931,18 @@ class Agent:
                 )
             if source == "broadcast":
                 # Rebroadcast applied changesets (agent.rs:2040-2057).
+                # A traced hop re-stamps the frame with ITS span's
+                # traceparent so the next hop parents here and the
+                # multi-hop chain reconstructs; untraced/unsampled
+                # relays forward the header untouched (the chain skips
+                # them but stays connected by trace id).
                 pb = dict(msg)
+                if span is not None:
+                    attach_trace(pb, span.traceparent)
                 self._queue_broadcast(pb)
         await flush()
+        for s in hop_spans:
+            s.finish()
 
     async def _apply_complete(self, actor, version, changes, last_seq, ts) -> None:
         dbv = changes[0].db_version if changes else 0
@@ -1271,6 +1358,12 @@ class Agent:
         counts). asyncio's equivalents: loop LAG (how late a 1 s sleep
         fires — the 'scheduled duration' signal that catches a blocked
         loop), live task count, and the counted-handle registry depth."""
+        from corrosion_tpu.utils.metrics import (
+            process_open_fds,
+            process_rss_bytes,
+            register_process_gauges,
+        )
+
         lag_hist = self.metrics.histogram(
             "corro_runtime_loop_lag_seconds",
             "event-loop wakeup lag of a 1s timer (blocked-loop detector)",
@@ -1282,6 +1375,11 @@ class Agent:
             "corro_runtime_counted_handles",
             "tasks tracked by the counted-spawn registry",
         )
+        # Process self-observability (docs/OBSERVABILITY.md): RSS,
+        # open-fd count, and the last loop-lag sample as gauges, so an
+        # hours-long soak's leak signals are on /metrics, not just in
+        # post-hoc reports.
+        rss_g, fds_g, lag_g = register_process_gauges(self.metrics)
         log = logging.getLogger(__name__)
         interval = 1.0
         while not self.tripwire.tripped:
@@ -1289,6 +1387,7 @@ class Agent:
             await asyncio.sleep(interval)
             lag = max(time.monotonic() - t0 - interval, 0.0)
             lag_hist.observe(lag)
+            lag_g.set(lag)
             if lag > 1.0:
                 # Slow-turn watchdog (the foca loop warns past 1 s,
                 # broadcast/mod.rs:296-300): something blocked the loop.
@@ -1298,6 +1397,12 @@ class Agent:
             except RuntimeError:
                 pass
             counted_g.set(self.tasks.pending)
+            rss = process_rss_bytes()
+            if rss is not None:
+                rss_g.set(rss)
+            fds = process_open_fds()
+            if fds is not None:
+                fds_g.set(fds)
 
     async def _wal_checkpoint_loop(self) -> None:
         """Periodic WAL truncation on the writer, timed (the reference's

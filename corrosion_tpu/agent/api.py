@@ -211,7 +211,7 @@ async def serve_api(agent: "Agent") -> tuple[str, int]:
                 try:
                     keep = await _route(
                         agent, reader, writer, method, url.path,
-                        parse_qs(url.query), body,
+                        parse_qs(url.query), body, headers,
                     )
                 except HttpError as e:
                     _json_resp(writer, e.status, {"error": e.message})
@@ -260,14 +260,17 @@ def rebuild_api_limits(agent) -> None:
     }
 
 
-async def _route(agent, reader, writer, method, path, query, body) -> bool:
+async def _route(
+    agent, reader, writer, method, path, query, body, headers=None
+) -> bool:
     """Dispatch; returns False when the connection was turned into a stream
     (and must close when the stream ends)."""
     route_key = "/".join(path.split("/")[:3])  # /v1/<route>
     limit = agent._api_limits.get(route_key)
     if limit is None:
         return await _dispatch(
-            agent, reader, writer, method, path, query, body, lambda: None
+            agent, reader, writer, method, path, query, body, lambda: None,
+            headers,
         )
     # The limit bounds request SETUP, not stream lifetime: the reference's
     # ConcurrencyLimitLayer releases its permit when the handler returns
@@ -286,18 +289,38 @@ async def _route(agent, reader, writer, method, path, query, body) -> bool:
 
     try:
         return await _dispatch(
-            agent, reader, writer, method, path, query, body, release
+            agent, reader, writer, method, path, query, body, release,
+            headers,
         )
     finally:
         release()
 
 
 async def _dispatch(
-    agent, reader, writer, method, path, query, body, release
+    agent, reader, writer, method, path, query, body, release, headers=None
 ) -> bool:
     if method == "POST" and path == "/v1/transactions":
         stmts = [Statement.parse(o) for o in _json_body(body)]
-        resp = await agent.execute_async(stmts)
+        # Causal write tracing (opt-in, AgentConfig.trace_writes): every
+        # write gets a trace id HERE, at ingest — continuing the client's
+        # W3C `traceparent` header when one came in, so an end-to-end
+        # journey joins on the caller's trace id. The root `api_write`
+        # span covers request handling through the response body build;
+        # the commit/fan-out children open inside execute_async. The
+        # default path allocates no spans (pinned by tests).
+        span = (
+            agent.tracer.maybe_span(
+                "api_write",
+                traceparent=(headers or {}).get("traceparent"),
+                route=path,
+            )
+            if getattr(agent, "_trace_writes", False) else None
+        )
+        if span is None:
+            resp = await agent.execute_async(stmts)
+        else:
+            with span:
+                resp = await agent.execute_async(stmts)
         _json_resp(writer, 200, resp.to_json_obj())
         return True
     if method == "POST" and path == "/v1/queries":

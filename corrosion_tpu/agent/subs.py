@@ -1007,6 +1007,11 @@ class SubsManager:
         self._db_dir = db_dir
         self._by_sql: dict[str, MatcherHandle] = {}
         self._by_id: dict[str, MatcherHandle] = {}
+        # Causal-trace hook: set by the agent when write tracing is on.
+        # match_changes then emits a `sub_fanout` child span inside each
+        # traced write (ambient span present); unwired — the default —
+        # the fan-out path costs one attribute check and nothing else.
+        self.tracer = None
         self._ensure_table()
 
     def _ensure_table(self) -> None:
@@ -1076,10 +1081,24 @@ class SubsManager:
         callers persist them via ``persist_watermarks_sync`` — on the pool
         writer when one exists, so the event loop never waits on the store
         write lock."""
+        span = None
+        if self.tracer is not None:
+            from corrosion_tpu.utils import tracing
+
+            # Only inside an already-traced (and sampled) write: a bare
+            # match call must not mint a noise root trace.
+            if tracing.current_span() is not None:
+                span = self.tracer.span("sub_fanout").__enter__()
         dirty = []
-        for handle in self._by_id.values():
-            if handle.interested(changes) and handle.process(changes):
-                dirty.append((handle.id, handle.change_id))
+        try:
+            for handle in self._by_id.values():
+                if handle.interested(changes) and handle.process(changes):
+                    dirty.append((handle.id, handle.change_id))
+        finally:
+            if span is not None:
+                span.set_attr("subs_matched", len(dirty))
+                span.set_attr("subs_total", len(self._by_id))
+                span.__exit__(None, None, None)
         return dirty
 
     def persist_watermarks_sync(self, dirty: list[tuple[str, int]]) -> None:

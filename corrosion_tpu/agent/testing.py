@@ -65,6 +65,7 @@ async def launch_test_cluster(
     n: int,
     wait_membership: bool = True,
     membership_timeout: float = 20.0,
+    cfg_for=None,
     **cfg_overrides,
 ) -> list[TestAgent]:
     """``n`` agents over loopback, chained via bootstrap through the
@@ -72,14 +73,21 @@ async def launch_test_cluster(
     harness, and the CLI all share. With ``wait_membership`` (default)
     it returns only once every agent believes the other ``n - 1`` alive,
     so callers can start measuring immediately. Launched agents are
-    stopped on a launch/poll failure (no orphaned listeners)."""
+    stopped on a launch/poll failure (no orphaned listeners).
+
+    ``cfg_for`` (``index -> dict``) merges per-agent config over the
+    shared ``cfg_overrides`` — e.g. a distinct ``trace_export_path`` per
+    agent so traced clusters don't interleave span files."""
     agents: list[TestAgent] = []
     try:
         for i in range(n):
+            per_agent = dict(cfg_overrides)
+            if cfg_for is not None:
+                per_agent.update(cfg_for(i))
             agents.append(await launch_test_agent(
                 os.path.join(data_dir, f"agent{i}"),
                 bootstrap=[agents[0].gossip_addr] if agents else None,
-                **cfg_overrides,
+                **per_agent,
             ))
         if wait_membership and n > 1:
             await poll_until(

@@ -48,6 +48,36 @@ MAX_DATAGRAM = 1178  # foca max_packet_size (broadcast/mod.rs:710)
 FRAME_JSON = 0
 FRAME_BIN = 1
 
+# Causal-trace wire header: broadcast changeset frames may carry a W3C
+# traceparent under this key (the SyncTraceContextV1 role for the
+# broadcast plane, sync.rs:32-67) so a write's dissemination chain
+# reconstructs across hops — each relay re-stamps the frame with ITS
+# ingest span's traceparent, parenting the next hop's span on this one.
+# Absent on untraced/unsampled writes; relays without tracing forward it
+# untouched (the chain skips them but stays connected by trace id).
+TRACE_KEY = "trace"
+
+
+def attach_trace(frame: dict, traceparent: str | None) -> dict:
+    """Stamp (or re-stamp) a frame's trace header in place; a None
+    traceparent leaves the frame untouched."""
+    if traceparent is not None:
+        frame[TRACE_KEY] = traceparent
+    return frame
+
+
+def extract_trace(frame: dict) -> str | None:
+    """The frame's traceparent header, or None. Malformed values are
+    dropped here (one validation point) so ingest never parents a span
+    on garbage a peer sent."""
+    tp = frame.get(TRACE_KEY)
+    if isinstance(tp, str):
+        from corrosion_tpu.utils.tracing import parse_traceparent
+
+        if parse_traceparent(tp) is not None:
+            return tp
+    return None
+
 # Circuit breaker: consecutive failures before tripping, and the cooldown
 # schedule (doubles per further failure, capped).
 BREAKER_THRESHOLD = 3

@@ -138,6 +138,37 @@ def _build_parser() -> argparse.ArgumentParser:
     orc.add_argument("--churn", action="store_true")
     orc.add_argument("--seed", type=int, default=0)
 
+    otm = ob_sub.add_parser(
+        "timeline", parents=[common],
+        help="correlate a traced loadgen run's spans + oracle delivery "
+        "records (and optionally a kernel flight + write trace) into a "
+        "corro-timeline/1 latency-budget artifact",
+    )
+    otm.add_argument("--from-run", default=None,
+                     help="loadgen run report JSON produced with "
+                     "--trace-dir (reads run.trace)")
+    otm.add_argument("--spans", nargs="*", default=None,
+                     help="span-export JSONL file(s) (with --records)")
+    otm.add_argument("--records", default=None,
+                     help="oracle delivery-records JSON (with --spans)")
+    otm.add_argument("--sample", type=float, default=1.0,
+                     help="trace sampling rate the run used (--spans "
+                     "mode; --from-run reads it from the report)")
+    otm.add_argument("--flight", default=None,
+                     help="kernel flight JSONL for the write-journey "
+                     "block (requires --trace)")
+    otm.add_argument("--trace", default=None,
+                     help="recorded write trace JSONL "
+                     "(sim.trace.Trace.save; requires --flight)")
+    otm.add_argument("--round-ms", type=float, default=500.0)
+    otm.add_argument("--tolerance-ms", type=float, default=100.0,
+                     help="stage-sum vs wall reconciliation tolerance")
+    otm.add_argument("--min-coverage", type=float, default=0.99,
+                     help="reconstructed/expected writes floor for "
+                     "exit 0")
+    otm.add_argument("--out", default=None)
+    otm.add_argument("--json", action="store_true")
+
     # Chaos plane (sim/faults.py + sim/invariants.py, docs/CHAOS.md):
     # declarative fault injection, post-heal invariant checking, and a
     # seeded fuzzer that shrinks failing plans to minimal JSON repros.
@@ -236,6 +267,12 @@ def _build_parser() -> argparse.ArgumentParser:
     lgr.add_argument("--dir", default=None,
                      help="data dir (default: a fresh tempdir)")
     lgr.add_argument("--out", default=None, help="report JSON path")
+    lgr.add_argument("--trace-dir", default=None,
+                     help="enable causal write tracing; span exports + "
+                     "oracle delivery records land here and the report "
+                     "gains the run.trace block `obs timeline` consumes")
+    lgr.add_argument("--trace-sample", type=float, default=1.0,
+                     help="trace-id-keyed sampling rate for traced runs")
 
     lgs = lg_sub.add_parser(
         "sweep", parents=[common],
@@ -616,6 +653,8 @@ async def _loadgen(args) -> int:
                 writes=args.writes, write_rate=args.write_rate,
                 read_rate=args.read_rate, pg_rate=args.pg_rate,
                 n_agents=args.agents, drain_timeout_s=args.drain_timeout,
+                trace_dir=args.trace_dir,
+                trace_sample=args.trace_sample,
                 progress=sys.stderr,
             )
         report = {
@@ -825,86 +864,12 @@ async def _fidelity(args) -> int:
 
 
 def _obs(args) -> int:
-    """`corrosion obs {report,tail,diff,record}` — the convergence health
-    plane's CLI (sim/health.py). The import is deferred so the agent
-    subcommands never pay for it; note that any ``corrosion_tpu.sim``
-    import pulls in jax (the package __init__ loads the engines), so obs
-    startup costs the jax import even for pure-JSONL report/tail/diff."""
-    from corrosion_tpu.sim import health
+    """`corrosion obs {report,tail,diff,record,timeline}` — delegates to
+    the obs package (corrosion_tpu/obs/commands.py), which owns the
+    convergence-plane verdicts and the causal-tracing correlator."""
+    from corrosion_tpu.obs import commands as obs_commands
 
-    if args.obs_cmd == "report":
-        rep = health.report_from_flight(
-            args.flight, round_ms=args.round_ms,
-            kill_rounds=args.kill_round,
-        )
-        if args.json:
-            print(json.dumps(rep.to_dict()))
-        else:
-            print(rep.render())
-        return 0
-
-    if args.obs_cmd == "tail":
-        last_round: dict = {}
-        n_rounds = 0
-        for rec in health.iter_flight(
-            args.flight, follow=args.follow, poll_s=args.poll,
-            idle_timeout_s=args.idle_timeout,
-        ):
-            kind = rec.get("kind")
-            if kind == "flight":
-                print(
-                    f"[flight] engine={rec.get('engine', '?')} "
-                    f"version={rec.get('version', '?')}"
-                )
-            elif kind == "round":
-                last_round = rec
-                n_rounds += 1
-                if args.rounds:
-                    print(json.dumps(rec))
-            elif kind == "chunk" and not args.rounds:
-                wall = rec.get("wall_s")
-                tail = {
-                    k: last_round.get(k)
-                    for k in (
-                        "need", "mismatches", "staleness_sum",
-                        "queue_backlog", "swim_undetected_deaths",
-                    )
-                    if k in last_round
-                }
-                print(
-                    f"[chunk] rounds {rec.get('start')}.."
-                    f"{rec.get('start', 0) + rec.get('rounds', 0) - 1}"
-                    + (f" wall={wall}s" if wall is not None else "")
-                    + f" {json.dumps(tail)}"
-                )
-        print(f"[tail] {n_rounds} round records")
-        return 0
-
-    if args.obs_cmd == "diff":
-        base = health.load_report(args.baseline, round_ms=args.round_ms)
-        cand = health.load_report(args.candidate, round_ms=args.round_ms)
-        diff = health.diff_reports(base, cand, tolerance=args.tolerance)
-        if args.json:
-            print(json.dumps(diff))
-        else:
-            for row in diff["rows"]:
-                mark = "ok" if row["ok"] else "REGRESSION"
-                print(
-                    f"{row['metric']}: {row['baseline']} -> "
-                    f"{row['candidate']} [{mark}]"
-                )
-            for r in diff["regressions"]:
-                print(f"REGRESSION: {r}", file=sys.stderr)
-        return 1 if diff["regressions"] else 0
-
-    if args.obs_cmd == "record":
-        facts = health.record_demo_flight(
-            args.out, nodes=args.nodes, rounds=args.rounds,
-            churn=args.churn, seed=args.seed, progress=sys.stderr,
-        )
-        print(json.dumps(facts))
-        return 0
-    return 2
+    return obs_commands.run(args)
 
 
 async def _run_agent(cfg: Config) -> int:

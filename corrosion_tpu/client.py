@@ -79,14 +79,19 @@ class CorrosionApiClient:
         self.port = port
 
     async def _request(
-        self, method: str, path: str, body: bytes | None = None
+        self, method: str, path: str, body: bytes | None = None,
+        headers: dict | None = None,
     ) -> _Response:
         reader, writer = await asyncio.open_connection(self.host, self.port)
+        extra = "".join(
+            f"{k}: {v}\r\n" for k, v in (headers or {}).items()
+        )
         head = (
             f"{method} {path} HTTP/1.1\r\n"
             f"host: {self.host}:{self.port}\r\n"
             "content-type: application/json\r\n"
-            f"content-length: {len(body or b'')}\r\n\r\n"
+            f"content-length: {len(body or b'')}\r\n"
+            f"{extra}\r\n"
         )
         writer.write(head.encode() + (body or b""))
         await writer.drain()
@@ -101,14 +106,26 @@ class CorrosionApiClient:
             headers[k.strip().lower()] = v.strip()
         return _Response(status, headers, reader, writer)
 
-    async def execute(self, statements: list[Statement | str | list]) -> dict:
+    async def execute(
+        self, statements: list[Statement | str | list],
+        traceparent: str | None = None,
+    ) -> dict:
+        """POST /v1/transactions. ``traceparent`` (a W3C header value)
+        seeds the server's causal write trace with the CALLER's trace id
+        — how a load generator's delivery records later join the agent's
+        span export (docs/OBSERVABILITY.md "Causal tracing")."""
         body = json.dumps(
             [
                 s.to_json_obj() if isinstance(s, Statement) else s
                 for s in statements
             ]
         ).encode()
-        resp = await self._request("POST", "/v1/transactions", body)
+        resp = await self._request(
+            "POST", "/v1/transactions", body,
+            headers=(
+                {"traceparent": traceparent} if traceparent else None
+            ),
+        )
         data = await resp.body()
         resp.close()
         if resp.status != 200:

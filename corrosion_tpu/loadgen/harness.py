@@ -22,6 +22,7 @@ contract the oracle then verifies.
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass, field
 
 from corrosion_tpu.client import ApiError, CorrosionApiClient
@@ -229,7 +230,8 @@ class SubscriptionPump:
             if "row" in ev:
                 _rowid, cells = ev["row"]
                 self.oracle.snapshot_row(
-                    self.sid, cells[0], tuple(cells[1:])
+                    self.sid, cells[0], tuple(cells[1:]),
+                    t_wall=time.time(),
                 )
             elif "change" in ev:
                 self._on_change(ev, loop)
@@ -241,9 +243,12 @@ class SubscriptionPump:
 
     def _on_change(self, ev: dict, loop) -> None:
         kind, _rowid, cells, change_id = ev["change"]
+        # Both clocks on purpose: loop.time() feeds the lag histogram
+        # (monotonic, ack-relative); time.time() is the wall stamp the
+        # timeline correlator joins against the agent's span export.
         self.oracle.change(
             self.sid, kind, cells[0], tuple(cells[1:]), change_id,
-            loop.time(),
+            loop.time(), t_wall=time.time(),
         )
 
     async def _run(self) -> None:

@@ -48,6 +48,18 @@ class _Commit:
     payload: object
     t_ack: float
     group: int | None = None
+    # Causal-trace join keys (traced runs only): the client-minted trace
+    # id this write's HTTP request carried, and wall-clock send/ack
+    # times in the same epoch domain as the agent's span export — the
+    # obs timeline correlator joins spans <-> commits <-> deliveries on
+    # (trace_id, key). t_send_mono is the monotonic-clock send time
+    # (same clock as t_ack): the correlator's reconciliation measures
+    # the wall on the monotonic domain so the epoch-derived stage sum
+    # has an INDEPENDENT measurement to answer to.
+    trace_id: str | None = None
+    t_send_wall: float | None = None
+    t_ack_wall: float | None = None
+    t_send_mono: float | None = None
 
 
 @dataclass
@@ -65,9 +77,15 @@ class _Stream:
 class FanoutOracle:
     """Tracks commits vs per-stream deliveries; see module docstring."""
 
-    def __init__(self, registry=None) -> None:
+    def __init__(self, registry=None, keep_deliveries: bool = False) -> None:
+        """``keep_deliveries`` retains one record per observed delivery
+        (stream, key, change id, wall time) for the obs timeline
+        correlator — off by default, a 2k-stream storm's 40k deliveries
+        should not be held unless a traced run asked for them."""
         self._commits: dict[tuple, _Commit] = {}
         self._streams: dict[int, _Stream] = {}
+        self.keep_deliveries = keep_deliveries
+        self.delivery_log: list[dict] = []
         # Deliveries observed BEFORE their commit registered: fan-out
         # regularly beats the writer's own HTTP ack (the matcher pushes
         # to listener queues before the execute response is written), so
@@ -95,15 +113,22 @@ class FanoutOracle:
     # -- write side ----------------------------------------------------------
 
     def commit(
-        self, key, payload, t_ack: float, group: int | None = None
+        self, key, payload, t_ack: float, group: int | None = None,
+        trace_id: str | None = None, t_send_wall: float | None = None,
+        t_ack_wall: float | None = None, t_send_mono: float | None = None,
     ) -> None:
         """Register an acked transaction. ``group`` partitions commits
         onto the subscription group whose query matches them (None =
-        matches every stream)."""
+        matches every stream). Traced runs also pass the write's
+        ``trace_id`` and wall/monotonic send/ack times (see _Commit)."""
         k = (key, payload)
         if k in self._commits:
             raise ValueError(f"commit {k} registered twice by the harness")
-        self._commits[k] = _Commit(key, payload, t_ack, group)
+        self._commits[k] = _Commit(
+            key, payload, t_ack, group,
+            trace_id=trace_id, t_send_wall=t_send_wall,
+            t_ack_wall=t_ack_wall, t_send_mono=t_send_mono,
+        )
         for t in self._early_deliveries.pop(k, ()):
             lag = max(0.0, t - t_ack)
             self.lag_hist.observe(lag)
@@ -129,18 +154,31 @@ class FanoutOracle:
         if st.attached_t is None:
             st.attached_t = t
 
-    def snapshot_row(self, sid: int, key, payload) -> None:
+    def snapshot_row(
+        self, sid: int, key, payload, t_wall: float | None = None
+    ) -> None:
         """A row in the initial snapshot (or a snapshot-restart replay
         after deep reconnect). Set semantics: snapshot re-sends of the
         same row are not duplicates."""
         self._streams[sid].seen_snapshot.add((key, payload))
         self.delivered_snapshot += 1
+        if self.keep_deliveries and t_wall is not None:
+            self.delivery_log.append({
+                "kind": "snapshot", "sid": sid, "key": key,
+                "t_wall": t_wall,
+            })
 
     def change(
-        self, sid: int, kind: str, key, payload, change_id: int, t: float
+        self, sid: int, kind: str, key, payload, change_id: int, t: float,
+        t_wall: float | None = None,
     ) -> None:
         """A live change event on a stream."""
         st = self._streams[sid]
+        if self.keep_deliveries and t_wall is not None:
+            self.delivery_log.append({
+                "kind": "change", "sid": sid, "key": key,
+                "change_id": change_id, "t_wall": t_wall, "t_mono": t,
+            })
         if st.last_change_id is not None and change_id <= st.last_change_id:
             self.violations.append(
                 f"non_monotonic: stream {sid}{st.label and f' ({st.label})'} "
@@ -167,6 +205,31 @@ class FanoutOracle:
 
     def reconnected(self, sid: int) -> None:
         self._streams[sid].reconnects += 1
+
+    # -- correlator export ---------------------------------------------------
+
+    def delivery_records(self) -> dict:
+        """The obs timeline correlator's input: every registered commit
+        (with its trace id + wall send/ack times when the run was
+        traced) and — with ``keep_deliveries`` — every observed delivery
+        wall-timestamped. Keys must be JSON-scalar for the artifact (the
+        loadgen scenarios use integer row ids)."""
+        return {
+            "writes": [
+                {
+                    "key": c.key,
+                    "group": c.group,
+                    "trace_id": c.trace_id,
+                    "t_send_wall": c.t_send_wall,
+                    "t_ack_wall": c.t_ack_wall,
+                    "t_send_mono": c.t_send_mono,
+                    "t_ack_mono": c.t_ack if c.t_send_mono is not None
+                    else None,
+                }
+                for c in self._commits.values()
+            ],
+            "deliveries": list(self.delivery_log),
+        }
 
     # -- verdict -------------------------------------------------------------
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import os
 import resource
+import time
 
 from corrosion_tpu.agent.testing import launch_test_cluster, stop_cluster
 from corrosion_tpu.loadgen.harness import LoadHarness, SubscriptionPump
@@ -76,10 +77,20 @@ async def fanout_storm(
     n_agents: int = 1,
     drain_timeout_s: float = 30.0,
     attach_batch: int = 64,
+    trace_dir: str | None = None,
+    trace_sample: float = 1.0,
     progress=None,
 ) -> dict:
     """Scenario (b): the subscription fan-out storm. Returns the ``run``
-    report block (routes + oracle verdict + achieved concurrency)."""
+    report block (routes + oracle verdict + achieved concurrency).
+
+    ``trace_dir`` switches the run into causal-tracing mode: agents
+    launch with ``trace_writes`` on and per-agent span export files
+    under the directory, every write carries a client-minted W3C
+    traceparent, and the report gains a ``trace`` block (span files +
+    oracle delivery records) — everything ``obs timeline`` needs to
+    reconstruct each acked write's journey (docs/OBSERVABILITY.md
+    "Causal tracing")."""
 
     def note(msg):
         if progress is not None:
@@ -87,9 +98,25 @@ async def fanout_storm(
             progress.flush()
 
     _raise_nofile()
-    agents = await _launch_cluster(data_dir, n_agents)
+    cluster_kw: dict = {}
+    span_files: list[str] = []
+    if trace_dir is not None:
+        os.makedirs(trace_dir, exist_ok=True)
+        span_files = [
+            os.path.join(trace_dir, f"spans-agent{i}.jsonl")
+            for i in range(n_agents)
+        ]
+        cluster_kw = dict(
+            trace_writes=True,
+            trace_sample=trace_sample,
+            cfg_for=lambda i: {"trace_export_path": span_files[i]},
+        )
+    agents = await _launch_cluster(data_dir, n_agents, **cluster_kw)
     harness = LoadHarness()
-    oracle = FanoutOracle(registry=harness.registry)
+    oracle = FanoutOracle(
+        registry=harness.registry,
+        keep_deliveries=trace_dir is not None,
+    )
     pumps: list[SubscriptionPump] = []
     pg_server = pg_client = None
     try:
@@ -122,12 +149,31 @@ async def fanout_storm(
             ta = agents[k % len(agents)]
 
             async def go():
+                tp = trace_id = t_send = t_send_mono = None
+                if trace_dir is not None:
+                    # The CLIENT mints the trace id (Dapper-style): the
+                    # agent's api_write root continues it, so spans,
+                    # this commit record, and the stream deliveries for
+                    # key k all join on one id. Send time is stamped on
+                    # BOTH clocks: epoch (joins the span domain) and
+                    # monotonic (the independent wall the correlator
+                    # reconciles the epoch-derived stage sum against).
+                    trace_id = os.urandom(16).hex()
+                    tp = f"00-{trace_id}-{os.urandom(8).hex()}-01"
+                    t_send = time.time()
+                    t_send_mono = loop.time()
                 await ta.client.execute(
                     [["INSERT INTO tests (id, text) VALUES (?, ?)",
-                      [k, payload]]]
+                      [k, payload]]],
+                    traceparent=tp,
                 )
                 oracle.commit(
-                    k, (payload,), loop.time(), group=k % sub_groups
+                    k, (payload,), loop.time(), group=k % sub_groups,
+                    trace_id=trace_id, t_send_wall=t_send,
+                    t_ack_wall=(
+                        time.time() if trace_dir is not None else None
+                    ),
+                    t_send_mono=t_send_mono,
                 )
 
             # Deadline scales with fan-out: every commit costs the
@@ -185,7 +231,7 @@ async def fanout_storm(
                 *(p.stop() for p in pumps[base:base + 256])
             )
         verdict = oracle.finish()
-        return {
+        out = {
             "subs": subs,
             "sub_groups": sub_groups,
             "agents": n_agents,
@@ -197,6 +243,13 @@ async def fanout_storm(
             },
             "oracle": verdict,
         }
+        if trace_dir is not None:
+            out["trace"] = {
+                "span_files": span_files,
+                "sample": trace_sample,
+                "oracle_records": oracle.delivery_records(),
+            }
+        return out
     finally:
         # Everything the scenario opened closes here, success or not —
         # a failing assertion mid-storm must not leak the PG server,
@@ -356,11 +409,15 @@ def intake_policy(
     from corrosion_tpu.models.baselines import _cfg
     from corrosion_tpu.sim import simulate
     from corrosion_tpu.sim.engine import Schedule
+    from corrosion_tpu.utils.metrics import process_stats
 
     def note(msg):
         if progress is not None:
             progress.write(f"[loadgen soak] {msg}\n")
             progress.flush()
+
+    proc_start = process_stats()
+    t_start = time.monotonic()
 
     # Sustained storm: no drain tail — the collapse rule is about steady
     # state under load, and a drain would let even a starved intake
@@ -412,10 +469,27 @@ def intake_policy(
     divergence_ratio = (
         starved["staleness_last"] / max(sized["staleness_last"], 1.0)
     )
+    proc_end = process_stats()
     return {
         "kernel_nodes": nodes,
         "rounds": rounds,
         "write_rate_per_round": round(write_rate, 2),
+        # Process self-observability (the satellite the hours-long
+        # ROADMAP-5 soaks need): RSS/fd growth across the run, plus how
+        # long the synchronous kernel sections held the event loop —
+        # the soak's own loop-lag figure (the whole section IS lag when
+        # run from an async caller; the agent plane samples the same
+        # gauges live on /metrics).
+        "process": {
+            "start": proc_start,
+            "end": proc_end,
+            "rss_growth_bytes": (
+                proc_end["rss_bytes"] - proc_start["rss_bytes"]
+                if proc_end["rss_bytes"] is not None
+                and proc_start["rss_bytes"] is not None else None
+            ),
+            "loop_held_s": round(time.monotonic() - t_start, 3),
+        },
         "sized": sized,
         "starved": starved,
         "bounded_ceiling": bounded_ceiling,

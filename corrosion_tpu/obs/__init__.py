@@ -1,0 +1,31 @@
+"""Observability plane: causal write timelines + kernel write journeys.
+
+The ``obs`` package owns the cross-plane observability logic the ``obs``
+CLI group exposes (promoted out of ``cli.py``):
+
+- :mod:`corrosion_tpu.obs.timeline` — the correlator that joins agent
+  span exports (``utils/tracing.py`` JSONL), loadgen oracle delivery
+  records (``loadgen/oracle.py``), and optionally a kernel write-journey
+  reconstruction into one ``corro-timeline/1`` artifact with a
+  latency-budget report: for one acked write, where did the latency go —
+  send-wait / ingest-wait / commit / gossip-hops / fan-out — with every
+  write's stage sum reconciled against the independently measured wall
+  latency.
+- :mod:`corrosion_tpu.obs.journey` — the kernel-plane reconstructor:
+  given a flight JSONL and a recorded ``sim/trace.py`` workload, derive
+  each write's commit round, delivery-round profile, and queue-dwell
+  estimate from the existing round curves and delivery-latency buckets —
+  no new traced code.
+- :mod:`corrosion_tpu.obs.commands` — the CLI entrypoints
+  (``obs report|tail|diff|record|timeline``).
+
+Everything host-side; ``journey``/``commands`` import jax transitively
+through ``sim``, ``timeline`` does not.
+"""
+
+from corrosion_tpu.obs.timeline import (  # noqa: F401
+    TIMELINE_SCHEMA,
+    build_timeline,
+    load_spans,
+    timeline_from_run,
+)

@@ -1,0 +1,180 @@
+"""`corrosion obs ...` command implementations.
+
+Promoted out of ``cli.py`` so the observability logic lives with the
+plane it operates on: ``report``/``tail``/``diff``/``record`` drive the
+kernel convergence plane (``sim/health.py``), ``timeline`` drives the
+causal-tracing correlator (:mod:`corrosion_tpu.obs.timeline` +
+:mod:`corrosion_tpu.obs.journey`). ``cli.py`` keeps the argparse surface
+and delegates here.
+
+Exit codes: 0 = verdict ok, 1 = regression / failed invariant, 2 =
+usage. Note any ``corrosion_tpu.sim`` import pulls in jax (the package
+__init__ loads the engines), so obs startup costs the jax import even
+for pure-JSONL report/tail/diff; ``timeline`` without ``--flight``
+avoids it.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def run(args) -> int:
+    if args.obs_cmd == "timeline":
+        return _timeline(args)
+
+    from corrosion_tpu.sim import health
+
+    if args.obs_cmd == "report":
+        rep = health.report_from_flight(
+            args.flight, round_ms=args.round_ms,
+            kill_rounds=args.kill_round,
+        )
+        if args.json:
+            print(json.dumps(rep.to_dict()))
+        else:
+            print(rep.render())
+        return 0
+
+    if args.obs_cmd == "tail":
+        last_round: dict = {}
+        n_rounds = 0
+        for rec in health.iter_flight(
+            args.flight, follow=args.follow, poll_s=args.poll,
+            idle_timeout_s=args.idle_timeout,
+        ):
+            kind = rec.get("kind")
+            if kind == "flight":
+                print(
+                    f"[flight] engine={rec.get('engine', '?')} "
+                    f"version={rec.get('version', '?')}"
+                )
+            elif kind == "round":
+                last_round = rec
+                n_rounds += 1
+                if args.rounds:
+                    print(json.dumps(rec))
+            elif kind == "chunk" and not args.rounds:
+                wall = rec.get("wall_s")
+                tail = {
+                    k: last_round.get(k)
+                    for k in (
+                        "need", "mismatches", "staleness_sum",
+                        "queue_backlog", "swim_undetected_deaths",
+                    )
+                    if k in last_round
+                }
+                print(
+                    f"[chunk] rounds {rec.get('start')}.."
+                    f"{rec.get('start', 0) + rec.get('rounds', 0) - 1}"
+                    + (f" wall={wall}s" if wall is not None else "")
+                    + f" {json.dumps(tail)}"
+                )
+        print(f"[tail] {n_rounds} round records")
+        return 0
+
+    if args.obs_cmd == "diff":
+        base = health.load_report(args.baseline, round_ms=args.round_ms)
+        cand = health.load_report(args.candidate, round_ms=args.round_ms)
+        diff = health.diff_reports(base, cand, tolerance=args.tolerance)
+        if args.json:
+            print(json.dumps(diff))
+        else:
+            for row in diff["rows"]:
+                mark = "ok" if row["ok"] else "REGRESSION"
+                print(
+                    f"{row['metric']}: {row['baseline']} -> "
+                    f"{row['candidate']} [{mark}]"
+                )
+            for r in diff["regressions"]:
+                print(f"REGRESSION: {r}", file=sys.stderr)
+        return 1 if diff["regressions"] else 0
+
+    if args.obs_cmd == "record":
+        facts = health.record_demo_flight(
+            args.out, nodes=args.nodes, rounds=args.rounds,
+            churn=args.churn, seed=args.seed, progress=sys.stderr,
+        )
+        print(json.dumps(facts))
+        return 0
+    return 2
+
+
+def _timeline(args) -> int:
+    """`obs timeline`: correlate a traced loadgen run's span exports +
+    oracle delivery records (and optionally a kernel flight + write
+    trace) into one corro-timeline/1 artifact."""
+    from corrosion_tpu.obs.timeline import (
+        build_timeline,
+        load_spans,
+        timeline_ok,
+    )
+
+    if args.from_run:
+        try:
+            with open(args.from_run) as f:
+                report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"obs timeline: {e!r}", file=sys.stderr)
+            return 2
+        run = report.get("run", report)
+        trace_blk = run.get("trace")
+        if not trace_blk:
+            print(
+                "obs timeline: report has no run.trace block — rerun "
+                "`loadgen run --trace-dir DIR`", file=sys.stderr,
+            )
+            return 2
+        spans = load_spans(trace_blk["span_files"])
+        records = trace_blk["oracle_records"]
+        sample = float(trace_blk.get("sample", 1.0))
+    elif args.spans and args.records:
+        spans = load_spans(args.spans)
+        try:
+            with open(args.records) as f:
+                records = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"obs timeline: bad --records: {e!r}", file=sys.stderr)
+            return 2
+        sample = args.sample
+    else:
+        print(
+            "obs timeline: need --from-run REPORT or --spans FILE... "
+            "--records FILE", file=sys.stderr,
+        )
+        return 2
+
+    timeline = build_timeline(
+        spans, records, sample=sample, tolerance_ms=args.tolerance_ms,
+    )
+
+    if args.flight and args.trace:
+        from corrosion_tpu.obs.journey import reconstruct_write_journeys
+        from corrosion_tpu.sim.trace import Trace
+
+        try:
+            timeline["kernel"] = reconstruct_write_journeys(
+                args.flight, Trace.load(args.trace),
+                round_ms=args.round_ms,
+            )
+        except (OSError, ValueError) as e:
+            print(f"obs timeline: kernel join failed: {e!r}",
+                  file=sys.stderr)
+            return 2
+    elif args.flight or args.trace:
+        print(
+            "obs timeline: --flight and --trace go together (the "
+            "journey reconstructor needs both)", file=sys.stderr,
+        )
+        return 2
+
+    text = json.dumps(timeline, indent=None if args.json else 2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    ok, problems = timeline_ok(timeline, min_coverage=args.min_coverage)
+    for p in problems:
+        print(f"obs timeline: {p}", file=sys.stderr)
+    return 0 if ok else 1

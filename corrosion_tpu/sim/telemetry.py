@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import time
 from dataclasses import dataclass, field
 from typing import IO, Callable
@@ -177,29 +178,88 @@ def round_curves(**stats) -> dict:
     }
 
 
+FLIGHT_SCHEMA = "corro-flight/1"
+
+
+def flight_segments(path: str) -> list[str]:
+    """Every file of a (possibly rotated) flight record, oldest first:
+    ``path.1``, ``path.2``, ..., then the live ``path``. Non-numeric
+    suffixes are not segments."""
+    import glob as _glob
+
+    segs = []
+    for p in _glob.glob(path + ".*"):
+        sfx = p[len(path) + 1:]
+        if sfx.isdigit():
+            segs.append((int(sfx), p))
+    out = [p for _n, p in sorted(segs)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
 class FlightRecorder:
     """Streams per-round kernel curves to JSONL at chunk boundaries.
 
     One ``{"kind": "round", "round": r, <curve values>}`` object per
     round, plus a ``{"kind": "chunk", ...}`` marker per flushed chunk
     (device-execution wall included) and a ``{"kind": "flight", ...}``
-    header per open. The file is flushed after every chunk, so a crashed
-    run loses at most the in-flight chunk and the tail line may be
-    truncated mid-write — ``replay_flight`` skips unparsable lines.
+    header per open — the header is self-describing (``schema``
+    ``corro-flight/1`` + ``segment``), so a reader can refuse a future
+    incompatible format instead of misparsing it. The file is flushed
+    after every chunk, so a crashed run loses at most the in-flight
+    chunk and the tail line may be truncated mid-write —
+    ``replay_flight`` skips unparsable lines.
 
     Open with ``mode="a"`` (default) to let a resumed run append to the
     same record.
+
+    **Rotation** (``max_bytes``): an hours-long soak must not grow one
+    unbounded JSONL. Past the cap (checked at chunk boundaries — whole
+    chunks are never split across files), the live file rotates to
+    ``path.N`` (N monotonically increasing, oldest = ``.1``) and a fresh
+    ``path`` opens with a new header carrying the next ``segment``
+    index. ``replay_flight`` reads the whole segment chain
+    transparently; rounds stay absolute across segments.
     """
 
-    def __init__(self, path: str, engine: str = "dense", mode: str = "a"):
+    def __init__(
+        self, path: str, engine: str = "dense", mode: str = "a",
+        max_bytes: int | None = None,
+    ):
         self.path = path
         self.engine = engine
+        self.max_bytes = max_bytes
+        existing = flight_segments(path)
+        if mode == "w":
+            # A truncating open starts a FRESH record: stale rotated
+            # segments from a previous capped run at the same path must
+            # not survive to be merged into this record's replay.
+            for p in existing:
+                if p != path:
+                    os.remove(p)
+            self._segment = 0
+        else:
+            # Resume-aware segment counter: appending to an already-
+            # rotated record must not rename the live file over an old
+            # segment.
+            self._segment = max(
+                (
+                    int(p[len(path) + 1:]) for p in existing
+                    if p != path
+                ),
+                default=0,
+            )
         self._f: IO[str] | None = open(path, mode)
+        self._write_header()
+        self._f.flush()
+
+    def _write_header(self) -> None:
         self._write(
-            {"kind": "flight", "version": 1, "engine": engine,
+            {"kind": "flight", "schema": FLIGHT_SCHEMA, "version": 1,
+             "engine": self.engine, "segment": self._segment,
              "t_unix": time.time()}
         )
-        self._f.flush()
 
     def _write(self, obj: dict) -> None:
         # Flush every record: `obs tail` / external `tail -f` must see
@@ -231,6 +291,22 @@ class FlightRecorder:
             marker["wall_s"] = round(float(wall_s), 6)
         self._write(marker)
         self._f.flush()
+        if (
+            self.max_bytes is not None
+            and self._f.tell() >= self.max_bytes
+        ):
+            self._rotate()
+
+    def _rotate(self) -> None:
+        """Roll the live file to ``path.N`` and open a fresh segment.
+        Only called at chunk boundaries, so every segment holds whole
+        chunks and replays standalone."""
+        self._f.close()
+        self._segment += 1
+        os.replace(self.path, f"{self.path}.{self._segment}")
+        self._f = open(self.path, "w")
+        self._write_header()
+        self._f.flush()
 
     def close(self) -> None:
         if self._f is not None:
@@ -246,7 +322,9 @@ class FlightRecorder:
 
 
 def replay_flight(path: str) -> tuple[dict, list[dict]]:
-    """Rebuild (curves, chunk markers) from a flight-recorder JSONL.
+    """Rebuild (curves, chunk markers) from a flight-recorder JSONL —
+    including every rotated segment (``path.1``, ``path.2``, ...; see
+    FlightRecorder rotation), oldest first.
 
     Crash-tolerant: unparsable lines (a write cut mid-line) are skipped.
     Rounds are sorted by absolute index; duplicate rounds (an overlapping
@@ -255,20 +333,21 @@ def replay_flight(path: str) -> tuple[dict, list[dict]]:
     """
     rows: dict[int, dict] = {}
     chunks: list[dict] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except ValueError:
-                continue  # truncated tail from a crash — ignore
-            kind = obj.get("kind")
-            if kind == "round" and "round" in obj:
-                rows[int(obj["round"])] = obj
-            elif kind == "chunk":
-                chunks.append(obj)
+    for seg in flight_segments(path) or [path]:
+        with open(seg) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue  # truncated tail from a crash — ignore
+                kind = obj.get("kind")
+                if kind == "round" and "round" in obj:
+                    rows[int(obj["round"])] = obj
+                elif kind == "chunk":
+                    chunks.append(obj)
     order = sorted(rows)
     keys = [
         k for k in ROUND_CURVE_KEYS

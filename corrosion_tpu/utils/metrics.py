@@ -11,6 +11,7 @@ asyncio HTTP endpoint when `[telemetry] prometheus_addr` is configured.
 from __future__ import annotations
 
 import asyncio
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -265,6 +266,68 @@ async def serve_prometheus(
     server = await asyncio.start_server(on_conn, host, port)
     sock = server.sockets[0].getsockname()
     return server, (sock[0], sock[1])
+
+
+def process_rss_bytes() -> int | None:
+    """Resident set size of this process, or None where unknowable.
+    /proc is authoritative on Linux; the resource fallback (macOS)
+    reports ru_maxrss (peak, in bytes there) — close enough for a
+    soak-growth signal."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * (os.sysconf("SC_PAGE_SIZE") or 4096)
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+    except Exception:
+        return None
+
+
+def process_open_fds() -> int | None:
+    """Open file descriptors of this process (None where /proc-less and
+    uncountable). The serving plane is FD-bound — one client + one
+    server socket per subscription — so fd growth is the leak signal
+    hours-long soaks need."""
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def process_stats() -> dict:
+    """One self-observability sample: RSS + open-fd count, JSON-ready.
+    Event-loop lag is measured where a loop runs (the agent's runtime
+    metrics loop exports it; soak reports record how long their
+    synchronous kernel sections held the loop)."""
+    return {
+        "rss_bytes": process_rss_bytes(),
+        "open_fds": process_open_fds(),
+    }
+
+
+def register_process_gauges(registry: "MetricsRegistry") -> tuple:
+    """Create the process self-observability gauges on ``registry``:
+    ``corro_runtime_rss_bytes``, ``corro_runtime_open_fds``, and
+    ``corro_runtime_loop_lag_last_seconds`` (the most recent event-loop
+    wakeup lag — the gauge companion of the existing
+    ``corro_runtime_loop_lag_seconds`` histogram). Returns the three
+    gauges; the caller's sampling loop sets them."""
+    return (
+        registry.gauge(
+            "corro_runtime_rss_bytes", "process resident set size"
+        ),
+        registry.gauge(
+            "corro_runtime_open_fds", "open file descriptors"
+        ),
+        registry.gauge(
+            "corro_runtime_loop_lag_last_seconds",
+            "most recent event-loop wakeup lag sample",
+        ),
+    )
 
 
 class StepTimer:

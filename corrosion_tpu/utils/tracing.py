@@ -54,6 +54,19 @@ class Span:
         self.tracer._record(self)
         return False
 
+    def start(self) -> "Span":
+        """Begin timing WITHOUT becoming the ambient span. For batched
+        span sets whose lifetimes overlap non-LIFO (the ingest loop opens
+        one span per changeset in a batch and closes them all after the
+        flush) — contextvar tokens must reset LIFO, so the context
+        manager cannot model that shape. Pair with :meth:`finish`."""
+        self.start_ns = time.time_ns()
+        return self
+
+    def finish(self) -> None:
+        self.end_ns = time.time_ns()
+        self.tracer._record(self)
+
     @property
     def traceparent(self) -> str:
         """W3C traceparent header value (version 00, sampled)."""
@@ -65,6 +78,10 @@ class Span:
             "trace_id": self.trace_id,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
+            # Which agent emitted this span — the timeline correlator
+            # separates same-trace spans from different cluster members
+            # by it (OTLP carries it at the resource level instead).
+            "service": self.tracer.service if self.tracer else None,
             "start_ns": self.start_ns,
             "duration_us": (self.end_ns - self.start_ns) // 1000,
             "attrs": self.attrs,
@@ -113,6 +130,24 @@ def spans_to_otlp(service: str, spans: list[dict]) -> dict:
     }
 
 
+def trace_sampled(trace_id: str, rate: float) -> bool:
+    """Deterministic trace-id-keyed sampling decision.
+
+    Hash-based (the first 8 hex chars of the trace id against the rate),
+    not random-per-call: every hop of a multi-hop write chain — and every
+    agent of a cluster — makes the SAME keep/drop decision for a given
+    trace without propagating a sampled flag, so a kept trace is kept
+    end-to-end and a dropped one costs nothing anywhere. The W3C
+    tail-sampling consistency trick; rate 1.0 keeps everything, 0.0
+    drops everything.
+    """
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return int(trace_id[:8], 16) < rate * 0x100000000
+
+
 class Tracer:
     """Per-agent tracer: bounded finished-span ring + optional export.
 
@@ -121,7 +156,13 @@ class Tracer:
     finished spans (256 spans or 5 s idle, whichever first — the
     reference's batch exporter, main.rs:103-109) and POSTs OTLP/JSON to
     ``<endpoint>/v1/traces``; close() drains the queue so shutdown never
-    drops buffered spans."""
+    drops buffered spans.
+
+    ``sample`` (0.0–1.0) gates :meth:`maybe_span` by trace id
+    (``trace_sampled``): high-rate span sources (the write path under a
+    2k-subscription storm) thin deterministically and consistently
+    across hops. Explicit :meth:`span` calls always record — sampling is
+    opt-in per call site."""
 
     OTLP_BATCH = 256
     OTLP_FLUSH_S = 5.0
@@ -129,10 +170,12 @@ class Tracer:
     def __init__(
         self, service: str = "corrosion-tpu", capacity: int = 4096,
         export_path: str | None = None, otlp_endpoint: str | None = None,
+        sample: float = 1.0,
     ) -> None:
         import queue
 
         self.service = service
+        self.sample = sample
         self.finished: deque[Span] = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._export_path = export_path
@@ -211,6 +254,40 @@ class Tracer:
             attrs=dict(attrs),
         )
 
+    def maybe_span(
+        self, name: str, traceparent: str | None = None, **attrs
+    ) -> Span | None:
+        """Sampled :meth:`span`: resolve the trace id exactly as span()
+        would (explicit remote parent > ambient parent > fresh trace),
+        then return None when the trace is not kept at this tracer's
+        ``sample`` rate. Callers guard with ``if span is not None`` —
+        an unsampled write allocates no Span at all."""
+        parent = _current_span.get()
+        if traceparent is not None:
+            ctx = parse_traceparent(traceparent)
+            trace_id = ctx[0] if ctx else os.urandom(16).hex()
+            parent_id = ctx[1] if ctx else None
+        elif parent is not None:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        else:
+            trace_id = os.urandom(16).hex()
+            parent_id = None
+        # Decide on the id the span will actually CARRY (a fresh root's
+        # random id included): downstream hops re-check the propagated
+        # id, so deciding on any other value would let a kept root's
+        # children drop mid-chain.
+        if not trace_sampled(trace_id, self.sample):
+            return None
+        return Span(
+            tracer=self,
+            name=name,
+            trace_id=trace_id,
+            span_id=os.urandom(8).hex(),
+            parent_id=parent_id,
+            attrs=dict(attrs),
+        )
+
     def current_traceparent(self) -> str | None:
         span = _current_span.get()
         return span.traceparent if span is not None else None
@@ -258,6 +335,13 @@ class Tracer:
             self._otlp_thread.join(timeout=5.0)
             self._otlp_q = None
             self._otlp_thread = None
+
+
+def current_span() -> "Span | None":
+    """The calling context's ambient span, if any — the guard fan-out
+    instrumentation uses to attach only inside an already-traced write
+    instead of minting noise root traces."""
+    return _current_span.get()
 
 
 def parse_traceparent(value: str) -> tuple[str, str] | None:

@@ -1,0 +1,122 @@
+"""CI timeline-smoke gate: causal write tracing, end to end.
+
+Runs a small loadgen fan-out storm WITH tracing enabled (2 agents so the
+gossip-hop stage is exercised, client-minted trace ids on every write),
+builds the ``corro-timeline/1`` artifact with ``obs``'s correlator, and
+asserts the PR-11 acceptance invariants hard (no budget entry — these
+are absolute correctness properties, not tolerance-scaled ceilings):
+
+- **coverage**: >= 99% of acked (sampled) writes reconstruct end-to-end
+  — ingest -> commit -> fan-out, with span + oracle evidence joined;
+- **reconciliation**: every reconstructed write's stage-latency sum
+  (send-wait + ingest + commit + gossip + fan-out) equals the
+  independently measured wall latency within the stated tolerance, and
+  the span cuts are causally ordered against the oracle's timestamps.
+
+The emitted report goes through ``telemetry.check_bench_invariants``
+(via the serving plane's provenance context) like every other artifact:
+platform, nodes, device_count, config fingerprint, scenario — a
+timeline can no more be published without provenance than a bench.
+
+Usage:
+    python scripts/timeline_smoke.py [--out timeline_smoke_report.json]
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+import argparse
+import asyncio
+import json
+import sys
+import tempfile
+
+SCENARIO = "timeline_smoke"
+SUBS = 32
+WRITES = 48
+WRITE_RATE = 24.0
+AGENTS = 2
+MIN_COVERAGE = 0.99
+TOLERANCE_MS = 100.0
+
+
+def measure() -> dict:
+    from corrosion_tpu.loadgen import scenarios
+    from corrosion_tpu.loadgen.report import emit_serving_report, serving_context
+    from corrosion_tpu.obs.timeline import timeline_from_run, timeline_ok
+
+    async def go():
+        with tempfile.TemporaryDirectory() as tmp:
+            run = await scenarios.fanout_storm(
+                _os.path.join(tmp, "run"),
+                subs=SUBS, writes=WRITES, write_rate=WRITE_RATE,
+                read_rate=5.0, pg_rate=2.0, n_agents=AGENTS,
+                trace_dir=_os.path.join(tmp, "trace"),
+                progress=sys.stderr,
+            )
+            # Build INSIDE the tempdir scope: the span files live there.
+            return run, timeline_from_run(run, tolerance_ms=TOLERANCE_MS)
+
+    run, timeline = asyncio.run(go())
+    ok, problems = timeline_ok(timeline, min_coverage=MIN_COVERAGE)
+    rec = timeline["reconcile"]
+    if rec["independent_walls"] < rec["checked"]:
+        # The smoke must exercise the NON-tautological reconcile path:
+        # every wall measured on the monotonic clock, not the epoch
+        # fallback.
+        ok = False
+        problems = list(problems) + [
+            f"only {rec['independent_walls']}/{rec['checked']} walls "
+            f"measured on the independent monotonic clock"
+        ]
+    report = {
+        **serving_context(SCENARIO, AGENTS, SUBS, WRITES, WRITE_RATE),
+        "subs": SUBS,
+        "oracle": run["oracle"],
+        "timeline": timeline,
+        "min_coverage": MIN_COVERAGE,
+        "ok": ok and run["oracle"]["violations"] == 0,
+        "problems": problems,
+    }
+    return emit_serving_report(report)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="timeline_smoke_report.json")
+    args = ap.parse_args(argv)
+
+    report = measure()
+    with open(args.out, "w") as f:
+        f.write(json.dumps(report, indent=2) + "\n")
+    tl = report["timeline"]
+    print(json.dumps({
+        k: tl[k] for k in (
+            "coverage", "writes_reconstructed", "writes_expected",
+            "hops", "stages_ms", "wall_ms", "reconcile",
+        )
+    }, indent=2))
+    if not report["ok"]:
+        for p in report["problems"]:
+            print(f"[timeline-smoke] FAIL {p}", file=sys.stderr)
+        if report["oracle"]["violations"]:
+            print(
+                f"[timeline-smoke] FAIL oracle violations: "
+                f"{report['oracle']['violation_examples']}",
+                file=sys.stderr,
+            )
+        return 1
+    print(
+        f"[timeline-smoke] ok: {tl['writes_reconstructed']}/"
+        f"{tl['writes_expected']} writes reconstructed, max reconcile "
+        f"err {tl['reconcile']['max_abs_err_ms']} ms"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
