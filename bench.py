@@ -77,24 +77,44 @@ def main() -> None:
         n, rounds = 512, 60
     cfg, topo, sched = models.merge_10k(n=n, rounds=rounds, samples=256)
 
+    # Compile ledger (obs/ledger.py): the first run's window splits the
+    # opaque first-run blob into ledger-derived compile_ms +
+    # first_step_ms, and ARMING it around the timed run turns any
+    # steady-state recompile into a loud RetraceError instead of a
+    # silently skewed measurement (the r04→r05 failure class).
+    from corrosion_tpu.obs import costs as costs_mod
+    from corrosion_tpu.obs import ledger as ledger_mod
+
+    led = ledger_mod.CompileLedger().watch_engines(("dense",)).install()
+
     chunk = 24  # bound single device executions (watchdog-safe:
     # ~5 s per execution at current step times; dispatch to the remote
     # device costs tens of ms per chunk, so fewer chunks = honest wall)
     t0 = time.perf_counter()
-    final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=chunk)
-    jax.block_until_ready(final.data.contig)
+    with led.window("first_run") as cold:
+        final, curves = simulate(cfg, topo, sched, seed=0, max_chunk=chunk)
+        jax.block_until_ready(final.data.contig)
     compile_and_run = time.perf_counter() - t0
 
     # The timed run carries the kernel telemetry plane: per-chunk device
-    # execution walls (step_inner_ms) and corro_kernel_* metric totals.
+    # execution walls (step_inner_ms), corro_kernel_* metric totals, the
+    # armed compile ledger, and live per-device memory watermarks
+    # sampled at every chunk boundary.
     registry = MetricsRegistry()
-    tele = telemetry.KernelTelemetry(engine="dense", registry=registry)
+    watermarks = costs_mod.MemoryWatermarks()
+    tele = telemetry.KernelTelemetry(
+        engine="dense", registry=registry, ledger=led,
+        watermarks=watermarks,
+    )
+    led.arm("bench timed run (seed 1, warmed by seed 0 at same shapes)")
     t1 = time.perf_counter()
     final, curves = simulate(
         cfg, topo, sched, seed=1, max_chunk=chunk, telemetry=tele
     )
     jax.block_until_ready(final.data.contig)
     wall = time.perf_counter() - t1
+    led.disarm()
+    led.publish(registry, engine="dense")
     step_ms = wall / rounds * 1000.0
     step_inner_ms = tele.device_step_ms
     assert step_inner_ms <= step_ms + 1e-6, (
@@ -155,10 +175,20 @@ def main() -> None:
     )
     attr = telemetry.attribute_planes(composite, stages, carry0)
     plane, residual_ms = attr.scale(step_ms)
+    # Roofline stage costs from the SAME composite prefixes (AOT
+    # cost_analysis — lowering only, nothing re-executes): per-plane
+    # flops/bytes joined with the measured plane split below.
+    stage_costs = costs_mod.roofline_stage_costs(composite, stages, carry0)
 
     state_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(final.data)
     ) + sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(final.swim))
+
+    # Memory reconcile-or-fail (obs/costs.py): the watermarks sampled at
+    # every chunk boundary must cover the final state's own live bytes —
+    # a silent sampling gap aborts the bench rather than publishing an
+    # unverified watermark.
+    mem = costs_mod.reconcile_memory(final, watermarks=watermarks)
 
     diag = {
         "platform": platform,
@@ -166,6 +196,10 @@ def main() -> None:
         "rounds": rounds,
         "wall_s": round(wall, 3),
         "first_run_incl_compile_s": round(compile_and_run, 1),
+        "compile_events": cold.compiles,
+        "peak_live_mib": round(
+            max(watermarks.peak.values(), default=0) / 2**20, 1
+        ),
         "applied": applied,
         "cell_merges": merges,
         "state_mib": round(state_bytes / 2**20, 1),
@@ -240,6 +274,7 @@ def main() -> None:
     p99 = lat["p99_s"]
     from corrosion_tpu.ops import onehot
 
+    step_rep = benchlib.rounded_step_report(step_ms, plane)
     report = {
         # Self-describing provenance (check_bench_invariants asserts the
         # presence of platform / nodes / device_count /
@@ -270,10 +305,30 @@ def main() -> None:
         # fusion slack, kept visible so regressions can't hide in
         # unattributed time). One implementation shared with the CI
         # bench-smoke gate.
-        **benchlib.rounded_step_report(step_ms, plane),
+        **step_rep,
         # Device chunk executions only (telemetry chunk timer) —
         # a subset of step_ms's wall, so <= step_ms always.
         "step_inner_ms": round(step_inner_ms, 1),
+        # The ledger split of the first-run blob: compile wall vs
+        # everything else, reconstructing first_run_incl_compile_s
+        # exactly on the published numbers (check_bench_invariants).
+        **benchlib.compile_split_report(compile_and_run, cold.compile_ms),
+        # The armed timed run compiled nothing (a recompile would have
+        # raised RetraceError before this line).
+        "steady_compiles": led.armed_compiles,
+        # Device-cost roofline per plane: composite flops/bytes joined
+        # with the measured plane split — achieved FLOP/s, B/s, and
+        # arithmetic intensity per plane, recomputable from the emitted
+        # numbers (check_bench_invariants does).
+        "roofline": benchlib.roofline_report(
+            stage_costs, step_rep["plane_ms"]
+        ),
+        # Live per-device memory watermark, reconciled against the final
+        # state's own bytes (reconcile_memory raised on any break).
+        "peak_live_bytes_per_device": max(
+            watermarks.peak.values(), default=0
+        ),
+        "state_bytes_per_device": mem["state_bytes_per_device_max"],
         # Convergence health plane (derived from the flight
         # curves alone; bucket-edge seconds, so >= the exact
         # percentiles above by construction).

@@ -82,14 +82,6 @@ _RUNNERS = {
 }
 
 
-def _jitted_functions(module) -> dict[str, object]:
-    return {
-        name: obj for name in dir(module)
-        if callable(obj := getattr(module, name, None))
-        and hasattr(obj, "_cache_size")
-    }
-
-
 def sanitize_engines(
     engines: tuple[str, ...] = ENGINES, strict_dtypes: bool = True,
     check_nans: bool = True,
@@ -99,6 +91,12 @@ def sanitize_engines(
     import contextlib
 
     import jax
+
+    # The ONE registry of watched jitted functions, shared with the
+    # runtime compile ledger and the perf-plane cache pins
+    # (obs/ledger.py) — the offline tripwire and the live one can never
+    # watch different function sets.
+    from corrosion_tpu.obs.ledger import cache_sizes, jitted_functions
 
     findings: list[Finding] = []
     for name in engines:
@@ -134,8 +132,7 @@ def sanitize_engines(
                 f"({type(e).__name__}): {e}",
             ))
             continue
-        jitted = _jitted_functions(module)
-        sizes = {n: fn._cache_size() for n, fn in jitted.items()}
+        sizes = cache_sizes(jitted_functions(module))
         if not any(sizes.values()):
             # A refactor that renames the scan entry points would turn
             # the tripwire into a no-op; that must be loud, not green.

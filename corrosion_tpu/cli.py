@@ -169,6 +169,74 @@ def _build_parser() -> argparse.ArgumentParser:
     otm.add_argument("--out", default=None)
     otm.add_argument("--json", action="store_true")
 
+    # Device-cost observability plane (corrosion_tpu/obs/costs.py,
+    # docs/PERFORMANCE.md "Cost model & roofline"): the XLA cost model
+    # over every engine entry, the baseline diff gate, and the HBM
+    # capacity curve.
+    oct_ = ob_sub.add_parser(
+        "cost", parents=[common],
+        help="XLA cost model: show/diff the corro-cost-model/1 "
+        "artifact, derive the corro-capacity/1 HBM curve",
+    )
+    oct_sub = oct_.add_subparsers(dest="cost_cmd", required=True)
+
+    ocs = oct_sub.add_parser(
+        "show", parents=[common],
+        help="AOT-lower every engine plane entry and emit the "
+        "corro-cost-model/1 artifact",
+    )
+    ocs.add_argument("--engines", default="dense,sparse,chunk,mixed")
+    ocs.add_argument("--variants", default="plain,donated")
+    ocs.add_argument("--devices", default="1,8",
+                     help="comma-separated device counts (sets the "
+                     "virtual CPU mesh flag itself when jax is not yet "
+                     "initialized)")
+    ocs.add_argument("--out", default=None,
+                     help="artifact path (e.g. COST_BASELINE.json)")
+    ocs.add_argument("--json", action="store_true")
+
+    ocd = oct_sub.add_parser(
+        "diff", parents=[common],
+        help="rebuild the cost model at the baseline's dims and diff "
+        "at tolerance — exit 1 on cost regressions",
+    )
+    ocd.add_argument("baseline", help="committed corro-cost-model/1 "
+                     "JSON (COST_BASELINE.json)")
+    ocd.add_argument("--tolerance", type=float, default=None,
+                     help="relative-increase tolerance (default: the "
+                     "baseline's, else 0.25)")
+    ocd.add_argument("--out", default=None, help="diff report path")
+    ocd.add_argument("--json", action="store_true")
+
+    occ = oct_sub.add_parser(
+        "capacity", parents=[common],
+        help="predicted per-device HBM curve (corro-capacity/1), "
+        "validated against the measured 512-node and 100k points",
+    )
+    occ.add_argument("--nodes", default=None,
+                     help="comma-separated node counts (default: the "
+                     "100k..1M flagship grid)")
+    occ.add_argument("--devices", type=int, default=8)
+    occ.add_argument("--hbm-gib", type=float, default=16.0,
+                     help="per-device HBM budget (default: v5e 16 GiB)")
+    occ.add_argument("--no-validate", action="store_true",
+                     help="skip the live 512-node validation point")
+    occ.add_argument("--out", default=None)
+    occ.add_argument("--json", action="store_true")
+
+    # Bench trajectory (corrosion_tpu/obs/trajectory.py): the committed
+    # BENCH_r*/MULTICHIP_r* artifacts as one provenance-checked series.
+    otj = ob_sub.add_parser(
+        "trajectory", parents=[common],
+        help="aggregate committed BENCH_r*/MULTICHIP_r* artifacts into "
+        "a provenance-checked trajectory (refuses cross-platform/"
+        "kernel deltas)",
+    )
+    otj.add_argument("--root", default=".",
+                     help="directory holding the artifacts")
+    otj.add_argument("--out", default=None)
+    otj.add_argument("--json", action="store_true")
+
     # Chaos plane (sim/faults.py + sim/invariants.py, docs/CHAOS.md):
     # declarative fault injection, post-heal invariant checking, and a
     # seeded fuzzer that shrinks failing plans to minimal JSON repros.

@@ -16,11 +16,24 @@ CLI group exposes (promoted out of ``cli.py``):
   each write's commit round, delivery-round profile, and queue-dwell
   estimate from the existing round curves and delivery-latency buckets —
   no new traced code.
+- :mod:`corrosion_tpu.obs.costs` — the device-cost plane: the AOT XLA
+  cost model over every engine entry (``corro-cost-model/1``), roofline
+  stage costs, live per-device memory watermarks with the
+  reconcile-or-fail check, and the ``corro-capacity/1`` HBM curve.
+- :mod:`corrosion_tpu.obs.ledger` — the runtime compile ledger: one
+  registry of watched jitted functions (shared with the sanitize
+  CT030-32 tripwire), per-chunk compile windows into the flight
+  recorder and metrics, and the armable steady-state retrace tripwire.
+- :mod:`corrosion_tpu.obs.trajectory` — the committed
+  ``BENCH_r*``/``MULTICHIP_r*`` artifacts as one provenance-checked
+  series (``corro-bench-trajectory/1``) that refuses cross-platform/
+  kernel deltas.
 - :mod:`corrosion_tpu.obs.commands` — the CLI entrypoints
-  (``obs report|tail|diff|record|timeline``).
+  (``obs report|tail|diff|record|timeline|cost|trajectory``).
 
 Everything host-side; ``journey``/``commands`` import jax transitively
-through ``sim``, ``timeline`` does not.
+through ``sim`` (``costs``/``ledger`` import jax directly),
+``timeline``/``trajectory`` do not.
 """
 
 from corrosion_tpu.obs.timeline import (  # noqa: F401

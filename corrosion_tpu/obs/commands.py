@@ -23,6 +23,10 @@ import sys
 def run(args) -> int:
     if args.obs_cmd == "timeline":
         return _timeline(args)
+    if args.obs_cmd == "cost":
+        return _cost(args)
+    if args.obs_cmd == "trajectory":
+        return _trajectory(args)
 
     from corrosion_tpu.sim import health
 
@@ -99,6 +103,153 @@ def run(args) -> int:
         print(json.dumps(facts))
         return 0
     return 2
+
+
+def _ensure_devices(n: int) -> bool:
+    """Provision ``n`` virtual CPU devices when possible. XLA reads
+    XLA_FLAGS at BACKEND initialization, not at jax import (the
+    package __init__ has already imported jax by CLI-dispatch time), so
+    setting the flag here works as long as nothing has touched
+    ``jax.devices()`` yet; returns False when a backend is already up
+    with fewer devices (the caller reports the usage error)."""
+    import os
+
+    if n <= 1:
+        return True
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    if jax.config.jax_platforms and "axon" in jax.config.jax_platforms:
+        # The environment's sitecustomize grabs the real TPU chip at
+        # interpreter start; the virtual mesh needs the CPU platform
+        # (same override scripts/multichip_smoke.py applies).
+        jax.config.update("jax_platforms", "cpu")
+    return len(jax.devices()) >= n
+
+
+def _emit(payload: dict, args, text: str | None = None) -> None:
+    """Shared artifact output: pretty/compact JSON to stdout (or the
+    rendered text form), plus --out."""
+    body = json.dumps(payload, indent=None if args.json else 2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(payload, indent=2) + "\n")
+    print(body if text is None else text)
+
+
+def _cost(args) -> int:
+    """`obs cost {show,diff,capacity}` — the device-cost plane
+    (obs/costs.py, docs/PERFORMANCE.md "Cost model & roofline")."""
+    from corrosion_tpu.obs import costs
+
+    if args.cost_cmd == "show":
+        devices = [int(d) for d in args.devices.split(",") if d.strip()]
+        if not _ensure_devices(max(devices)):
+            print(
+                f"obs cost: need {max(devices)} devices but jax is "
+                f"already initialized with fewer — set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={max(devices)}",
+                file=sys.stderr,
+            )
+            return 2
+        model = costs.build_cost_model(
+            engines=tuple(
+                e.strip() for e in args.engines.split(",") if e.strip()
+            ),
+            variants=tuple(
+                v.strip() for v in args.variants.split(",") if v.strip()
+            ),
+            device_counts=tuple(devices),
+            progress=sys.stderr,
+        )
+        _emit(model, args)
+        return 0
+
+    if args.cost_cmd == "diff":
+        try:
+            base = costs.load_model(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"obs cost diff: {e!r}", file=sys.stderr)
+            return 2
+        dmax = max(base.get("device_counts", [1]))
+        if not _ensure_devices(dmax):
+            print(
+                f"obs cost diff: baseline covers device_count={dmax}; "
+                f"set XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{dmax}", file=sys.stderr,
+            )
+            return 2
+        cand = costs.build_cost_model(
+            engines=tuple(base.get("engines", costs.ENGINES)),
+            variants=tuple(base.get("variants", costs.VARIANTS)),
+            device_counts=tuple(base.get("device_counts", (1,))),
+            progress=sys.stderr,
+        )
+        ok, breaches, notes = costs.diff_cost_models(
+            base, cand, tolerance=args.tolerance
+        )
+        report = {
+            "ok": ok, "breaches": breaches, "notes": notes,
+            "baseline": args.baseline, "measured": cand,
+        }
+        _emit(report, args)
+        for b in breaches:
+            print(f"obs cost diff: BREACH {b}", file=sys.stderr)
+        for n in notes:
+            print(f"obs cost diff: note: {n}", file=sys.stderr)
+        return 0 if ok else 1
+
+    if args.cost_cmd == "capacity":
+        if not _ensure_devices(args.devices):
+            print(
+                f"obs cost capacity: need {args.devices} devices — set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{args.devices}", file=sys.stderr,
+            )
+            return 2
+        nodes = (
+            costs.CAPACITY_NODE_GRID if args.nodes is None
+            else tuple(
+                int(x) for x in args.nodes.split(",") if x.strip()
+            )
+        )
+        try:
+            model = costs.capacity_model(
+                node_counts=nodes,
+                device_count=args.devices,
+                validate_live=not args.no_validate,
+                hbm_bytes=int(args.hbm_gib * 2**30),
+            )
+        except ValueError as e:
+            print(f"obs cost capacity: RECONCILE FAILED: {e}",
+                  file=sys.stderr)
+            return 1
+        _emit(model, args)
+        return 0
+    return 2
+
+
+def _trajectory(args) -> int:
+    """`obs trajectory` — the committed bench artifacts as one
+    provenance-checked series (obs/trajectory.py)."""
+    from corrosion_tpu.obs import trajectory as traj_mod
+
+    traj = traj_mod.build_trajectory(args.root)
+    if not traj["bench"] and not traj["multichip"]:
+        print(
+            f"obs trajectory: no BENCH_r*/MULTICHIP_r* artifacts under "
+            f"{args.root}", file=sys.stderr,
+        )
+        return 2
+    _emit(
+        traj, args,
+        text=None if args.json else traj_mod.render_trajectory(traj),
+    )
+    return 0
 
 
 def _timeline(args) -> int:

@@ -1,0 +1,850 @@
+"""Device-cost observability: XLA cost model, memory watermarks,
+roofline attribution, and the HBM capacity curve.
+
+SURVEY's premise makes gossip rounds batched sparse scatter/gather over
+ICI — flops and bytes per round are this simulator's native currency —
+yet until this plane the repo measured neither: the v5e capacity claim
+in docs/SCALING.md was prose arithmetic, and the pallas-vs-dense
+decision had no per-kernel flop/byte data. This module extracts what
+XLA already knows at compile time and reconciles it against what the
+runtime actually does:
+
+- **Cost model** (``corro-cost-model/1``): AOT-lower every jitted plane
+  entry of all four engine drivers — the plain AND donated scan twins,
+  and the shard_map driver at device_count ∈ {1, 8} — at fixed tiny
+  configs, and extract ``cost_analysis()`` (flops, bytes accessed) +
+  ``memory_analysis()`` (argument/output/temp/alias bytes) per entry,
+  keyed by config fingerprint + backend + device count. The committed
+  ``COST_BASELINE.json`` is this artifact; CI diffs every PR against it
+  (:func:`diff_cost_models`), so a cost regression — an accidental
+  dense fallback, a widened dtype, a lost donation alias — fails the
+  PR that introduces it.
+- **Roofline stage costs**: the SAME cumulative-prefix composite the
+  timing attribution uses (``benchlib.plane_composite``) is lowered one
+  prefix at a time; a stage's flops/bytes are the increment, exactly
+  mirroring how its milliseconds are measured. Joined with measured
+  ``plane_ms``, every bench JSON carries achieved FLOP/s, B/s, and
+  arithmetic intensity per plane (``benchlib.roofline_report``).
+- **Memory watermarks** (:class:`MemoryWatermarks`): live per-device
+  buffer bytes sampled at chunk/epoch boundaries (via
+  ``KernelTelemetry``), reconciled — in the reconcile-or-fail style of
+  the timeline plane — against the static spec-arithmetic prediction
+  (``parallel.mesh.predicted_per_device_bytes``) and the measured
+  ``parallel.per_device_state_bytes``: breaks raise, they do not skew.
+- **Capacity curve** (``corro-capacity/1``): nodes → predicted
+  per-device state bytes for the flagship sharded config, derived from
+  ``jax.eval_shape`` + the one placement-spec source the shard helpers
+  use, validated against the lane's measured 512-node point (live, to
+  the byte) and the recorded 100,352-node run — then extrapolated to
+  the 500k–800k ROADMAP targets against the v5e HBM budget. This
+  replaces docs/SCALING.md's prose math.
+
+Everything here is host-side AOT work: lowering never executes a round,
+and ``.lower().compile()`` does not populate the jitted entries' call
+caches (pinned in tests/test_cost_plane.py), so building the model
+cannot trip the compile ledger's steady-state tripwire.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+COST_SCHEMA = "corro-cost-model/1"
+CAPACITY_SCHEMA = "corro-capacity/1"
+
+ENGINES = ("dense", "sparse", "chunk", "mixed")
+VARIANTS = ("plain", "donated")
+#: Device counts the model covers when the host has 8 devices: the
+#: unsharded anchor and the standing 8-virtual-device CPU mesh lane.
+DEVICE_COUNTS = (1, 8)
+
+#: v5e per-chip HBM, the budget the capacity verdicts gate against.
+HBM_BYTES_V5E = 16 * 2**30
+#: Fraction of HBM the capacity verdict leaves for the round's transient
+#: working set (XLA temps, donated round-trips, collectives). The
+#: measured tiny-config ``temp_bytes / argument_bytes`` ratio rides the
+#: artifact as context; this headroom is the conservative gate.
+CAPACITY_HEADROOM = 0.5
+
+#: Measured validation points for :func:`capacity_model`. The 512-node
+#: point is re-measured LIVE on every run (device placement is cheap);
+#: the 100k point is the recorded multichip ``--large`` run
+#: (docs/SCALING.md "Multi-chip": 67.8 MiB max per-device state at
+#: 100,352 nodes on the (dcn=2, ici=4) mesh).
+MEASURED_100K = {
+    "nodes": 100_352,
+    "device_count": 8,
+    "per_device_bytes": 67.8 * 2**20,
+    "source": "multichip --large r07 (docs/SCALING.md Multi-chip)",
+}
+
+
+# ---------------------------------------------------------------------------
+# cost_analysis / memory_analysis extraction
+
+
+def _cost_dict(compiled) -> dict:
+    """Normalize ``compiled.cost_analysis()`` across jax versions
+    (list-of-dict vs dict) to ``{flops, bytes_accessed}``."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+    }
+
+
+def _mem_dict(compiled) -> dict:
+    ma = compiled.memory_analysis()
+    out = {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+    # One number for "live at once" regressions to gate on. XLA's CPU
+    # backend reports no explicit peak, so this is the documented
+    # arguments+outputs+temps upper envelope (aliased buffers counted
+    # once — donation reuses them in place).
+    out["peak_bytes"] = (
+        out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+        - out["alias_bytes"]
+    )
+    return out
+
+
+def extract_entry(lowered, rounds: int, **meta) -> dict:
+    """Compile a lowered computation and extract its cost entry."""
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    entry = {
+        **meta,
+        "rounds": int(rounds),
+        **_cost_dict(compiled),
+        **_mem_dict(compiled),
+        "aot_compile_s": round(time.perf_counter() - t0, 2),
+    }
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# Tiny fixed configs + concrete scan-entry arguments, per engine.
+#
+# Shapes mirror the sanitize pass's tiny instances (analysis/sanitize.py)
+# so "the cost of a plane entry" means the same thing to both watchers;
+# node counts divide 8 so the same configs lower on the virtual mesh.
+
+
+def _tiny_dense():
+    from corrosion_tpu import models
+
+    return models.merge_10k(n=32, rounds=8, samples=8)
+
+
+def _tiny_sparse():
+    from corrosion_tpu import models
+
+    return models.anywrite_sparse(
+        n=96, w_hot=16, n_regions=4, rounds=16, cohort=8, epoch_rounds=8,
+        k_dev=8, samples=16,
+    )
+
+
+def _tiny_chunk():
+    from corrosion_tpu.ops.chunks import ChunkConfig
+
+    cfg = ChunkConfig(
+        n_nodes=16, n_streams=2, chunk_len=64, fanout=3, sync_interval=4,
+        gap_requests=4,
+    )
+    return cfg, [0, 5], [511, 255], 8
+
+
+def _tiny_mixed():
+    from corrosion_tpu.models.baselines import mixed_storm
+
+    return mixed_storm(
+        n=64, streams=2, last_seq=255, rounds=8, samples=8, n_cells=0
+    )
+
+
+def _mesh_for(d: int):
+    from corrosion_tpu.sim import benchlib
+
+    if d <= 1:
+        return None
+    if len(jax.devices()) < d:
+        raise ValueError(
+            f"cost model at device_count={d} needs {d} devices, have "
+            f"{len(jax.devices())} — set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={d}"
+        )
+    return benchlib.multichip_mesh(d)
+
+
+def _bcast_for(mesh):
+    from corrosion_tpu import parallel
+
+    return None if mesh is None else parallel.make_sharded_broadcast(mesh)
+
+
+def _lower_dense(variant: str, mesh) -> tuple[object, int, str]:
+    from corrosion_tpu import parallel
+    from corrosion_tpu.parallel import mesh as mesh_mod
+    from corrosion_tpu.sim import benchlib, engine
+
+    cfg, topo, sched = _tiny_dense()
+    n_regions = int(np.asarray(topo.region).max()) + 1
+    state = engine.init_cluster(cfg, len(sched.sample_writer))
+    if mesh is not None:
+        state = mesh_mod.shard_cluster_state(state, mesh)
+        topo = parallel.replicate(topo, mesh)
+    writes = jnp.asarray(sched.writes, dtype=jnp.uint32)
+    kill = revive = jnp.zeros((sched.rounds, 1), dtype=bool)
+    part = jnp.zeros((sched.rounds, n_regions, n_regions), dtype=bool)
+    xs = (
+        writes, part, kill, revive,
+        jnp.arange(sched.rounds, dtype=jnp.int32), None, None, None,
+    )
+    fn = (
+        engine._scan_rounds if variant == "plain"
+        else engine._scan_rounds_donated
+    )
+    lowered = fn.lower(
+        state, topo, xs, jnp.asarray(sched.sample_writer),
+        jnp.asarray(sched.sample_ver), jnp.asarray(sched.sample_round),
+        jax.random.PRNGKey(0), cfg, False, bcast_fn=_bcast_for(mesh),
+    )
+    return lowered, sched.rounds, benchlib.config_fingerprint(
+        cfg, sched.rounds, len(sched.sample_writer)
+    )
+
+
+def _lower_sparse(variant: str, mesh) -> tuple[object, int, str]:
+    from corrosion_tpu import parallel
+    from corrosion_tpu.ops import sparse_writers as sw_ops
+    from corrosion_tpu.ops import swim as swim_ops
+    from corrosion_tpu.parallel import mesh as mesh_mod
+    from corrosion_tpu.sim import benchlib, sparse_engine
+
+    cfg, topo, sched = _tiny_sparse()
+    sp = cfg.sparse
+    n = cfg.n_nodes
+    n_regions = int(np.asarray(topo.region).max()) + 1
+    # The driver rebinds the writer arrays from the planner each epoch
+    # (simulate_sparse); lowering only needs their shapes/dtypes.
+    topo = topo._replace(
+        writer_nodes=jnp.zeros(cfg.w_hot, jnp.int32),
+        writer_of_node=jnp.full(n, -1, jnp.int32),
+        writer_ids=jnp.zeros(cfg.w_hot, jnp.uint32),
+    )
+    sstate = sw_ops.init_sparse(cfg.gossip, sp)
+    swim_state = swim_ops.impl(cfg.swim).init_state(cfg.swim)
+    n_samples = len(sched.sample_writer)
+    vis = jnp.full((n_samples, n), -1, jnp.int32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        node = mesh_mod._node_axis(mesh, None)
+        sstate = mesh_mod.shard_sparse_state(sstate, mesh)
+        swim_state = mesh_mod.shard_node_major(swim_state, mesh, node)
+        vis = jax.device_put(vis, NamedSharding(mesh, P(None, node)))
+        topo = parallel.replicate(topo, mesh)
+    el = sp.epoch_rounds
+    writes_slots = jnp.zeros((el, cfg.w_hot), jnp.uint32)
+    kill = revive = jnp.zeros((el, 1), bool)
+    part = jnp.zeros((el, n_regions, n_regions), bool)
+    s_slot = jnp.zeros((n_samples,), jnp.int32)
+    ridx = jnp.arange(el, dtype=jnp.int32)
+    fn = (
+        sparse_engine._epoch_scan if variant == "plain"
+        else sparse_engine._epoch_scan_donated
+    )
+    lowered = fn.lower(
+        sstate, swim_state, vis, topo,
+        (writes_slots, kill, revive, ridx, None, None), part,
+        s_slot, jnp.asarray(sched.sample_ver),
+        jnp.asarray(sched.sample_round), jax.random.PRNGKey(0),
+        cfg, sp, False, bcast_fn=_bcast_for(mesh),
+    )
+    return lowered, el, benchlib.config_fingerprint(cfg, el, n_samples)
+
+
+def _lower_chunk(variant: str, mesh) -> tuple[object, int, str]:
+    from corrosion_tpu.ops import chunks as chunk_ops
+    from corrosion_tpu.parallel import mesh as mesh_mod
+    from corrosion_tpu.sim import benchlib, chunk_engine
+
+    cfg, origin, last_seq, rounds = _tiny_chunk()
+    origin = jnp.asarray(origin, jnp.int32)
+    last_seq = jnp.asarray(last_seq, jnp.int32)
+    state = chunk_ops.init_chunks(cfg, origin, last_seq)
+    vis = jnp.full((cfg.n_nodes, cfg.n_streams), -1, jnp.int32)
+    alive = jnp.ones((cfg.n_nodes,), bool)
+    if mesh is not None:
+        # The chunk plane is GSPMD-placed (no broadcast queue exchange
+        # to stage explicitly) — same path as simulate_chunks_sharded.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        node = mesh_mod._node_axis(mesh, None)
+        state = mesh_mod.shard_chunk_state(state, mesh)
+        vis = jax.device_put(vis, NamedSharding(mesh, P(node, None)))
+        last_seq = jax.device_put(last_seq, NamedSharding(mesh, P()))
+    xs = (jnp.arange(rounds, dtype=jnp.int32), None, None, None)
+    fn = (
+        chunk_engine._scan if variant == "plain"
+        else chunk_engine._scan_donated
+    )
+    lowered = fn.lower(
+        state, vis, last_seq, alive, jax.random.PRNGKey(1), xs, cfg
+    )
+    return lowered, rounds, benchlib.config_fingerprint(cfg, rounds)
+
+
+def _lower_mixed(variant: str, mesh) -> tuple[object, int, str]:
+    from corrosion_tpu import parallel
+    from corrosion_tpu.parallel import mesh as mesh_mod
+    from corrosion_tpu.sim import benchlib, mixed_engine
+
+    cfg, ccfg, topo, sched, spec = _tiny_mixed()
+    state = mixed_engine.init_mixed_state(cfg, ccfg, topo, sched, spec)
+    if mesh is not None:
+        state = mesh_mod.shard_mixed_state(state, mesh)
+        topo = parallel.replicate(topo, mesh)
+    rounds = sched.rounds
+    n_regions = topo.region_rtt.shape[0]
+    writes = jnp.asarray(sched.writes, jnp.uint32)
+    commit = np.zeros((rounds, len(spec.writer)), bool)
+    for s, r in enumerate(spec.commit_round):
+        if 0 <= r < rounds:
+            commit[r, s] = True
+    kill = revive = jnp.zeros((rounds, 1), dtype=bool)
+    part = jnp.zeros((rounds, n_regions, n_regions), dtype=bool)
+    xs = (
+        writes, jnp.asarray(commit), part, kill, revive,
+        jnp.arange(rounds, dtype=jnp.int32), None, None, None,
+    )
+    fn = (
+        mixed_engine._scan_mixed if variant == "plain"
+        else mixed_engine._scan_mixed_donated
+    )
+    lowered = fn.lower(
+        state, topo, xs, jnp.asarray(spec.writer, jnp.int32),
+        jnp.asarray(spec.version, jnp.uint32),
+        jnp.asarray(spec.last_seq, jnp.int32),
+        jnp.asarray(sched.sample_writer), jnp.asarray(sched.sample_ver),
+        jnp.asarray(sched.sample_round), jax.random.PRNGKey(0),
+        cfg, ccfg, False, bcast_fn=_bcast_for(mesh),
+    )
+    return lowered, rounds, benchlib.config_fingerprint(
+        cfg, ccfg, rounds, len(sched.sample_writer)
+    )
+
+
+_LOWERERS = {
+    "dense": _lower_dense,
+    "sparse": _lower_sparse,
+    "chunk": _lower_chunk,
+    "mixed": _lower_mixed,
+}
+
+_ENTRY_NAMES = {
+    "dense": "_scan_rounds",
+    "sparse": "_epoch_scan",
+    "chunk": "_scan",
+    "mixed": "_scan_mixed",
+}
+
+
+def entry_key(engine: str, variant: str, device_count: int) -> str:
+    return f"{engine}/{variant}/d{device_count}"
+
+
+def cost_entry(engine: str, variant: str, device_count: int = 1) -> dict:
+    """AOT-lower one engine's scan entry and extract its cost entry."""
+    mesh = _mesh_for(device_count)
+    lowered, rounds, fingerprint = _LOWERERS[engine](variant, mesh)
+    name = _ENTRY_NAMES[engine] + ("_donated" if variant == "donated" else "")
+    return extract_entry(
+        lowered, rounds,
+        engine=engine, entry=name, variant=variant,
+        device_count=device_count, config_fingerprint=fingerprint,
+    )
+
+
+def build_cost_model(
+    engines=ENGINES,
+    variants=VARIANTS,
+    device_counts=(1,),
+    progress=None,
+) -> dict:
+    """The ``corro-cost-model/1`` artifact: one cost entry per
+    engine × variant × device count, plus self-describing provenance.
+
+    Sharded entries (device_count > 1) report PER-DEVICE numbers —
+    that is what ``cost_analysis`` measures for an SPMD executable, and
+    it is the per-chip roofline the capacity questions need.
+    """
+    from corrosion_tpu.ops import onehot
+
+    entries: dict[str, dict] = {}
+    for d in sorted(device_counts):
+        for eng in engines:
+            for var in variants:
+                key = entry_key(eng, var, d)
+                if progress is not None:
+                    progress.write(f"[cost] lowering {key}\n")
+                    progress.flush()
+                entries[key] = cost_entry(eng, var, device_count=d)
+    return {
+        "schema": COST_SCHEMA,
+        "platform": jax.devices()[0].platform,
+        "device_count": len(jax.devices()),
+        "backend": onehot.resolve_backend(None),
+        "jax_version": jax.__version__,
+        # The diff gate's relative-increase ceiling, committed with the
+        # baseline so a hand-edited file never gates tighter than the
+        # documented workflow (same rule as bench_budget.json).
+        "tolerance": DEFAULT_COST_TOLERANCE,
+        "engines": list(engines),
+        "variants": list(variants),
+        "device_counts": sorted(device_counts),
+        "entries": entries,
+    }
+
+
+def save_model(model: dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(model, f, indent=2)
+        f.write("\n")
+
+
+def load_model(path: str) -> dict:
+    with open(path) as f:
+        model = json.load(f)
+    if model.get("schema") != COST_SCHEMA:
+        raise ValueError(
+            f"{path}: schema {model.get('schema')!r} is not {COST_SCHEMA}"
+        )
+    return model
+
+
+#: Metrics the baseline diff gates on (increase beyond tolerance fails).
+GATED_METRICS = ("flops", "bytes_accessed", "peak_bytes", "temp_bytes")
+#: Default relative-increase tolerance: the gate catches structural
+#: regressions (a lost donation alias, a dense fallback, a widened
+#: dtype — tens of percent to multi-×), not XLA-version scheduling
+#: noise.
+DEFAULT_COST_TOLERANCE = 0.25
+
+
+def diff_cost_models(
+    base: dict, cand: dict, tolerance: float | None = None
+) -> tuple[bool, list[str], list[str]]:
+    """Gate a freshly built model against the committed baseline.
+
+    Returns ``(ok, breaches, notes)``. Breaches: cross-platform /
+    cross-backend comparison (refused outright, the house provenance
+    rule), entries missing from the candidate, config-fingerprint
+    drift (the tiny shapes changed without a baseline refresh), and
+    any gated metric increasing beyond ``tolerance`` (relative).
+    Decreases are reported as notes — improvements land with a
+    baseline refresh, they do not fail the gate.
+    """
+    tol = (
+        float(base.get("tolerance", DEFAULT_COST_TOLERANCE))
+        if tolerance is None else tolerance
+    )
+    breaches: list[str] = []
+    notes: list[str] = []
+    for dim in ("platform", "backend"):
+        if base.get(dim) != cand.get(dim):
+            breaches.append(
+                f"{dim}: baseline {base.get(dim)!r} vs measured "
+                f"{cand.get(dim)!r} — cost baselines do not compare "
+                f"across {dim}s; rerun `obs cost show --out "
+                f"COST_BASELINE.json` on the target {dim}"
+            )
+    if base.get("jax_version") != cand.get("jax_version"):
+        notes.append(
+            f"jax_version drift: baseline {base.get('jax_version')} vs "
+            f"{cand.get('jax_version')} (tolerance absorbs codegen "
+            f"movement; refresh the baseline on toolchain bumps)"
+        )
+    for key, b in base.get("entries", {}).items():
+        c = cand.get("entries", {}).get(key)
+        if c is None:
+            breaches.append(f"{key}: missing from measurement")
+            continue
+        if b.get("config_fingerprint") != c.get("config_fingerprint"):
+            breaches.append(
+                f"{key}: config fingerprint "
+                f"{c.get('config_fingerprint')} != baseline "
+                f"{b.get('config_fingerprint')} — the fixed tiny shapes "
+                f"changed; refresh COST_BASELINE.json with the change"
+            )
+            continue
+        for m in GATED_METRICS:
+            bv, cv = float(b.get(m, 0.0)), float(c.get(m, 0.0))
+            if bv <= 0:
+                continue
+            rel = (cv - bv) / bv
+            if rel > tol:
+                breaches.append(
+                    f"{key}.{m}: {cv:.0f} > baseline {bv:.0f} "
+                    f"(+{rel:.0%}, tolerance {tol:.0%})"
+                )
+            elif rel < -tol:
+                notes.append(
+                    f"{key}.{m}: {cv:.0f} improved {rel:.0%} vs baseline "
+                    f"— refresh COST_BASELINE.json to lock it in"
+                )
+    for key in cand.get("entries", {}):
+        if key not in base.get("entries", {}):
+            notes.append(f"{key}: new entry (not in baseline)")
+    return not breaches, breaches, notes
+
+
+# ---------------------------------------------------------------------------
+# Roofline stage costs: the cumulative-prefix composite, in flops/bytes.
+
+
+def roofline_stage_costs(composite, stages, carry0) -> dict:
+    """Per-stage flops/bytes by lowering the SAME cumulative prefixes
+    the timing attribution scans (``telemetry.attribute_planes``): a
+    stage's cost is the increment of the single-round composite with it
+    enabled. Increments telescope exactly like the wall-clock ones, so
+    the flop/byte partition matches the millisecond partition stage for
+    stage. Returns ``{stage: {flops, bytes}}`` (clamped at 0 — XLA may
+    fuse a later stage into earlier work).
+
+    Deliberately compiles its OWN single-step prefixes rather than
+    reusing ``attribute_planes``'s scan-wrapped executables: a scan's
+    ``cost_analysis`` counts the while-loop body once regardless of
+    trip count and folds in scan plumbing, so it is not the per-round
+    number — the extra N+1 single-step compiles (cheap relative to the
+    scan compiles the timing pass already pays) buy an honest unit."""
+    cum = []
+    for k in range(len(stages) + 1):
+        step = composite(tuple(stages[:k]))
+        compiled = jax.jit(step).lower(carry0, jnp.int32(0)).compile()
+        cum.append(_cost_dict(compiled))
+    out = {}
+    for k, s in enumerate(stages):
+        out[s] = {
+            "flops": max(cum[k + 1]["flops"] - cum[k]["flops"], 0.0),
+            "bytes": max(
+                cum[k + 1]["bytes_accessed"] - cum[k]["bytes_accessed"],
+                0.0,
+            ),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Live per-device memory watermarks + the reconcile-or-fail check.
+
+
+def live_device_bytes() -> dict:
+    """Live committed-buffer bytes per device, from the runtime's own
+    array registry (``jax.live_arrays``) — works on backends with no
+    allocator stats (CPU). Device allocator stats
+    (``device.memory_stats``) ride alongside where the platform
+    provides them (TPU ``bytes_in_use``/``peak_bytes_in_use``)."""
+    out: dict = {}
+    for arr in jax.live_arrays():
+        try:
+            shards = arr.addressable_shards
+        except Exception:
+            continue
+        for s in shards:
+            n = int(np.prod(s.data.shape or (1,))) * s.data.dtype.itemsize
+            out[s.device] = out.get(s.device, 0) + n
+    return out
+
+
+class MemoryWatermarks:
+    """Per-device live-byte high-water marks, sampled at chunk/epoch
+    boundaries by ``KernelTelemetry`` (``watermarks=`` field)."""
+
+    def __init__(self):
+        self.peak: dict = {}
+        self.allocator_peak: dict = {}
+        self.samples = 0
+
+    def sample(self) -> dict:
+        live = live_device_bytes()
+        for dev, n in live.items():
+            if n > self.peak.get(dev, 0):
+                self.peak[dev] = n
+        for dev in jax.devices():
+            stats = dev.memory_stats() or {}
+            pk = stats.get("peak_bytes_in_use")
+            if pk is not None and pk > self.allocator_peak.get(dev, 0):
+                self.allocator_peak[dev] = pk
+        self.samples += 1
+        return live
+
+    def to_dict(self) -> dict:
+        return {
+            "samples": self.samples,
+            "peak_bytes": {
+                str(dev): n for dev, n in sorted(
+                    self.peak.items(), key=lambda kv: str(kv[0])
+                )
+            },
+            "allocator_peak_bytes": {
+                str(dev): n for dev, n in sorted(
+                    self.allocator_peak.items(), key=lambda kv: str(kv[0])
+                )
+            },
+        }
+
+
+def reconcile_memory(
+    final_state,
+    watermarks: MemoryWatermarks | None = None,
+    predicted_per_device: int | None = None,
+    cost: dict | None = None,
+    tol: float = 0.01,
+) -> dict:
+    """Reconcile the three views of per-device state memory; breaks
+    raise ValueError (the house reconcile-or-fail rule), agreement
+    returns the joined report.
+
+    1. **measured vs predicted**: ``parallel.per_device_state_bytes``
+       (live addressable shards) must equal the static spec-arithmetic
+       prediction per device within ``tol`` — placement drift between
+       the shard helpers and the capacity model is a break, not skew.
+    2. **watermark covers state**: every device's live high-water mark
+       must be at least its measured state bytes (the state was live
+       when sampled; a smaller watermark means the sampler missed
+       devices or the run freed state it still reports).
+    3. **memory_analysis covers state**: when a cost entry for the
+       entry point is supplied, its per-device ``output_bytes`` must
+       cover the per-device state (the scan's output carries the state
+       plus the stacked curves — a prediction below the state means
+       the lowered entry and the run disagree about shapes).
+    """
+    from corrosion_tpu import parallel
+
+    measured = parallel.per_device_state_bytes(final_state)
+    if not measured:
+        raise ValueError(
+            "reconcile_memory: state has no addressable shards — was the "
+            "final state deleted (donated) before reconciling?"
+        )
+    problems: list[str] = []
+    per_dev = sorted(measured.values())
+    if predicted_per_device is not None:
+        for dev, got in sorted(measured.items(), key=lambda kv: str(kv[0])):
+            if abs(got - predicted_per_device) > tol * max(
+                predicted_per_device, 1
+            ):
+                problems.append(
+                    f"{dev}: measured state {got} B != predicted "
+                    f"{predicted_per_device} B (tol {tol:.0%})"
+                )
+    if watermarks is not None:
+        if not watermarks.samples:
+            problems.append("watermarks were never sampled")
+        for dev, got in measured.items():
+            wm = watermarks.peak.get(dev, 0)
+            if wm + 1 < got:  # +1: exact integer domain, no fuzz needed
+                problems.append(
+                    f"{dev}: live watermark {wm} B below the device's own "
+                    f"state bytes {got} B — the sampler missed this device"
+                )
+    if cost is not None:
+        out_b = int(cost.get("output_bytes", 0))
+        if out_b and out_b + 1 < max(per_dev):
+            problems.append(
+                f"memory_analysis output_bytes {out_b} B does not cover "
+                f"the per-device state {max(per_dev)} B — the lowered "
+                f"entry and the run disagree about state shapes"
+            )
+    if problems:
+        raise ValueError(
+            "per-device memory reconciliation failed:\n  "
+            + "\n  ".join(problems)
+        )
+    return {
+        "devices": len(measured),
+        "state_bytes_per_device_max": max(per_dev),
+        "state_bytes_per_device_min": min(per_dev),
+        "predicted_per_device": predicted_per_device,
+        "watermarks": None if watermarks is None else watermarks.to_dict(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Capacity curve: nodes -> predicted per-device bytes, validated.
+
+
+def flagship_cfg(n_nodes: int, samples: int = 16):
+    """The flagship sharded config family (``benchlib._measure_large``'s
+    exact shape): wan_100k at 8 regions, queue depth 16, writer count
+    ``min(128, n/4)`` — the configuration the 100,352-node measured
+    point ran, and the one the 500k–800k ROADMAP run will use."""
+    from dataclasses import replace as dc_replace
+
+    from corrosion_tpu import models
+
+    n_writers = min(128, n_nodes // 4)
+    cfg, topo, sched = models.wan_100k(
+        n=n_nodes, n_regions=8, n_writers=n_writers, rounds=16,
+        samples=samples, partition=False,
+    )
+    cfg = dc_replace(cfg, gossip=dc_replace(cfg.gossip, queue=16))
+    return cfg, topo, sched
+
+
+def predicted_state_bytes(cfg, n_samples: int, mesh) -> int:
+    """Per-device state bytes for a dense ClusterState under the
+    standard placement — pure ``eval_shape`` + spec arithmetic, no
+    allocation (a 1M-node prediction costs microseconds)."""
+    from corrosion_tpu.parallel import mesh as mesh_mod
+    from corrosion_tpu.sim import engine
+
+    shapes = jax.eval_shape(lambda: engine.init_cluster(cfg, n_samples))
+    specs = mesh_mod.cluster_state_specs(shapes, mesh)
+    return mesh_mod.predicted_per_device_bytes(shapes, specs, mesh)
+
+
+#: The capacity curve's default node grid: the measured 100k anchor and
+#: its multiples through the ROADMAP 500k-800k window to 1M, every
+#: count divisible by 8 regions x 8 devices.
+CAPACITY_NODE_GRID = (100_352, 250_880, 401_408, 501_760, 802_816, 1_003_520)
+
+
+def capacity_model(
+    node_counts=CAPACITY_NODE_GRID,
+    device_count: int = 8,
+    validate_live: bool = True,
+    hbm_bytes: int = HBM_BYTES_V5E,
+    tol: float = 0.05,
+) -> dict:
+    """The ``corro-capacity/1`` artifact: predicted per-device state
+    bytes over ``node_counts`` for the flagship config on the standing
+    (dcn, ici) mesh, validated against measured points, with a
+    fits/exceeds verdict per count against the HBM budget.
+
+    Validation (reconcile-or-fail — a failed point raises, the artifact
+    is never emitted from a model that contradicts its measurements):
+
+    - the **512-node lane point**, re-measured live on this host's mesh
+      (the multichip lane's merge_10k shape): prediction must equal the
+      measured ``per_device_state_bytes`` exactly;
+    - the **100,352-node recorded point** (multichip ``--large``):
+      prediction within ``tol``.
+    """
+    from corrosion_tpu.sim import benchlib
+
+    mesh = _mesh_for(device_count)
+    if mesh is None:
+        raise ValueError("capacity_model needs device_count > 1")
+
+    validation: dict = {}
+    if validate_live:
+        from corrosion_tpu import models, parallel
+        from corrosion_tpu.parallel import mesh as mesh_mod
+        from corrosion_tpu.sim import engine
+
+        cfg512, _topo, sched512 = models.merge_10k(
+            n=benchlib.MULTICHIP_NODES, rounds=8, samples=64
+        )
+        st = mesh_mod.shard_cluster_state(
+            engine.init_cluster(cfg512, len(sched512.sample_writer)), mesh
+        )
+        measured512 = max(parallel.per_device_state_bytes(st).values())
+        predicted512 = predicted_state_bytes(
+            cfg512, len(sched512.sample_writer), mesh
+        )
+        if measured512 != predicted512:
+            raise ValueError(
+                f"capacity validation failed at the 512-node lane point: "
+                f"predicted {predicted512} B != measured {measured512} B "
+                f"per device — the placement specs and the shard helpers "
+                f"have drifted"
+            )
+        validation["lane_512"] = {
+            "nodes": benchlib.MULTICHIP_NODES,
+            "predicted_bytes": predicted512,
+            "measured_bytes": measured512,
+            "exact": True,
+        }
+
+    cfg100k, _, sched100k = flagship_cfg(MEASURED_100K["nodes"])
+    pred100k = predicted_state_bytes(
+        cfg100k, len(sched100k.sample_writer), mesh
+    )
+    rec = MEASURED_100K["per_device_bytes"]
+    rel = abs(pred100k - rec) / rec
+    if rel > tol:
+        raise ValueError(
+            f"capacity validation failed at the recorded 100k point: "
+            f"predicted {pred100k / 2**20:.1f} MiB vs measured "
+            f"{rec / 2**20:.1f} MiB ({rel:.1%} > {tol:.0%}) — "
+            f"{MEASURED_100K['source']}"
+        )
+    validation["large_100k"] = {
+        **{k: v for k, v in MEASURED_100K.items()},
+        "predicted_bytes": pred100k,
+        "relative_error": round(rel, 4),
+    }
+
+    budget = int(hbm_bytes * (1 - CAPACITY_HEADROOM))
+    curve = []
+    for n in sorted(node_counts):
+        cfg, _, sched = flagship_cfg(n)
+        per_dev = predicted_state_bytes(cfg, len(sched.sample_writer), mesh)
+        curve.append({
+            "nodes": n,
+            "per_device_bytes": per_dev,
+            "per_device_mib": round(per_dev / 2**20, 1),
+            "hbm_fraction": round(per_dev / hbm_bytes, 4),
+            "verdict": (
+                "fits" if per_dev <= budget
+                else "tight" if per_dev <= hbm_bytes
+                else "exceeds"
+            ),
+        })
+    model = {
+        "schema": CAPACITY_SCHEMA,
+        "platform": jax.devices()[0].platform,
+        "device_count": device_count,
+        "mesh": {
+            a: int(mesh.shape[a]) for a in mesh.axis_names
+        },
+        "engine": "dense",
+        "config_family": "wan_100k(n_regions=8, queue=16, "
+                         "n_writers=min(128, n/4))",
+        "hbm_bytes": hbm_bytes,
+        "hbm_headroom_fraction": CAPACITY_HEADROOM,
+        "validation": validation,
+        "curve": curve,
+    }
+    if len(curve) > 1:
+        model["state_bytes_per_node"] = round(bytes_per_node(model), 1)
+    return model
+
+
+def bytes_per_node(model: dict) -> float:
+    """Marginal per-device bytes per node from the capacity curve's
+    endpoints (the replicated floor cancels)."""
+    c = model["curve"]
+    lo, hi = c[0], c[-1]
+    d = math.prod(model["mesh"].values())
+    return (
+        (hi["per_device_bytes"] - lo["per_device_bytes"])
+        / (hi["nodes"] - lo["nodes"])
+        * d
+    )

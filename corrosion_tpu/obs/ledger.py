@@ -1,0 +1,336 @@
+"""Runtime compile ledger: every XLA compilation becomes a recorded,
+attributable, gateable event.
+
+The repo already catches steady-state retraces OFFLINE — ``corrosion
+lint --sanitize`` runs tiny engine instances and checks every jitted
+function's compile-cache count (analysis/sanitize.py CT030-32) — but a
+retrace on a REAL run is invisible until it shows up as wall time (the
+r04→r05 10.6× step mystery was exactly this class: nothing in the run
+itself said "you are recompiling"). This module closes that gap:
+
+- **One registry of watched jitted functions.**
+  :func:`jitted_functions` is the single discovery of a module's
+  compiled entry points (anything exposing jax's ``_cache_size``);
+  the sanitize pass, the runtime ledger, and the perf-plane
+  cache-count pins all call it, so the three watchers can never drift
+  onto different function sets.
+- **A ledger of compilation events.** :class:`CompileLedger` registers
+  one ``jax.monitoring`` listener (``backend_compile`` durations) and
+  snapshots watched cache sizes around :meth:`CompileLedger.window`
+  scopes, producing per-window records — which functions gained cache
+  entries, how many backend compiles fired, and the summed compile
+  wall-ms — that flow into the flight recorder (``kind: "compile"``)
+  and the metrics registry (``corro_kernel_compiles_total`` /
+  ``corro_kernel_compile_ms``).
+- **A live retrace tripwire.** :meth:`CompileLedger.arm` declares
+  "everything is compiled now": any further backend compile (or watched
+  cache growth at a window boundary) raises :class:`RetraceError`
+  naming the window, instead of silently eating wall time. bench.py and
+  scripts/bench_smoke.py arm it around their timed runs, so a
+  steady-state recompile aborts the bench rather than skewing it — and
+  CI gates ``steady_compiles == 0`` through
+  ``telemetry.check_bench_invariants``.
+
+Honesty note on attribution: jax's monitoring events carry durations
+but not function identities, so a window with several compiles reports
+their SUMMED wall against the set of watched functions that grew. The
+window label (engine + start round) is the shape-signature seam — the
+caller names what was being dispatched; the ledger does not invent a
+signature it cannot observe.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+# The monitoring event that brackets an actual XLA backend compile.
+# Trace/lowering events are deliberately excluded: a cache HIT still
+# traces, and counting it would cry wolf on every warm chunk.
+_COMPILE_EVENTS = ("/jax/core/compile/backend_compile_duration",)
+
+#: Engine name -> module path, the watch set the engine drivers and the
+#: sanitize pass share (analysis/sanitize.py imports its runners from
+#: the same names).
+ENGINE_MODULES = {
+    "dense": "corrosion_tpu.sim.engine",
+    "sparse": "corrosion_tpu.sim.sparse_engine",
+    "chunk": "corrosion_tpu.sim.chunk_engine",
+    "mixed": "corrosion_tpu.sim.mixed_engine",
+}
+
+
+class RetraceError(RuntimeError):
+    """A compilation fired while the ledger was armed steady-state."""
+
+
+def jitted_functions(module) -> dict[str, object]:
+    """Every watched jitted function of ``module``, by name.
+
+    THE one registry discovery shared by the runtime ledger, the
+    sanitize retrace tripwire (CT030-32), and the perf-plane
+    cache-count pins — one implementation, so the offline and live
+    watchers can never watch different sets. Detection is jax's
+    ``_cache_size`` attribute (present on every ``jax.jit`` product,
+    donated twins included)."""
+    return {
+        name: obj
+        for name in dir(module)
+        if callable(obj := getattr(module, name, None))
+        and hasattr(obj, "_cache_size")
+    }
+
+
+def cache_sizes(fns: dict[str, object]) -> dict[str, int]:
+    """Current compile-cache entry count per watched function."""
+    return {name: fn._cache_size() for name, fn in fns.items()}
+
+
+# ---------------------------------------------------------------------------
+# One process-wide monitoring listener fanning out to active ledgers.
+# jax.monitoring has no per-listener unregister (clear_event_listeners
+# nukes everyone's), so registration is once-per-process and activation
+# is membership in _ACTIVE.
+
+_LISTENER_LOCK = threading.Lock()
+_ACTIVE: list["CompileLedger"] = []
+_INSTALLED = False
+
+
+def _listener(name: str, secs: float, **kw) -> None:
+    if name not in _COMPILE_EVENTS:
+        return
+    for led in list(_ACTIVE):
+        led._on_compile(secs)
+
+
+def _ensure_listener() -> None:
+    global _INSTALLED
+    with _LISTENER_LOCK:
+        if not _INSTALLED:
+            jax.monitoring.register_event_duration_secs_listener(_listener)
+            _INSTALLED = True
+
+
+@dataclass
+class CompileWindow:
+    """One observed dispatch scope: which watched functions compiled,
+    how many backend compiles fired, their summed wall. ``nested``
+    windows are inert placeholders: their events were attributed to the
+    enclosing window, so they report nothing and are never published.
+    ``published`` marks windows a live sink (KernelTelemetry.run_chunk)
+    already folded into a registry, so :meth:`CompileLedger.publish`
+    cannot double-count them."""
+
+    label: str
+    compiles: int = 0
+    compile_ms: float = 0.0
+    fns: dict = field(default_factory=dict)  # fn name -> new cache entries
+    wall_ms: float = 0.0
+    nested: bool = False
+    published: bool = False
+
+    def to_record(self) -> dict:
+        """Flight-recorder line (``kind: "compile"``)."""
+        return {
+            "kind": "compile",
+            "label": self.label,
+            "compiles": self.compiles,
+            "compile_ms": round(self.compile_ms, 3),
+            "fns": dict(self.fns),
+        }
+
+
+class CompileLedger:
+    """Records every compilation event and arms the retrace tripwire.
+
+    Usage (the engine-driver integration rides
+    ``telemetry.KernelTelemetry(ledger=...)``, which opens a window per
+    chunk)::
+
+        led = CompileLedger()
+        led.watch_engines(("dense",))
+        with led:                       # activates the monitoring tap
+            with led.window("first_run") as w:
+                run_once()              # compiles here are expected
+            compile_ms = w.compile_ms
+            led.arm("timed run")        # steady state: compiling = bug
+            run_again()                 # RetraceError on any compile
+            led.disarm()
+    """
+
+    def __init__(self):
+        self.watched: dict[str, object] = {}
+        self.windows: list[CompileWindow] = []
+        self.total_compiles = 0
+        self.total_compile_ms = 0.0
+        self.armed_compiles = 0
+        self._armed: str | None = None
+        self._current: CompileWindow | None = None
+        self._active = False
+
+    # -- watch set ---------------------------------------------------------
+
+    def watch(self, module) -> "CompileLedger":
+        """Merge a module's jitted functions into the watch set."""
+        self.watched.update(jitted_functions(module))
+        return self
+
+    def watch_engines(self, engines=tuple(ENGINE_MODULES)) -> "CompileLedger":
+        import importlib
+
+        for name in engines:
+            self.watch(importlib.import_module(ENGINE_MODULES[name]))
+        return self
+
+    # -- activation --------------------------------------------------------
+
+    def install(self) -> "CompileLedger":
+        _ensure_listener()
+        with _LISTENER_LOCK:
+            if self not in _ACTIVE:
+                _ACTIVE.append(self)
+        self._active = True
+        return self
+
+    def uninstall(self) -> None:
+        with _LISTENER_LOCK:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
+        self._active = False
+
+    def __enter__(self) -> "CompileLedger":
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- the tap -----------------------------------------------------------
+
+    def _on_compile(self, secs: float) -> None:
+        ms = secs * 1000.0
+        self.total_compiles += 1
+        self.total_compile_ms += ms
+        win = self._current
+        if win is not None:
+            win.compiles += 1
+            win.compile_ms += ms
+        if self._armed is not None:
+            self.armed_compiles += 1
+            where = f" in window {win.label!r}" if win is not None else ""
+            raise RetraceError(
+                f"steady-state recompile ({ms:.1f} ms){where}: the ledger "
+                f"was armed ({self._armed}) — a host value is leaking into "
+                f"a trace, or the warm-up did not cover this shape "
+                f"(docs/PERFORMANCE.md 'Compile ledger')"
+            )
+
+    # -- windows -----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def window(self, label: str):
+        """Scope one dispatch; yields the :class:`CompileWindow` being
+        filled (read it after the ``with`` exits). Windows do not
+        sub-attribute: a window opened inside another (a telemetry
+        chunk inside a caller's first-run scope) attributes its events
+        to the OUTER window and yields an inert ``nested`` placeholder
+        — so a per-chunk sink reading its own window can never re-count
+        the enclosing scope's cumulative totals."""
+        if self._current is not None:
+            yield CompileWindow(label=label, nested=True)
+            return
+        before = cache_sizes(self.watched)
+        win = CompileWindow(label=label)
+        self._current = win
+        t0 = time.perf_counter()
+        try:
+            yield win
+        finally:
+            win.wall_ms = (time.perf_counter() - t0) * 1000.0
+            self._current = None
+            after = cache_sizes(self.watched)
+            win.fns = {
+                name: after[name] - before.get(name, 0)
+                for name in after
+                if after[name] > before.get(name, 0)
+            }
+            self.windows.append(win)
+        # Persistent-compilation-cache hits skip backend_compile but
+        # still retrace + add a cache entry — cache growth under arms is
+        # a violation even when the monitoring tap saw nothing.
+        if self._armed is not None and win.fns and not win.compiles:
+            self.armed_compiles += 1
+            raise RetraceError(
+                f"steady-state retrace in window {win.label!r}: watched "
+                f"functions gained cache entries {win.fns} while the "
+                f"ledger was armed ({self._armed})"
+            )
+
+    # -- tripwire ----------------------------------------------------------
+
+    def arm(self, reason: str = "steady state") -> None:
+        """Declare warm-up over: any further compile raises
+        :class:`RetraceError` (the live analogue of sanitize CT030)."""
+        if not self._active:
+            self.install()
+        self._armed = reason
+
+    def disarm(self) -> None:
+        self._armed = None
+
+    @property
+    def armed(self) -> bool:
+        return self._armed is not None
+
+    # -- outputs -----------------------------------------------------------
+
+    def publish_window(self, registry, win: CompileWindow,
+                       engine: str = "dense") -> None:
+        """Fold ONE window into a MetricsRegistry and mark it
+        published — the single emit implementation shared by the live
+        per-chunk sink (``KernelTelemetry.run_chunk``) and the run-end
+        :meth:`publish`, so a window can never be counted twice and
+        both paths use one label scheme:
+        ``corro_kernel_compiles_total{engine,fn}`` (an
+        ``fn="(unwatched)"`` bucket carries backend compiles no watched
+        function accounts for) and
+        ``corro_kernel_compile_ms{engine}``."""
+        if win.nested or win.published:
+            return
+        win.published = True
+        per_fn = dict(win.fns)
+        accounted = sum(per_fn.values())
+        if win.compiles > accounted:
+            per_fn["(unwatched)"] = win.compiles - accounted
+        if per_fn:
+            c = registry.counter(
+                "corro_kernel_compiles_total",
+                "kernel plane: XLA compilation events (compile ledger)",
+            )
+            for name, cnt in per_fn.items():
+                c.inc(float(cnt), engine=engine, fn=name)
+        if win.compile_ms:
+            registry.counter(
+                "corro_kernel_compile_ms",
+                "kernel plane: summed XLA backend-compile wall (ms)",
+            ).inc(win.compile_ms, engine=engine)
+
+    def publish(self, registry, engine: str = "dense") -> None:
+        """Fold every not-yet-published window into the registry
+        (windows a live KernelTelemetry sink already emitted are
+        skipped — idempotent against the per-chunk path)."""
+        for w in self.windows:
+            self.publish_window(registry, w, engine=engine)
+
+    def compile_counts(self) -> dict[str, int]:
+        """Cumulative new-cache-entry count per watched function."""
+        out: dict[str, int] = {}
+        for w in self.windows:
+            for name, cnt in w.fns.items():
+                out[name] = out.get(name, 0) + cnt
+        return out

@@ -65,6 +65,58 @@ def _put(x, mesh: Mesh, spec: P):
     return jax.device_put(x, NamedSharding(mesh, spec))
 
 
+def spec_shard_factor(spec: P, mesh: Mesh) -> int:
+    """How many ways a leaf with PartitionSpec ``spec`` splits over
+    ``mesh`` — the product of the named axis sizes it mentions. The
+    byte arithmetic behind ``obs.costs``'s capacity predictions: a
+    leaf's per-device bytes are ``nbytes / spec_shard_factor`` (1 for
+    replicated leaves). One rule derived from the SAME spec trees the
+    shard helpers below place with, so prediction and placement cannot
+    drift."""
+    factor = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for name in names:
+            factor *= int(mesh.shape[name])
+    return factor
+
+
+def predicted_per_device_bytes(shapes, specs, mesh: Mesh) -> int:
+    """Static per-device state bytes for a pytree of
+    ``jax.ShapeDtypeStruct`` (or arrays) under a matching spec pytree —
+    the arithmetic twin of ``shard_driver.per_device_state_bytes``
+    (which measures live addressable shards). Every sharded DIMENSION
+    must divide its mesh factor — the same placeability rule
+    ``jax.device_put`` enforces — so a configuration that could never
+    be placed raises here rather than yielding a byte count for a
+    phantom placement."""
+    import math
+
+    total = 0
+    for leaf, spec in zip(jax.tree.leaves(shapes), jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )):
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, (tuple, list)) else (entry,)
+            dim_factor = 1
+            for name in names:
+                dim_factor *= int(mesh.shape[name])
+            if leaf.shape[dim] % dim_factor:
+                raise ValueError(
+                    f"leaf {leaf.shape}/{leaf.dtype} dimension {dim} "
+                    f"({leaf.shape[dim]}) does not divide its mesh "
+                    f"factor {dim_factor} — this placement is not "
+                    f"expressible (pad the node count)"
+                )
+        nbytes = math.prod(leaf.shape or (1,)) * leaf.dtype.itemsize
+        total += nbytes // spec_shard_factor(spec, mesh)
+    return total
+
+
 def shard_topology(topo: Topology, mesh: Mesh, axis=None) -> Topology:
     axis = _node_axis(mesh, axis)
     n = P(axis)
@@ -88,52 +140,80 @@ def shard_topology(topo: Topology, mesh: Mesh, axis=None) -> Topology:
     )
 
 
-def _shard_data_state(d: DataState, mesh: Mesh, axis) -> DataState:
-    """NamedSharding placement for a gossip DataState (shared by the
-    dense, sparse, and mixed shard helpers): node-major tensors shard
-    their row axis, writer heads and the window-live flag replicate,
-    window words shard dim 1 ([B, N, W]), and the flat cell plane
-    shards on node boundaries (K divides each shard when N does)."""
+def _put_specs(tree, specs, mesh: Mesh):
+    """device_put every leaf with its matching PartitionSpec leaf."""
+    return jax.tree.map(
+        lambda x, s: _put(x, mesh, s), tree, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def data_state_specs(d: DataState, mesh: Mesh, axis=None) -> DataState:
+    """The PartitionSpec tree for a gossip DataState (shared by the
+    dense, sparse, and mixed shard helpers AND the capacity
+    prediction in ``obs.costs``): node-major tensors shard their row
+    axis, writer heads and the window-live flag replicate, window
+    words shard dim 1 ([B, N, W]), and the flat cell plane shards on
+    node boundaries (K divides each shard when N does)."""
+    axis = _node_axis(mesh, axis)
     row = P(axis, None)
     vec = P(axis)
     rep = P()
     return DataState(
-        head=_put(d.head, mesh, rep),
-        contig=_put(d.contig, mesh, row),
-        seen=_put(d.seen, mesh, row),
-        oo=_put(d.oo, mesh, P(None, axis, None)),
-        oo_any=_put(d.oo_any, mesh, rep),
-        q_writer=_put(d.q_writer, mesh, row),
-        q_ver=_put(d.q_ver, mesh, row),
-        q_tx=_put(d.q_tx, mesh, row),
-        q_gw=_put(d.q_gw, mesh, row),
-        cells=jax.tree.map(lambda a: _put(a, mesh, vec), d.cells),
+        head=rep,
+        contig=row,
+        seen=row,
+        oo=P(None, axis, None),
+        oo_any=rep,
+        q_writer=row,
+        q_ver=row,
+        q_tx=row,
+        q_gw=row,
+        cells=jax.tree.map(lambda a: vec, d.cells),
+    )
+
+
+def node_major_specs(tree, mesh: Mesh, axis=None):
+    """Leading-axis sharding specs for every leaf (SWIM state, chunk
+    coverage)."""
+    axis = _node_axis(mesh, axis)
+    return jax.tree.map(
+        lambda x: P(axis, *([None] * (x.ndim - 1))), tree
     )
 
 
 def shard_node_major(tree, mesh: Mesh, axis):
     """Shard every leaf's leading axis (SWIM state, chunk coverage)."""
-    return jax.tree.map(
-        lambda x: _put(x, mesh, P(axis, *([None] * (x.ndim - 1)))), tree
+    return _put_specs(tree, node_major_specs(tree, mesh, axis), mesh)
+
+
+def cluster_state_specs(
+    state: ClusterState, mesh: Mesh, axis=None
+) -> ClusterState:
+    """Spec tree for the dense engine's ClusterState — the one
+    placement rule ``shard_cluster_state`` applies and
+    ``obs.costs.capacity_model`` predicts per-device bytes from."""
+    axis = _node_axis(mesh, axis)
+    return ClusterState(
+        # Every SWIM-plane field (dense SwimState or SparseSwimState) is
+        # node-major: shard the leading axis, replicate the rest.
+        swim=node_major_specs(state.swim, mesh, axis),
+        data=data_state_specs(state.data, mesh, axis),
+        round=P(),
+        vis_round=P(None, axis),
     )
 
 
 def shard_cluster_state(
     state: ClusterState, mesh: Mesh, axis=None
 ) -> ClusterState:
-    axis = _node_axis(mesh, axis)
-    return ClusterState(
-        # Every SWIM-plane field (dense SwimState or SparseSwimState) is
-        # node-major: shard the leading axis, replicate the rest.
-        swim=shard_node_major(state.swim, mesh, axis),
-        data=_shard_data_state(state.data, mesh, axis),
-        round=_put(state.round, mesh, P()),
-        vis_round=_put(state.vis_round, mesh, P(None, axis)),
+    return _put_specs(
+        state, cluster_state_specs(state, mesh, axis), mesh
     )
 
 
-def shard_sparse_state(sstate, mesh: Mesh, axis=None):
-    """NamedSharding placement for the sparse writer plane
+def sparse_state_specs(sstate, mesh: Mesh, axis=None):
+    """Spec tree for the sparse writer plane
     (ops/sparse_writers.SparseState): node-major tensors shard like the
     dense plane; slot-indexed vectors replicate (slots are global
     metadata, a few KB)."""
@@ -142,12 +222,18 @@ def shard_sparse_state(sstate, mesh: Mesh, axis=None):
     axis = _node_axis(mesh, axis)
     row = P(axis, None)
     return SparseState(
-        data=_shard_data_state(sstate.data, mesh, axis),
-        head_full=_put(sstate.head_full, mesh, P(axis)),
-        slot_writer=_put(sstate.slot_writer, mesh, P()),
-        dev_writer=_put(sstate.dev_writer, mesh, row),
-        dev_contig=_put(sstate.dev_contig, mesh, row),
-        dev_any=_put(sstate.dev_any, mesh, P()),
+        data=data_state_specs(sstate.data, mesh, axis),
+        head_full=P(axis),
+        slot_writer=P(),
+        dev_writer=row,
+        dev_contig=row,
+        dev_any=P(),
+    )
+
+
+def shard_sparse_state(sstate, mesh: Mesh, axis=None):
+    return _put_specs(
+        sstate, sparse_state_specs(sstate, mesh, axis), mesh
     )
 
 
@@ -160,8 +246,8 @@ def shard_chunk_state(state, mesh: Mesh, axis=None):
     return shard_node_major(state, mesh, axis)
 
 
-def shard_mixed_state(state, mesh: Mesh, axis=None):
-    """NamedSharding placement for the mixed chunk+version engine
+def mixed_state_specs(state, mesh: Mesh, axis=None):
+    """Spec tree for the mixed chunk+version engine
     (sim/mixed_engine.MixedState): the version plane shards like the
     dense engine, chunk coverage like the chunk plane, the per-stream
     completion latch is node-major, and the round counter replicates."""
@@ -169,10 +255,16 @@ def shard_mixed_state(state, mesh: Mesh, axis=None):
 
     axis = _node_axis(mesh, axis)
     return MixedState(
-        data=_shard_data_state(state.data, mesh, axis),
-        swim=shard_node_major(state.swim, mesh, axis),
-        chunks=shard_node_major(state.chunks, mesh, axis),
-        applied_before=_put(state.applied_before, mesh, P(axis, None)),
-        round=_put(state.round, mesh, P()),
-        vis_round=_put(state.vis_round, mesh, P(None, axis)),
+        data=data_state_specs(state.data, mesh, axis),
+        swim=node_major_specs(state.swim, mesh, axis),
+        chunks=node_major_specs(state.chunks, mesh, axis),
+        applied_before=P(axis, None),
+        round=P(),
+        vis_round=P(None, axis),
+    )
+
+
+def shard_mixed_state(state, mesh: Mesh, axis=None):
+    return _put_specs(
+        state, mixed_state_specs(state, mesh, axis), mesh
     )

@@ -80,6 +80,47 @@ def rounded_step_report(step_ms: float, plane: dict) -> dict:
     }
 
 
+def roofline_report(stage_costs: dict, plane_ms: dict) -> dict:
+    """Join the cost model's per-stage flops/bytes
+    (``obs.costs.roofline_stage_costs``) with the measured (already
+    emit-rounded) ``plane_ms`` into the roofline block every bench JSON
+    carries: achieved FLOP/s, B/s, and arithmetic intensity per plane.
+    Rates are derived FROM the emitted numbers — flops / (plane_ms/1e3)
+    — so ``telemetry.check_bench_invariants`` can recompute them
+    exactly; a plane measured at 0.0 ms publishes null rates rather
+    than infinities."""
+    out = {}
+    for name, ms in plane_ms.items():
+        cost = stage_costs.get(name, {"flops": 0.0, "bytes": 0.0})
+        flops = float(cost["flops"])
+        nbytes = float(cost["bytes"])
+        out[name] = {
+            "flops": flops,
+            "bytes": nbytes,
+            "flops_per_s": (flops / (ms / 1000.0)) if ms else None,
+            "bytes_per_s": (nbytes / (ms / 1000.0)) if ms else None,
+            "intensity": round(flops / nbytes, 4) if nbytes else None,
+        }
+    return out
+
+
+def compile_split_report(first_run_s: float, compile_ms: float) -> dict:
+    """The ledger split of the first-run blob, derived from the ROUNDED
+    values so ``compile_ms + first_step_ms == first_run_incl_compile_s
+    * 1000`` holds exactly on the published numbers (same emit-site
+    rounding rule as :func:`rounded_step_report`). ``first_step_ms`` is
+    the first run's non-compile wall: device execution plus host
+    dispatch — everything the blob contained that was not XLA
+    compilation."""
+    first_run_r = round(first_run_s, 1)
+    compile_r = round(min(compile_ms, first_run_r * 1000.0), 1)
+    return {
+        "first_run_incl_compile_s": first_run_r,
+        "compile_ms": compile_r,
+        "first_step_ms": round(first_run_r * 1000.0 - compile_r, 1),
+    }
+
+
 def plane_composite(cfg, topo, sched, final, bcast_fn=None):
     """Build the cumulative-prefix attribution inputs for a finished run.
 
@@ -294,6 +335,16 @@ def measure_multichip(
             )
             plane, _ = attr.scale(step_ms)
             report.update(rounded_step_report(step_ms, plane))
+            # Roofline on the SAME sharded composite: per-device
+            # flops/bytes per stage under the shard_map delivery chain
+            # (cost_analysis of an SPMD executable is per device),
+            # joined with the measured plane split.
+            from corrosion_tpu.obs import costs as costs_mod
+
+            report["roofline"] = roofline_report(
+                costs_mod.roofline_stage_costs(composite, stages, carry0),
+                report["plane_ms"],
+            )
             tm = parallel.traffic_model(cfg.gossip, mesh)
             got_ici = float(curves["xshard_bytes_ici"][0])
             got_dcn = float(curves["xshard_bytes_dcn"][0])

@@ -269,6 +269,19 @@ class FlightRecorder:
         self._f.write(json.dumps(obj) + "\n")
         self._f.flush()
 
+    def record_event(self, obj: dict) -> None:
+        """Append one out-of-band event line (e.g. the compile ledger's
+        ``kind: "compile"`` records). The reserved kinds stay owned by
+        their writers so replay_flight's row semantics cannot be
+        spoofed."""
+        if self._f is None:
+            raise ValueError("FlightRecorder is closed")
+        if obj.get("kind") in ("round", "chunk", "flight"):
+            raise ValueError(
+                f"record_event cannot write reserved kind {obj.get('kind')!r}"
+            )
+        self._write(obj)
+
     def record_chunk(
         self, start_round: int, curves: dict, wall_s: float | None = None
     ) -> None:
@@ -406,6 +419,18 @@ class KernelTelemetry:
     ``device_step_ms`` is the instrumented per-round step time over the
     chunk execution windows only, which is why it is a lower bound on a
     caller's whole-run wall per round.
+
+    ``ledger`` (obs.ledger.CompileLedger) opens a compile window around
+    every chunk execution: compilation events are attributed to the
+    chunk that dispatched them, written to the flight recorder
+    (``kind: "compile"``) and counted into the registry as
+    ``corro_kernel_compiles_total`` / ``corro_kernel_compile_ms``. An
+    ARMED ledger turns any steady-state compile into a RetraceError —
+    the run fails loudly instead of silently eating wall.
+
+    ``watermarks`` (obs.costs.MemoryWatermarks) samples live per-device
+    buffer bytes at every chunk boundary — the measured side of the
+    predicted-vs-live memory reconciliation (obs.costs.reconcile_memory).
     """
 
     engine: str = "dense"
@@ -414,6 +439,8 @@ class KernelTelemetry:
     tracer: object | None = None
     progress: IO[str] | None = None
     chunk_walls: list = field(default_factory=list)
+    ledger: object | None = None
+    watermarks: object | None = None
 
     def run_chunk(self, start_round: int, fn: Callable):
         """Execute one chunk ``fn() -> (state, curves)`` under a span,
@@ -427,8 +454,13 @@ class KernelTelemetry:
             if self.tracer is not None
             else contextlib.nullcontext()
         )
+        ledger_cm = (
+            self.ledger.window(f"{self.engine}@r{int(start_round)}")
+            if self.ledger is not None
+            else contextlib.nullcontext()
+        )
         t0 = time.perf_counter()
-        with span_cm as span:
+        with span_cm as span, ledger_cm as cwin:
             state, curves = fn()
             jax.block_until_ready(jax.tree.leaves(state))
             # Close the timed window before any host-side curve reads so
@@ -438,6 +470,24 @@ class KernelTelemetry:
             if span is not None:
                 span.set_attr("rounds", n)
                 span.set_attr("wall_s", round(wall, 6))
+        if self.watermarks is not None:
+            # Chunk boundary: the carried state (and the freshly stacked
+            # curves) are live right now — the honest high-water moment.
+            self.watermarks.sample()
+        if (
+            cwin is not None and not cwin.nested
+            and (cwin.compiles or cwin.fns)
+        ):
+            # A nested placeholder window (this chunk ran inside a
+            # caller's own ledger window, which owns the attribution)
+            # reports nothing here — the outer scope's reader and
+            # ledger.publish() cover it exactly once.
+            if self.recorder is not None:
+                self.recorder.record_event(cwin.to_record())
+            if self.registry is not None:
+                self.ledger.publish_window(
+                    self.registry, cwin, engine=self.engine
+                )
         self.on_chunk(start_round, curves, wall, n_rounds=n)
         return state, curves
 
@@ -632,6 +682,23 @@ def check_bench_invariants(
     - ``sum(plane_ms.values()) + residual_ms == step_ms``: plane
       attribution is a partition of the measured step time; nothing may
       hide in unattributed time.
+    - **Roofline** (the device-cost plane, obs/costs.py): a report that
+      attributes step time to planes must also attribute device cost —
+      a top-level ``plane_ms`` requires a ``roofline`` block with one
+      entry per plane carrying ``flops``/``bytes``/``flops_per_s``/
+      ``bytes_per_s``/``intensity``, and the achieved rates must equal
+      ``flops (bytes) / plane_ms`` recomputed from the emitted numbers.
+    - **Compile split** (the compile ledger): ``compile_ms`` requires
+      ``first_step_ms``, both non-negative, and when
+      ``first_run_incl_compile_s`` is present the split must
+      reconstruct it: ``compile_ms + first_step_ms ==
+      first_run_incl_compile_s * 1000`` on the emitted (rounded)
+      numbers — the opaque first-run blob is exactly compile + run,
+      nothing hides between them. ``compile_ms <= first_run`` follows.
+    - **Steady state is compile-free**: a ``steady_compiles`` field
+      must be 0 — the ledger counted a recompile inside an armed timed
+      window, and a bench that recompiled mid-measurement must not
+      publish at all.
 
     Raises ValueError naming the offending field on violation (a real
     exception, not ``assert`` — the guarantee must survive ``python -O``);
@@ -681,6 +748,89 @@ def check_bench_invariants(
                     f"= {total} != step_ms{sfx} {step}: attribution must "
                     f"partition the measured step time"
                 )
+
+    # Roofline: a report attributing step time to planes must attribute
+    # device cost the same way (suffixed variants — the 100k tail — are
+    # timing-only extras and are exempt).
+    plane = report.get("plane_ms")
+    if plane is not None:
+        roof = report.get("roofline")
+        if not isinstance(roof, dict):
+            raise ValueError(
+                "report carries plane_ms but no roofline block: every "
+                "plane attribution must also carry flops/bytes per plane "
+                "(obs/costs.roofline_stage_costs + "
+                "benchlib.roofline_report)"
+            )
+        missing = set(plane) - set(roof)
+        if missing:
+            raise ValueError(
+                f"roofline is missing plane(s) {sorted(missing)}: the "
+                f"flop/byte attribution must cover every timed plane"
+            )
+        for name, entry in roof.items():
+            for f in ("flops", "bytes", "flops_per_s", "bytes_per_s",
+                      "intensity"):
+                if f not in entry:
+                    raise ValueError(
+                        f"roofline.{name} is missing {f!r}"
+                    )
+            ms = plane.get(name)
+            if ms and entry["flops_per_s"] is not None:
+                want = entry["flops"] / (ms / 1000.0)
+                if abs(entry["flops_per_s"] - want) > 5e-3 * max(want, 1.0):
+                    raise ValueError(
+                        f"roofline.{name}.flops_per_s "
+                        f"{entry['flops_per_s']} != flops/plane_ms "
+                        f"{want:.1f}: achieved rates must be derived "
+                        f"from the emitted numbers"
+                    )
+            if ms and entry["bytes_per_s"] is not None:
+                want = entry["bytes"] / (ms / 1000.0)
+                if abs(entry["bytes_per_s"] - want) > 5e-3 * max(want, 1.0):
+                    raise ValueError(
+                        f"roofline.{name}.bytes_per_s "
+                        f"{entry['bytes_per_s']} != bytes/plane_ms "
+                        f"{want:.1f}"
+                    )
+
+    # Compile split: the ledger's decomposition of the first-run blob.
+    compile_ms = report.get("compile_ms")
+    if compile_ms is not None:
+        first_step = report.get("first_step_ms")
+        if first_step is None:
+            raise ValueError(
+                "compile_ms without first_step_ms: the ledger split "
+                "publishes both halves of the first-run blob or neither"
+            )
+        if compile_ms < 0 or first_step < 0:
+            raise ValueError(
+                f"negative compile split: compile_ms={compile_ms} "
+                f"first_step_ms={first_step}"
+            )
+        first_run_s = report.get("first_run_incl_compile_s")
+        if first_run_s is not None:
+            total = compile_ms + first_step
+            want = first_run_s * 1000.0
+            # The emit site derives first_step_ms from the ROUNDED
+            # values (benchlib.compile_split_report), so the published
+            # split reconstructs the blob to rounding, not to luck.
+            if abs(total - want) > 0.5 + tol * max(want, 1.0):
+                raise ValueError(
+                    f"compile_ms {compile_ms} + first_step_ms "
+                    f"{first_step} = {total} != "
+                    f"first_run_incl_compile_s*1000 = {want}: the split "
+                    f"must reconstruct the first-run blob exactly"
+                )
+
+    steady = report.get("steady_compiles")
+    if steady is not None and steady != 0:
+        raise ValueError(
+            f"steady_compiles={steady}: the compile ledger observed "
+            f"recompilation inside the armed timed window — the "
+            f"measurement is contaminated and must not publish "
+            f"(docs/PERFORMANCE.md 'Compile ledger')"
+        )
     return report
 
 

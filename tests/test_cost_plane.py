@@ -1,0 +1,569 @@
+"""Device-cost observability plane (obs/costs.py, obs/ledger.py,
+obs/trajectory.py).
+
+Coverage, per the plane's contract:
+
+- cost-model units: per-plane roofline flops/bytes are positive
+  increments, entries key on config fingerprint, the donated scan twin
+  costs the same flops as the plain one (donation changes aliasing,
+  never arithmetic);
+- predicted-vs-measured per-device byte reconciliation on the
+  8-virtual-device CPU mesh — the spec-arithmetic prediction must equal
+  the live addressable shards to the byte, and breaks RAISE;
+- compile-ledger determinism: exactly one compile per config per scan
+  entry, a second identical run compiles nothing even with the
+  tripwire armed;
+- a retrace-tripwire positive control (a fresh shape under an armed
+  ledger must raise RetraceError);
+- bench-trajectory provenance: cross-platform artifacts (the r05 CPU
+  fallback shape) are mechanically flagged and deltas across the break
+  are refused.
+
+Heavy AOT lowerings (one full engine compile each) are slow-marked into
+the bench-smoke CI job; the in-lane tests share the perf-plane's tiny
+cluster config so the suite compiles its scan once.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from corrosion_tpu.obs import costs, trajectory
+from corrosion_tpu.obs import ledger as ledger_mod
+from corrosion_tpu.sim import benchlib, telemetry
+
+from test_perf_plane import _tiny_cluster  # shared compiled config
+
+
+# ---------------------------------------------------------------------------
+# Compile ledger
+
+
+def test_ledger_one_compile_per_config_and_armed_second_run():
+    """Determinism: a chunked run compiles its donated scan exactly
+    once; an identical re-run adds zero compiles even with the
+    steady-state tripwire ARMED (the live analogue of sanitize CT030)."""
+    from corrosion_tpu.sim import engine as engine_mod
+    from corrosion_tpu.sim.engine import simulate
+
+    cfg, topo, sched = _tiny_cluster(rounds=9)
+    # Targeted cache clear (not jax.clear_caches(): other modules'
+    # compiles stay warm) so "exactly one compile" is exact regardless
+    # of what ran earlier in the session.
+    engine_mod._scan_rounds.clear_cache()
+    engine_mod._scan_rounds_donated.clear_cache()
+    led = ledger_mod.CompileLedger().watch(engine_mod).install()
+    try:
+        with led.window("warm") as w:
+            simulate(cfg, topo, sched, seed=0, max_chunk=3)
+        # Exactly one compiled executable per config per entry: the
+        # uniform chunking reuses ONE donated scan — and the plain twin
+        # must not have compiled alongside it.
+        assert w.fns == {"_scan_rounds_donated": 1}
+        assert w.compiles >= 1 and w.compile_ms > 0
+        assert engine_mod._scan_rounds_donated._cache_size() == 1
+        assert engine_mod._scan_rounds._cache_size() == 0
+        led.arm("identical re-run must be compile-free")
+        with led.window("steady") as w2:
+            simulate(cfg, topo, sched, seed=0, max_chunk=3)
+        assert w2.compiles == 0 and not w2.fns
+        assert led.armed_compiles == 0
+    finally:
+        led.disarm()
+        led.uninstall()
+
+
+def test_nested_window_and_publish_count_each_compile_once():
+    """The documented pattern — a KernelTelemetry per-chunk sink
+    running INSIDE a caller's own ledger window, then a run-end
+    publish() — must count every compile exactly once: nested windows
+    are inert placeholders (no per-chunk re-count of the outer scope's
+    cumulative totals, no premature flight records) and publish() skips
+    windows a live sink already emitted."""
+    from corrosion_tpu.sim import engine as engine_mod
+    from corrosion_tpu.sim.engine import simulate
+    from corrosion_tpu.utils.metrics import MetricsRegistry
+
+    cfg, topo, sched = _tiny_cluster(rounds=9)
+    # Guarantee the run compiles inside the window (non-vacuous even
+    # when an earlier test warmed this config).
+    engine_mod._scan_rounds_donated.clear_cache()
+    led = ledger_mod.CompileLedger().watch(engine_mod).install()
+    registry = MetricsRegistry()
+    try:
+        tele = telemetry.KernelTelemetry(
+            engine="dense", registry=registry, ledger=led
+        )
+        with led.window("outer") as outer:
+            simulate(cfg, topo, sched, seed=0, max_chunk=3, telemetry=tele)
+        led.publish(registry, engine="dense")
+        led.publish(registry, engine="dense")  # idempotent
+    finally:
+        led.uninstall()
+    total = sum(
+        registry.counter("corro_kernel_compiles_total").get(
+            engine="dense", fn=fn
+        )
+        for fn in list(outer.fns) + ["(unwatched)"]
+    )
+    # Every backend compile of the run counted exactly once, all owned
+    # by the outer window (the three chunk windows were inert).
+    assert total == outer.compiles
+    assert [w for w in led.windows if not w.nested] == [outer]
+    ms = registry.counter("corro_kernel_compile_ms").get(engine="dense")
+    assert ms == pytest.approx(outer.compile_ms)
+
+
+def test_retrace_tripwire_positive_control():
+    """An armed ledger must RAISE on a genuinely fresh compile."""
+    f = jax.jit(lambda x: x * 3 + 1)
+    f(jnp.ones(4))
+    led = ledger_mod.CompileLedger().install()
+    try:
+        led.arm("positive control")
+        f(jnp.ones(4))  # cached: fine
+        with pytest.raises(ledger_mod.RetraceError, match="armed"):
+            f(jnp.ones(5))  # fresh shape: compile under arms
+        assert led.armed_compiles == 1
+    finally:
+        led.disarm()
+        led.uninstall()
+
+
+def test_ledger_shared_registry_matches_sanitize_discovery():
+    """One registry: the ledger watches exactly the functions the
+    sanitize CT030 tripwire inspects (anything with jax's _cache_size),
+    donated twins included."""
+    from corrosion_tpu.sim import engine as engine_mod
+
+    fns = ledger_mod.jitted_functions(engine_mod)
+    for name in ("cluster_round", "cluster_round_donated",
+                 "_scan_rounds", "_scan_rounds_donated"):
+        assert name in fns
+    sizes = ledger_mod.cache_sizes(fns)
+    assert set(sizes) == set(fns)
+
+
+def test_ledger_compile_records_reach_flight_and_metrics(tmp_path):
+    """The KernelTelemetry integration: a chunk that compiles writes a
+    ``kind: "compile"`` flight record and counts into
+    corro_kernel_compiles_total; replay_flight stays intact."""
+    from corrosion_tpu.sim import engine as engine_mod
+    from corrosion_tpu.sim.engine import simulate
+    from corrosion_tpu.utils.metrics import MetricsRegistry
+
+    cfg, topo, sched = _tiny_cluster(rounds=9)
+    # A distinct chunk length forces one fresh scan compile so the
+    # window has something to record even in a warm session.
+    path = str(tmp_path / "flight.jsonl")
+    led = ledger_mod.CompileLedger().watch(engine_mod).install()
+    registry = MetricsRegistry()
+    try:
+        with telemetry.FlightRecorder(path, engine="dense") as rec:
+            tele = telemetry.KernelTelemetry(
+                engine="dense", recorder=rec, registry=registry,
+                ledger=led,
+            )
+            simulate(cfg, topo, sched, seed=0, max_chunk=9, telemetry=tele)
+    finally:
+        led.uninstall()
+    lines = [json.loads(x) for x in open(path) if x.strip()]
+    compiles = [x for x in lines if x.get("kind") == "compile"]
+    assert compiles, "the compiling chunk must leave a ledger record"
+    assert compiles[0]["compiles"] >= 1
+    assert compiles[0]["compile_ms"] > 0
+    got = registry.counter("corro_kernel_compiles_total").get(
+        engine="dense", fn="_scan_rounds_donated"
+    )
+    assert got >= 1
+    # The out-of-band record must not disturb curve replay.
+    curves, chunks = telemetry.replay_flight(path)
+    assert len(curves["round"]) == 9 and len(chunks) == 1
+
+
+def test_flight_record_event_refuses_reserved_kinds(tmp_path):
+    path = str(tmp_path / "f.jsonl")
+    with telemetry.FlightRecorder(path) as rec:
+        rec.record_event({"kind": "compile", "compiles": 1})
+        with pytest.raises(ValueError, match="reserved"):
+            rec.record_event({"kind": "round", "round": 0})
+
+
+# ---------------------------------------------------------------------------
+# Roofline stage costs + report arithmetic
+
+
+def test_roofline_stage_costs_are_positive_increments():
+    """Cumulative-prefix cost extraction on a hand composite: each
+    stage's flops/bytes are the increment of the single-step lowering,
+    positive when the stage does work."""
+
+    def composite(enabled):
+        def step(carry, i):
+            x, y = carry
+            if "mul" in enabled:
+                x = x * 2 + 1
+            if "dot" in enabled:
+                y = y + x @ x
+            return x, y
+
+        return step
+
+    carry0 = (jnp.ones((16, 16), jnp.float32),
+              jnp.zeros((16, 16), jnp.float32))
+    sc = costs.roofline_stage_costs(composite, ("mul", "dot"), carry0)
+    assert set(sc) == {"mul", "dot"}
+    for s in sc.values():
+        assert s["flops"] > 0 and s["bytes"] >= 0
+    # The dot stage dominates flops and moves extra bytes (the
+    # elementwise stage fuses into the carry copy: byte delta 0 is
+    # legitimate for it — the identity prefix already moves the carry).
+    assert sc["dot"]["flops"] > sc["mul"]["flops"]
+    assert sc["dot"]["bytes"] > 0
+
+
+def test_roofline_report_rates_derive_from_emitted_numbers():
+    sc = {"broadcast": {"flops": 2e6, "bytes": 4e6}}
+    roof = benchlib.roofline_report(sc, {"broadcast": 50.0})
+    b = roof["broadcast"]
+    assert b["flops_per_s"] == pytest.approx(2e6 / 0.05)
+    assert b["bytes_per_s"] == pytest.approx(4e6 / 0.05)
+    assert b["intensity"] == pytest.approx(0.5)
+    # A zero-ms plane publishes null rates, not infinities.
+    roof0 = benchlib.roofline_report(sc, {"broadcast": 0.0})
+    assert roof0["broadcast"]["flops_per_s"] is None
+
+
+# ---------------------------------------------------------------------------
+# Cost model entries (heavy AOT lowerings -> bench-smoke CI job)
+
+
+@pytest.mark.slow  # one full engine AOT compile per variant (~25 s)
+def test_cost_entry_donated_twin_equals_plain_dense():
+    """Donation changes buffer aliasing, never arithmetic: the donated
+    scan's flops equal the plain twin's EXACTLY, bytes within the
+    copy-elision margin, and the donated entry actually aliases."""
+    plain = costs.cost_entry("dense", "plain", device_count=1)
+    donated = costs.cost_entry("dense", "donated", device_count=1)
+    assert plain["flops"] == donated["flops"]
+    assert donated["bytes_accessed"] <= plain["bytes_accessed"] * 1.01
+    assert donated["alias_bytes"] > 0 and plain["alias_bytes"] == 0
+    assert plain["config_fingerprint"] == donated["config_fingerprint"]
+    for e in (plain, donated):
+        assert e["flops"] > 0 and e["bytes_accessed"] > 0
+        assert e["peak_bytes"] > 0 and e["rounds"] > 0
+
+
+@pytest.mark.slow  # four engine compiles on the virtual mesh (~60 s)
+def test_cost_entries_all_engines_sharded_and_keyed():
+    """Every engine lowers at D=8 on the (dcn, ici) mesh with positive
+    flops/bytes, and entries key on distinct config fingerprints."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    entries = {
+        eng: costs.cost_entry(eng, "plain", device_count=8)
+        for eng in costs.ENGINES
+    }
+    fps = {e["config_fingerprint"] for e in entries.values()}
+    assert len(fps) == len(entries), "fingerprints must key per config"
+    for eng, e in entries.items():
+        assert e["flops"] > 0 and e["bytes_accessed"] > 0, eng
+        assert e["device_count"] == 8
+
+
+@pytest.mark.slow  # engine-composite prefixes (~30 s of single-step AOT)
+def test_engine_roofline_every_plane_positive():
+    """The real plane composite: every timed stage does positive
+    flops AND bytes — a zero plane means the prefix wiring broke."""
+    from corrosion_tpu.sim.engine import simulate
+
+    cfg, topo, sched = _tiny_cluster(rounds=9)
+    final, _ = simulate(cfg, topo, sched, seed=0, max_chunk=3)
+    composite, stages, carry0 = benchlib.plane_composite(
+        cfg, topo, sched, final
+    )
+    sc = costs.roofline_stage_costs(composite, stages, carry0)
+    assert set(sc) == set(benchlib.PLANE_STAGES)
+    for name, s in sc.items():
+        assert s["flops"] > 0, name
+        assert s["bytes"] > 0, name
+
+
+def test_cost_model_diff_gates_regressions_and_fingerprints():
+    """The baseline diff: metric increases beyond tolerance breach,
+    decreases are notes, missing entries breach, fingerprint drift
+    breaches, cross-platform comparison is refused outright."""
+    base = {
+        "schema": costs.COST_SCHEMA, "platform": "cpu",
+        "backend": "native", "jax_version": "x", "tolerance": 0.25,
+        "entries": {
+            "dense/plain/d1": {
+                "config_fingerprint": "aa", "flops": 1000.0,
+                "bytes_accessed": 2000.0, "peak_bytes": 300,
+                "temp_bytes": 100,
+            },
+            "sparse/plain/d1": {
+                "config_fingerprint": "bb", "flops": 10.0,
+                "bytes_accessed": 10.0, "peak_bytes": 10,
+                "temp_bytes": 1,
+            },
+        },
+    }
+    ok_cand = json.loads(json.dumps(base))
+    ok, breaches, _ = costs.diff_cost_models(base, ok_cand)
+    assert ok and not breaches
+    # +50% flops on one entry breaches; -50% is a note.
+    worse = json.loads(json.dumps(base))
+    worse["entries"]["dense/plain/d1"]["flops"] = 1500.0
+    worse["entries"]["sparse/plain/d1"]["flops"] = 5.0
+    ok, breaches, notes = costs.diff_cost_models(base, worse)
+    assert not ok and any("dense/plain/d1.flops" in b for b in breaches)
+    assert any("improved" in n for n in notes)
+    # Missing entry + fingerprint drift breach.
+    drift = json.loads(json.dumps(base))
+    del drift["entries"]["sparse/plain/d1"]
+    drift["entries"]["dense/plain/d1"]["config_fingerprint"] = "zz"
+    ok, breaches, _ = costs.diff_cost_models(base, drift)
+    joined = "\n".join(breaches)
+    assert "missing from measurement" in joined
+    assert "fingerprint" in joined
+    # Cross-platform refusal, the house provenance rule.
+    tpu = json.loads(json.dumps(base))
+    tpu["platform"] = "tpu"
+    ok, breaches, _ = costs.diff_cost_models(base, tpu)
+    assert not ok and "platform" in "\n".join(breaches)
+
+
+# ---------------------------------------------------------------------------
+# Per-device memory: prediction, watermarks, reconcile-or-fail
+
+
+def test_predicted_per_device_bytes_exact_on_8dev_mesh():
+    """The spec-arithmetic prediction equals the live addressable
+    shards TO THE BYTE on the 8-virtual-device (dcn, ici) mesh, the
+    watermark covers the state, and a doctored prediction FAILS the
+    reconcile (break, not skew)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from corrosion_tpu import models, parallel
+    from corrosion_tpu.parallel import mesh as mesh_mod
+    from corrosion_tpu.sim import engine
+
+    cfg, topo, sched = models.merge_10k(n=32, rounds=8, samples=8)
+    mesh = benchlib.multichip_mesh(8)
+    state = mesh_mod.shard_cluster_state(
+        engine.init_cluster(cfg, len(sched.sample_writer)), mesh
+    )
+    predicted = costs.predicted_state_bytes(
+        cfg, len(sched.sample_writer), mesh
+    )
+    measured = parallel.per_device_state_bytes(state)
+    assert len(measured) == 8
+    assert all(v == predicted for v in measured.values()), (
+        predicted, sorted(measured.values())
+    )
+    wm = costs.MemoryWatermarks()
+    wm.sample()
+    rep = costs.reconcile_memory(
+        state, watermarks=wm, predicted_per_device=predicted
+    )
+    assert rep["devices"] == 8
+    assert rep["state_bytes_per_device_max"] == predicted
+    with pytest.raises(ValueError, match="predicted"):
+        costs.reconcile_memory(
+            state, watermarks=wm,
+            predicted_per_device=predicted + 10_000,
+        )
+
+
+def test_watermarks_sampled_at_chunk_boundaries_cover_state():
+    """The KernelTelemetry integration on a real chunked run: the
+    per-device live high-water mark sampled at chunk boundaries covers
+    the final state's own bytes, and an UNSAMPLED watermark fails the
+    reconcile rather than passing vacuously."""
+    from corrosion_tpu.sim.engine import simulate
+
+    cfg, topo, sched = _tiny_cluster(rounds=9)
+    wm = costs.MemoryWatermarks()
+    tele = telemetry.KernelTelemetry(engine="dense", watermarks=wm)
+    final, _ = simulate(
+        cfg, topo, sched, seed=0, max_chunk=3, telemetry=tele
+    )
+    assert wm.samples == 3  # one per chunk boundary
+    rep = costs.reconcile_memory(final, watermarks=wm)
+    assert rep["state_bytes_per_device_max"] > 0
+    with pytest.raises(ValueError, match="never sampled"):
+        costs.reconcile_memory(
+            final, watermarks=costs.MemoryWatermarks()
+        )
+
+
+@pytest.mark.slow  # sharded engine run + sharded AOT entry (~45 s)
+def test_sharded_run_reconciles_against_memory_analysis():
+    """The full three-way reconcile on the 8-virtual-device mesh: live
+    watermarks vs spec-arithmetic prediction vs the lowered entry's
+    memory_analysis — all on the SAME tiny config the cost model
+    fixes."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from corrosion_tpu import models, parallel
+
+    cfg, topo, sched = models.merge_10k(n=32, rounds=8, samples=8)
+    mesh = benchlib.multichip_mesh(8)
+    wm = costs.MemoryWatermarks()
+    tele = telemetry.KernelTelemetry(engine="dense", watermarks=wm)
+    final, _ = parallel.simulate_sharded(
+        cfg, topo, sched, mesh, seed=0, telemetry=tele
+    )
+    predicted = costs.predicted_state_bytes(
+        cfg, len(sched.sample_writer), mesh
+    )
+    entry = costs.cost_entry("dense", "plain", device_count=8)
+    rep = costs.reconcile_memory(
+        final, watermarks=wm, predicted_per_device=predicted, cost=entry
+    )
+    assert rep["state_bytes_per_device_max"] == predicted
+    # And the lowered entry's output really covers the state.
+    assert entry["output_bytes"] >= predicted
+
+
+# ---------------------------------------------------------------------------
+# Capacity curve
+
+
+def test_capacity_model_validates_both_measured_points():
+    """The corro-capacity/1 artifact: the 512-node lane point must
+    reconcile byte-exact against a live placement, the recorded 100k
+    point within tolerance, and the curve must cover the ROADMAP
+    500k-800k window with per-device bytes strictly increasing."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    model = costs.capacity_model()
+    assert model["schema"] == costs.CAPACITY_SCHEMA
+    assert model["validation"]["lane_512"]["exact"]
+    assert model["validation"]["large_100k"]["relative_error"] < 0.05
+    mib = [row["per_device_mib"] for row in model["curve"]]
+    assert mib == sorted(mib) and len(set(mib)) == len(mib)
+    nodes = [row["nodes"] for row in model["curve"]]
+    assert any(n >= 800_000 for n in nodes)
+    for row in model["curve"]:
+        assert row["verdict"] in ("fits", "tight", "exceeds")
+    # Marginal cluster-state bytes per node (the docs/SCALING.md
+    # "Memory capacity" figure) rides the artifact.
+    assert 1_000 < model["state_bytes_per_node"] < 50_000
+
+
+def test_predicted_bytes_rejects_unplaceable_dimension():
+    """A shape whose SHARDED DIMENSION does not divide the mesh factor
+    is unplaceable (jax.device_put would refuse it) — the prediction
+    must raise, even when the leaf's total BYTES happen to divide."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from jax.sharding import PartitionSpec as P
+
+    from corrosion_tpu.parallel import mesh as mesh_mod
+
+    mesh = benchlib.multichip_mesh(8)
+    good = jax.ShapeDtypeStruct((16, 2), jnp.float32)
+    assert mesh_mod.predicted_per_device_bytes(
+        [good], [P(mesh_mod._node_axis(mesh, None), None)], mesh
+    ) == 16 * 2 * 4 // 8
+    bad = jax.ShapeDtypeStruct((12, 2), jnp.float32)  # 96 B divides 8...
+    with pytest.raises(ValueError, match="not expressible"):
+        mesh_mod.predicted_per_device_bytes(
+            [bad], [P(mesh_mod._node_axis(mesh, None), None)], mesh
+        )  # ...but dimension 0 (12) does not divide the mesh factor
+
+
+def test_capacity_model_fails_on_contradicted_measurement(monkeypatch):
+    """A model that contradicts its measured point must refuse to emit
+    the artifact (reconcile-or-fail, not a skewed curve)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    monkeypatch.setitem(
+        costs.MEASURED_100K, "per_device_bytes", 300.0 * 2**20
+    )
+    with pytest.raises(ValueError, match="100k point"):
+        costs.capacity_model(node_counts=(100_352,))
+
+
+# ---------------------------------------------------------------------------
+# Bench trajectory
+
+
+def _wrap(path, n, parsed, tail=""):
+    path.write_text(json.dumps(
+        {"n": n, "cmd": "bench", "rc": 0, "tail": tail, "parsed": parsed}
+    ))
+
+
+def test_trajectory_flags_platform_fallback_and_refuses_delta(tmp_path):
+    """The r05 shape, mechanically: a TPU 10k artifact followed by a
+    CPU 512 artifact under the same metric name is a comparability
+    break — flagged, no delta computed across it — while matched
+    artifacts get deltas."""
+    _wrap(tmp_path / "BENCH_r01.json", 1, {
+        "metric": "p99", "value": 7.0, "unit": "s", "step_ms": 500.0,
+    }, tail='[bench] {"platform": "tpu", "nodes": 10000}\n')
+    _wrap(tmp_path / "BENCH_r02.json", 2, {
+        "metric": "p99", "value": 6.5, "unit": "s", "step_ms": 180.0,
+    }, tail='[bench] {"platform": "tpu", "nodes": 10000}\n')
+    _wrap(tmp_path / "BENCH_r03.json", 3, {
+        "metric": "p99", "value": 2.5, "unit": "s", "step_ms": 1189.1,
+        "platform": "cpu", "nodes": 512,
+    })
+    traj = trajectory.build_trajectory(str(tmp_path))
+    r1, r2, r3 = traj["bench"]
+    assert r2["comparable_with_prev"] is True
+    assert r2["value_delta"] == pytest.approx(-0.5)
+    assert r2["step_ms_delta"] == pytest.approx(-320.0)
+    assert r3["comparable_with_prev"] is False
+    assert any("platform tpu->cpu" in f for f in r3["flags"])
+    assert any("nodes 10000->512" in f for f in r3["flags"])
+    assert "value_delta" not in r3  # delta across the break is refused
+    assert len(traj["comparability_breaks"]) == 1
+    assert r1["provenance"] == "stderr" and r3["provenance"] == "emitted"
+    text = trajectory.render_trajectory(traj)
+    assert "not comparable: platform tpu->cpu" in text
+
+
+def test_trajectory_reads_committed_artifacts_and_r05_break():
+    """Against the REAL committed artifacts: the r04→r05 platform
+    fallback must surface as a break (the VERDICT r5 caveat, now
+    mechanical)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    traj = trajectory.build_trajectory(root)
+    assert len(traj["bench"]) >= 5
+    r05 = next(r for r in traj["bench"] if "r05" in r["file"])
+    assert r05["platform"] == "cpu" and r05["nodes"] == 512
+    assert r05["comparable_with_prev"] is False
+    assert any("platform tpu->cpu" in f for f in r05["flags"])
+    assert traj["multichip"], "multichip lane artifacts must parse"
+    for m in traj["multichip"]:
+        assert m["device_count"] == 8
+
+
+def test_trajectory_parses_prose_diag_line(tmp_path):
+    """r01-era artifacts carry provenance only as stderr prose."""
+    _wrap(tmp_path / "BENCH_r01.json", 1, {
+        "metric": "tp", "value": 1.0, "unit": "c/s",
+    }, tail="[bench] platform=tpu nodes=10000 rounds=120 wall=2s\n")
+    row = trajectory.build_trajectory(str(tmp_path))["bench"][0]
+    assert row["platform"] == "tpu" and row["nodes"] == 10000
+    assert row["provenance"] == "stderr"
+
+
+# ---------------------------------------------------------------------------
+# Fingerprint keying (host-side)
+
+
+def test_cost_entry_keys_and_fingerprints():
+    assert costs.entry_key("dense", "plain", 1) == "dense/plain/d1"
+    assert costs.entry_key("mixed", "donated", 8) == "mixed/donated/d8"
+    a = benchlib.config_fingerprint("cfg", 8, 16)
+    b = benchlib.config_fingerprint("cfg", 8, 32)
+    assert a != b and a == benchlib.config_fingerprint("cfg", 8, 16)

@@ -700,19 +700,22 @@ def test_donation_keeps_compile_cache_count_at_one():
     ownership copy makes chunk 1 donatable too): every jitted entry in
     the engine module holds <= 1 compile-cache entry — the CT031 retrace
     tripwire invariant, donation included."""
+    from corrosion_tpu.obs import ledger as ledger_mod
     from corrosion_tpu.sim import engine as engine_mod
     from corrosion_tpu.sim.engine import simulate
 
     cfg, topo, sched = _tiny_cluster(rounds=9)
     jax.clear_caches()
     simulate(cfg, topo, sched, seed=0, max_chunk=3)
-    for name in dir(engine_mod):
-        fn = getattr(engine_mod, name, None)
-        if callable(fn) and hasattr(fn, "_cache_size"):
-            assert fn._cache_size() <= 1, (
-                f"engine.{name} holds {fn._cache_size()} compile-cache "
-                f"entries — donation must not add cache entries"
-            )
+    # The shared watched-fn registry (obs/ledger.py) — the same
+    # discovery the sanitize CT030 tripwire and the runtime compile
+    # ledger use, so this pin can never watch a different set.
+    sizes = ledger_mod.cache_sizes(ledger_mod.jitted_functions(engine_mod))
+    for name, size in sizes.items():
+        assert size <= 1, (
+            f"engine.{name} holds {size} compile-cache "
+            f"entries — donation must not add cache entries"
+        )
     # The donated scan actually ran and compiled exactly once.
     assert engine_mod._scan_rounds_donated._cache_size() == 1
 
@@ -832,16 +835,70 @@ _PROVENANCE = {
 
 
 def test_check_bench_invariants_accepts_consistent_report():
+    plane = {"swim": 10.0, "broadcast": 50.0, "sync": 30.0}
+    stage_costs = {
+        k: {"flops": 1e6 * (i + 1), "bytes": 2e6 * (i + 1)}
+        for i, k in enumerate(plane)
+    }
     rep = {
         **_PROVENANCE,
         "step_ms": 100.0,
         "step_inner_ms": 90.0,
-        "plane_ms": {"swim": 10.0, "broadcast": 50.0, "sync": 30.0},
+        "plane_ms": plane,
         "residual_ms": 10.0,
+        # The device-cost plane: plane_ms now requires the matching
+        # roofline block (derived from the same emitted numbers).
+        "roofline": benchlib.roofline_report(stage_costs, plane),
         "step_ms_100k": 50.0,
         "step_inner_ms_100k": 49.0,
     }
     assert telemetry.check_bench_invariants(rep) is rep
+
+
+def test_check_bench_invariants_requires_roofline_with_planes():
+    """A plane_ms attribution without the flop/byte attribution is
+    refused at the emit site, and a roofline whose achieved rate does
+    not recompute from the emitted numbers is too."""
+    plane = {"broadcast": 50.0}
+    with pytest.raises(ValueError, match="roofline"):
+        telemetry.check_bench_invariants(
+            {**_PROVENANCE, "step_ms": 60.0, "plane_ms": plane,
+             "residual_ms": 10.0}
+        )
+    bad = benchlib.roofline_report(
+        {"broadcast": {"flops": 1e6, "bytes": 1e6}}, plane
+    )
+    bad["broadcast"]["flops_per_s"] = 123.0  # doctored achieved rate
+    with pytest.raises(ValueError, match="flops_per_s"):
+        telemetry.check_bench_invariants(
+            {**_PROVENANCE, "step_ms": 60.0, "plane_ms": plane,
+             "residual_ms": 10.0, "roofline": bad}
+        )
+
+
+def test_check_bench_invariants_compile_split_and_steady():
+    """The ledger split must reconstruct the first-run blob, and a
+    steady-state recompile count != 0 refuses to publish."""
+    split = benchlib.compile_split_report(74.82, 61234.5)
+    assert split["compile_ms"] + split["first_step_ms"] == pytest.approx(
+        split["first_run_incl_compile_s"] * 1000.0
+    )
+    rep = {**_PROVENANCE, "step_ms": 10.0, **split, "steady_compiles": 0}
+    assert telemetry.check_bench_invariants(rep) is rep
+    with pytest.raises(ValueError, match="first_step_ms"):
+        telemetry.check_bench_invariants(
+            {**_PROVENANCE, "step_ms": 10.0, "compile_ms": 5.0}
+        )
+    with pytest.raises(ValueError, match="reconstruct"):
+        telemetry.check_bench_invariants(
+            {**_PROVENANCE, "step_ms": 10.0,
+             "first_run_incl_compile_s": 10.0, "compile_ms": 5.0,
+             "first_step_ms": 5.0}
+        )
+    with pytest.raises(ValueError, match="steady_compiles"):
+        telemetry.check_bench_invariants(
+            {**_PROVENANCE, "step_ms": 10.0, "steady_compiles": 2}
+        )
 
 
 def test_check_bench_invariants_rejects_r05_shape():
