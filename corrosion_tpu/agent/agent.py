@@ -112,6 +112,26 @@ class AgentConfig:
     # Member-state persistence cadence (diff_member_states every 60 s,
     # broadcast/mod.rs:570-702); persisted members seed rejoin at restart.
     member_persist_interval: float = 60.0
+    # Gossip transport dial/send guards + circuit-breaker schedule
+    # (transport.py module constants by default; chaos scenarios compress
+    # them into test time).
+    connect_timeout: float = 3.0
+    send_timeout: float = 5.0
+    breaker_threshold: int = 3
+    breaker_base_s: float = 1.0
+    breaker_max_s: float = 30.0
+    # Announcer-loop backoff (agent.rs:726-768): how fast an agent with
+    # an EMPTY alive set re-announces to its bootstrap seeds — both at
+    # startup (DNS lag) and after a partition/suspicion cascade emptied
+    # the membership.
+    announce_backoff_min_s: float = 1.0
+    announce_backoff_max_s: float = 30.0
+    # Deterministic WAN impairment (agent/netem.py, docs/CHAOS.md "Host
+    # plane"): a corro-host-fault-plan/1 dict installs a NetemShim on the
+    # gossip transport. None = no shim, bit-identical transport path.
+    netem_plan: dict | None = None
+    netem_seed: int = 0
+    netem_node: str = ""  # this node's name in the plan's link space
     tls: "AgentTls | None" = None  # gossip-plane TLS (None = plaintext)
     prometheus_addr: str = ""  # host:port for /metrics ("" = disabled)
     trace_export_path: str = ""  # JSON-lines span export ("" = in-memory)
@@ -186,6 +206,25 @@ class Agent:
         self.actor_id = self.store.site_id.hex()
         self.bookie = Bookie()
         self.hlc = HLC()
+        self.netem = None
+        if cfg.netem_plan:
+            from corrosion_tpu.agent.netem import NetemShim
+
+            shim = NetemShim(
+                cfg.netem_plan, seed=cfg.netem_seed,
+                local=cfg.netem_node or self.actor_id[:8],
+            )
+            # An empty plan installs nothing: the transport keeps its
+            # bit-identical unimpaired path.
+            self.netem = shim if shim.enabled else None
+        transport_kw = dict(
+            connect_timeout=cfg.connect_timeout,
+            send_timeout=cfg.send_timeout,
+            breaker_threshold=cfg.breaker_threshold,
+            breaker_base_s=cfg.breaker_base_s,
+            breaker_max_s=cfg.breaker_max_s,
+            netem=self.netem,
+        )
         if cfg.tls is not None:
             from corrosion_tpu.agent import tls as tls_mod
 
@@ -198,9 +237,10 @@ class Agent:
                     cfg.tls.ca, cfg.tls.client_cert, cfg.tls.client_key,
                     insecure=cfg.tls.insecure,
                 ),
+                **transport_kw,
             )
         else:
-            self.transport = Transport()
+            self.transport = Transport(**transport_kw)
         self.members = Members(self.actor_id)
         self.tasks = TaskRegistry()
         self.tripwire = Tripwire()
@@ -283,6 +323,24 @@ class Agent:
         )
         self.metrics.counter(
             "corro_sync_changes_recv", "changes received through sync"
+        )
+        # Defensive-machinery visibility (docs/CHAOS.md "Host plane"):
+        # the stall abort, adaptive chunk halving, and announcer backoff
+        # all fire silently without these — and the chaos harness's
+        # "prove the defense engaged" assertions read exactly them.
+        # (Breaker trip/recovery edges live in transport.bind_metrics.)
+        self._m_stall_aborts = self.metrics.counter(
+            "corro_sync_stall_aborts_total",
+            "sync sessions aborted by the blocking-send stall guard "
+            "(peer.rs:352-355)",
+        )
+        self._m_chunk_halvings = self.metrics.counter(
+            "corro_sync_chunk_halvings_total",
+            "adaptive sync chunk-size halvings (peer.rs:638-653)",
+        )
+        self.metrics.counter(
+            "corro_peer_backoff_retries_total",
+            "backoff waits taken by the bootstrap announcer loop",
         )
         self._ingest: asyncio.Queue = asyncio.Queue(maxsize=4096)
         self._addr_of: dict[str, tuple[str, int]] = {}
@@ -422,23 +480,39 @@ class Agent:
         ).set(self.cfg.broadcast_buffer_bytes)
         for addr in self.cfg.bootstrap:
             await self.swim.announce(tuple(addr))
-        if self.cfg.bootstrap_raw:
+        if self.cfg.bootstrap_raw or self.cfg.bootstrap:
             self.tasks.spawn(
                 self._bootstrap_loop(), name="bootstrap_announcer"
             )
 
     async def _bootstrap_loop(self) -> None:
-        """Re-resolve + re-announce bootstrap seeds with backoff until the
-        member list is non-empty (the announcer loop, agent.rs:726-768):
-        a seed name may not be DNS-published yet when this node starts."""
+        """Announcer loop (agent.rs:726-768): re-resolve + re-announce
+        the bootstrap seeds with backoff WHENEVER the alive member set is
+        empty — at startup (a seed name may not be DNS-published yet) and
+        again after a partition or suspicion cascade empties the
+        membership. The SWIM plane never probes members it believes
+        down, so a fully isolated node can only re-enter the cluster by
+        announcing its way back in; the announce reply carries the
+        cluster's belief about the announcer so it can refute a stale
+        DOWN with a higher incarnation (membership.on_message)."""
         from corrosion_tpu.agent.config import resolve_bootstrap
         from corrosion_tpu.utils.backoff import Backoff
 
-        backoff = Backoff(min_wait=1.0, max_wait=30.0)
+        retries = self.metrics.counter("corro_peer_backoff_retries_total")
+        backoff = Backoff(
+            min_wait=self.cfg.announce_backoff_min_s,
+            max_wait=self.cfg.announce_backoff_max_s,
+            on_wait=lambda _w: retries.inc(),
+        )
         while not self.tripwire.tripped:
             if self.members.alive():
-                return  # joined; SWIM keeps the membership from here
-            for addr in resolve_bootstrap(self.cfg.bootstrap_raw):
+                backoff.reset()
+                await asyncio.sleep(1.0)
+                continue
+            addrs = [tuple(a) for a in self.cfg.bootstrap]
+            if self.cfg.bootstrap_raw:
+                addrs.extend(resolve_bootstrap(self.cfg.bootstrap_raw))
+            for addr in addrs:
                 if addr != self.gossip_addr:
                     await self.swim.announce(addr)
             await asyncio.sleep(next(backoff))
@@ -469,19 +543,35 @@ class Agent:
                 await self._persist_members_once()
             except Exception:
                 pass
+        await self._close_resources()
+
+    async def _close_resources(self) -> None:
+        """The ungraceful tail shared by stop() and abort(): release
+        every in-process resource (sockets, sqlite handles, threads) so
+        the same data_dir can relaunch immediately. Anything added here
+        closes on BOTH paths; graceful-only work (leave, flushes) stays
+        in stop()."""
         self.transport.close()
         if self.subs is not None:
             self.subs.close()
-        if self._api_server is not None:
-            self._api_server.close()
-        if self._admin_server is not None:
-            self._admin_server.close()
-        if self._prom_server is not None:
-            self._prom_server.close()
+        for srv in (self._api_server, self._admin_server, self._prom_server):
+            if srv is not None:
+                srv.close()
         if self.pool is not None:
             await self.pool.close()
         self.tracer.close()
         self.store.close()
+
+    async def abort(self) -> None:
+        """Crash-style shutdown — the in-process stand-in for SIGKILL
+        (agent/testing.hard_kill). Deliberately NOT stop(): no graceful
+        SWIM leave (peers must detect the death), no empties drain, no
+        final member-state flush — the restarted life gets only what a
+        dead process would have left behind: the store's committed WAL
+        state and whatever the periodic loops happened to persist."""
+        self.tripwire.trip()
+        await self.tasks.cancel_all()
+        await self._close_resources()
 
     # -- write path (make_broadcastable_changes) ------------------------------
 
@@ -1706,13 +1796,19 @@ class Agent:
                 session.close()
 
     async def _timed_send(self, session, frame, chunker) -> None:
-        """Send with the stall abort + chunk-size feedback loop."""
+        """Send with the stall abort + chunk-size feedback loop. Both
+        defenses count when they engage: the abort edge here, the
+        halving edge via AdaptiveChunker.record's return."""
         t0 = time.monotonic()
-        nbytes = await asyncio.wait_for(
-            session.send(frame), self.cfg.sync_stall_timeout
-        )
-        if chunker is not None:
-            chunker.record(time.monotonic() - t0)
+        try:
+            nbytes = await asyncio.wait_for(
+                session.send(frame), self.cfg.sync_stall_timeout
+            )
+        except asyncio.TimeoutError:
+            self._m_stall_aborts.inc()
+            raise
+        if chunker is not None and chunker.record(time.monotonic() - t0):
+            self._m_chunk_halvings.inc()
         if frame.get("t") == "sync_changes":
             self._m_sync_sent_bytes.inc(nbytes or 0)
             self._m_sync_sent.inc(len(frame.get("changes", ())))

@@ -352,6 +352,21 @@ class Swim:
             addr = tuple(msg["from_addr"])
             if self.members.apply_update(frm, addr, ALIVE, msg.get("inc", 0)):
                 self.queue_rumor(frm, addr, ALIVE, msg.get("inc", 0))
+            updates = self._piggyback()
+            m = self.members.states.get(frm)
+            if m is not None and m.state != ALIVE:
+                # Suspicion feedback (the announce handler's about_frm
+                # rule, applied to pings): a ping from a peer we believe
+                # SUSPECT/DOWN is refused by incarnation precedence, so
+                # without telling the pinger what we believe about IT the
+                # peer pings forever without learning it must refute —
+                # and a healed partition never heals the membership.
+                # The suspect rumor's own retransmission budget is spent
+                # long before a multi-second partition clears; this
+                # feedback is deterministic, not budget-gated.
+                updates.append(
+                    Rumor(frm, m.addr, m.state, m.incarnation, 1).wire()
+                )
             await self.send(
                 addr,
                 {
@@ -360,7 +375,7 @@ class Swim:
                     "seq": msg["seq"],
                     "from": self.members.self_id,
                     "from_addr": list(self.self_addr),
-                    "updates": self._piggyback(),
+                    "updates": updates,
                 },
             )
         elif kind == "ack":

@@ -36,6 +36,23 @@ class TestAgent:
     async def stop(self) -> None:
         await self.agent.stop()
 
+    async def hard_kill(self) -> None:
+        """SIGKILL semantics: no graceful leave, no final flushes — see
+        Agent.abort. The TestAgent keeps its cfg and last-known addrs so
+        :func:`relaunch_test_agent` can resurrect it in place."""
+        await self.agent.abort()
+
+
+async def _launch_from_cfg(cfg: AgentConfig, subs: bool = True) -> TestAgent:
+    agent = Agent(cfg)
+    if subs:
+        from corrosion_tpu.agent.subs import SubsManager
+
+        agent.subs = SubsManager(agent.store)
+    await agent.start()
+    host, port = agent.api_addr
+    return TestAgent(agent=agent, client=CorrosionApiClient(host, port))
+
 
 async def launch_test_agent(
     data_dir: str,
@@ -50,14 +67,43 @@ async def launch_test_agent(
         schema_sql=schema,
         **cfg_overrides,
     )
-    agent = Agent(cfg)
-    if subs:
-        from corrosion_tpu.agent.subs import SubsManager
+    return await _launch_from_cfg(cfg, subs=subs)
 
-        agent.subs = SubsManager(agent.store)
-    await agent.start()
-    host, port = agent.api_addr
-    return TestAgent(agent=agent, client=CorrosionApiClient(host, port))
+
+async def hard_kill(ta: TestAgent) -> None:
+    """Module-level alias for :meth:`TestAgent.hard_kill` (crash-recovery
+    scenarios read better as ``await hard_kill(victim)``)."""
+    await ta.agent.abort()
+
+
+async def relaunch_test_agent(
+    ta: TestAgent,
+    bootstrap: list[tuple[str, int]] | None = None,
+    subs: bool = True,
+    **cfg_overrides,
+) -> TestAgent:
+    """Restart a (hard-)killed agent on the SAME data_dir, gossip port,
+    and API port — the crash-recovery path every chaos scenario needs:
+    clients and subscription pumps reconnect to the address they already
+    hold, and the store/bookkeeping rehydrate from whatever the previous
+    life persisted. ``bootstrap`` defaults to the previous life's list
+    (pass a live peer when the original seed may itself be dead)."""
+    import dataclasses
+
+    old = ta.agent.cfg
+    gossip = ta.agent.gossip_addr
+    api = ta.agent.api_addr
+    cfg = dataclasses.replace(
+        old,
+        gossip_port=gossip[1] if gossip else old.gossip_port,
+        api_port=api[1] if api else old.api_port,
+        bootstrap=(
+            [tuple(a) for a in bootstrap]
+            if bootstrap is not None else list(old.bootstrap)
+        ),
+        **cfg_overrides,
+    )
+    return await _launch_from_cfg(cfg, subs=subs)
 
 
 async def launch_test_cluster(

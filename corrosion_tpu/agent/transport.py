@@ -212,27 +212,48 @@ async def read_frame(reader: asyncio.StreamReader) -> dict | None:
 
 
 class Breaker:
-    """Per-peer circuit breaker state (see module docstring)."""
+    """Per-peer circuit breaker state (see module docstring). The
+    threshold/cooldown schedule is instance-configurable so chaos
+    harnesses can compress the cooldown into test time; defaults are
+    the module constants."""
 
-    __slots__ = ("fails", "open_until")
+    __slots__ = ("fails", "open_until", "threshold", "base_s", "max_s")
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        threshold: int = BREAKER_THRESHOLD,
+        base_s: float = BREAKER_BASE_S,
+        max_s: float = BREAKER_MAX_S,
+    ) -> None:
         self.fails = 0
         self.open_until = 0.0
+        self.threshold = threshold
+        self.base_s = base_s
+        self.max_s = max_s
 
     def available(self) -> bool:
         return time.monotonic() >= self.open_until
 
-    def ok(self) -> None:
+    def ok(self) -> bool:
+        """Reset on success. Returns True when this closed a previously
+        tripped breaker (a recovery — the observability counterpart of
+        the trip edge)."""
+        recovered = self.fails >= self.threshold
         self.fails = 0
         self.open_until = 0.0
+        return recovered
 
-    def fail(self) -> None:
+    def fail(self) -> bool:
+        """Record a failure. Returns True on an available→open edge (a
+        trip) — including a re-trip after a cooldown expired — so the
+        caller can count trips without re-deriving the transition."""
+        tripped = self.fails + 1 >= self.threshold and self.available()
         self.fails += 1
-        if self.fails >= BREAKER_THRESHOLD:
-            over = self.fails - BREAKER_THRESHOLD
-            cooldown = min(BREAKER_BASE_S * (2.0 ** over), BREAKER_MAX_S)
+        if self.fails >= self.threshold:
+            over = self.fails - self.threshold
+            cooldown = min(self.base_s * (2.0 ** over), self.max_s)
             self.open_until = time.monotonic() + cooldown
+        return tripped
 
 
 class _DatagramPlane(asyncio.DatagramProtocol):
@@ -312,10 +333,22 @@ class Transport:
         connect_timeout: float = 3.0,
         send_timeout: float = 5.0,
         metrics=None,
+        netem=None,
+        breaker_threshold: int = BREAKER_THRESHOLD,
+        breaker_base_s: float = BREAKER_BASE_S,
+        breaker_max_s: float = BREAKER_MAX_S,
     ) -> None:
         self._pool: dict[tuple[str, int], tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
         self._locks: dict[tuple[str, int], asyncio.Lock] = {}
         self._breakers: dict[tuple[str, int], Breaker] = {}
+        # ACCEPTED connections, tracked so close() kills them too. An
+        # asyncio server's close() only stops LISTENING; in-process the
+        # event loop would keep serving already-accepted peers of a
+        # "dead" agent forever — peers' pooled sends would keep
+        # succeeding against a corpse, which no real process death
+        # allows (and which kept the circuit breaker from ever seeing
+        # the crash in the chaos harness).
+        self._accepted: set[asyncio.StreamWriter] = set()
         self._server: asyncio.AbstractServer | None = None
         self._udp: asyncio.DatagramTransport | None = None
         self._client_udp: list[asyncio.DatagramTransport] = []
@@ -325,6 +358,12 @@ class Transport:
         # Blocking-send abort (the reference aborts a sync send blocked
         # > 5 s, peer.rs:352-355; same guard here for any frame send).
         self.send_timeout = send_timeout
+        # Deterministic impairment shim (agent/netem.py); None = the
+        # bit-identical unimpaired path (a single branch per operation).
+        self._netem = netem
+        self._breaker_threshold = breaker_threshold
+        self._breaker_base_s = breaker_base_s
+        self._breaker_max_s = breaker_max_s
         # Aggregate transport metrics (Transport::emit_metrics,
         # transport.rs:225+): frames/datagrams/bytes both ways, pooled
         # connections, open breakers.
@@ -361,6 +400,18 @@ class Transport:
             "breakers_open": registry.gauge(
                 "corro_peer_breakers_open", "peers with an open circuit breaker"
             ),
+            # Trip/recovery EDGES, per peer: the open-breaker gauge shows
+            # the steady state but a trip that opens and cools down
+            # between scrapes was invisible — the host chaos harness
+            # asserts on these to prove the defense actually fired.
+            "breaker_trips": registry.counter(
+                "corro_peer_breaker_trips_total",
+                "circuit-breaker open transitions, by peer addr",
+            ),
+            "breaker_recoveries": registry.counter(
+                "corro_peer_breaker_recoveries_total",
+                "circuit-breaker recoveries (first success after a trip)",
+            ),
         }
 
     def _count(self, key: str, n: int = 1) -> None:
@@ -379,8 +430,24 @@ class Transport:
     def breaker(self, addr: tuple[str, int]) -> Breaker:
         br = self._breakers.get(addr)
         if br is None:
-            br = self._breakers[addr] = Breaker()
+            br = self._breakers[addr] = Breaker(
+                threshold=self._breaker_threshold,
+                base_s=self._breaker_base_s,
+                max_s=self._breaker_max_s,
+            )
         return br
+
+    def _breaker_fail(self, addr: tuple[str, int], br: Breaker) -> None:
+        """One failed operation: breaker bookkeeping + the failure/trip
+        counters (shared by frame sends and session opens)."""
+        if br.fail() and self._m is not None:
+            self._m["breaker_trips"].inc(addr=f"{addr[0]}:{addr[1]}")
+        self._count("send_failures")
+        self._sample_gauges()
+
+    def _breaker_ok(self, addr: tuple[str, int], br: Breaker) -> None:
+        if br.ok() and self._m is not None:
+            self._m["breaker_recoveries"].inc(addr=f"{addr[0]}:{addr[1]}")
 
     # -- outbound ------------------------------------------------------------
 
@@ -396,6 +463,14 @@ class Transport:
         if len(body) > MAX_DATAGRAM:
             return False
         sock = self._client_udp[hash(addr) % len(self._client_udp)]
+        if self._netem is not None:
+            v = self._netem.udp_fault(addr)
+            if v.drop:
+                # Lost in the (simulated) network: the sender cannot
+                # tell, exactly like a real dropped datagram.
+                return True
+            if v.delay_s > 0.0 or v.dup:
+                return self._udp_send_impaired(sock, body, addr, v)
         try:
             sock.sendto(body, addr)
             self._count("datagrams_sent")
@@ -403,6 +478,44 @@ class Transport:
             return True
         except OSError:
             return False
+
+    def _udp_send_impaired(self, sock, body, addr, v) -> bool:
+        """Delayed/duplicated datagram emission: late sends are
+        scheduled, so unequal jitter across packets reorders them on the
+        wire like a real WAN path would. Counters tick at the ACTUAL
+        send, never for scheduled copies that die with the socket."""
+        copies = 2 if v.dup else 1
+        if v.delay_s > 0.0:
+            def emit() -> None:
+                # A delayed send may fire after the transport closed
+                # (agent stop/crash mid-jitter): a late datagram into a
+                # closed socket is just a lost packet, never an error.
+                if sock.is_closing():
+                    return
+                try:
+                    sock.sendto(body, addr)
+                except Exception:
+                    return
+                self._count("datagrams_sent")
+                self._count("bytes_sent", len(body))
+
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return False
+            for _ in range(copies):
+                loop.call_later(v.delay_s, emit)
+            return True  # in flight; a WAN sender can't know its fate
+        sent = False
+        for _ in range(copies):
+            try:
+                sock.sendto(body, addr)
+            except OSError:
+                continue  # same contract as the unimpaired path
+            sent = True
+            self._count("datagrams_sent")
+            self._count("bytes_sent", len(body))
+        return sent
 
     async def send_packet(self, addr: tuple[str, int], msg: dict) -> bool:
         """SWIM packet send: datagram when possible, stream fallback for
@@ -418,6 +531,18 @@ class Transport:
         br = self.breaker(addr)
         if not br.available():
             return False
+        if self._netem is not None:
+            v = self._netem.stream_fault("bcast", addr)
+            if v.drop:
+                return True  # frame vanished in the impaired network
+            if v.block_s is not None:
+                # Cut link: burn the dial stall, then take the normal
+                # failure path — exactly what feeds the breaker.
+                await asyncio.sleep(v.block_s)
+                self._breaker_fail(addr, br)
+                return False
+            if v.delay_s > 0.0:
+                await asyncio.sleep(v.delay_s)
         lock = self._locks.setdefault(addr, asyncio.Lock())
         async with lock:
             if not br.available():
@@ -428,16 +553,14 @@ class Transport:
                     frame = encode_frame(msg)
                     writer.write(frame)
                     await asyncio.wait_for(writer.drain(), self.send_timeout)
-                    br.ok()
+                    self._breaker_ok(addr, br)
                     self._count("frames_sent")
                     self._count("bytes_sent", len(frame))
                     self._sample_gauges()
                     return True
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     self._drop(addr)
-        br.fail()
-        self._count("send_failures")
-        self._sample_gauges()
+        self._breaker_fail(addr, br)
         return False
 
     async def open_session(
@@ -447,6 +570,14 @@ class Transport:
         br = self.breaker(addr)
         if not br.available():
             return None
+        if self._netem is not None:
+            v = self._netem.stream_fault("sync", addr)
+            if v.block_s is not None:
+                await asyncio.sleep(v.block_s)
+                self._breaker_fail(addr, br)
+                return None
+            if v.delay_s > 0.0:
+                await asyncio.sleep(v.delay_s)
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(*addr, ssl=self._ssl_client), timeout
@@ -454,12 +585,15 @@ class Transport:
             frame = encode_frame(first)
             writer.write(frame)
             await writer.drain()
-            br.ok()
+            self._breaker_ok(addr, br)
             self._count("frames_sent")
             self._count("bytes_sent", len(frame))
-            return Session(reader, writer, counter=self._count)
+            return Session(
+                reader, writer, counter=self._count,
+                netem=self._netem, peer=addr,
+            )
         except (ConnectionError, OSError, asyncio.TimeoutError):
-            br.fail()
+            self._breaker_fail(addr, br)
             return None
 
     async def _conn(self, addr, fresh=False):
@@ -495,7 +629,16 @@ class Transport:
         only); if the UDP bind fails, gossip degrades to stream-only."""
 
         async def on_conn(reader, writer):
-            session = Session(reader, writer, counter=self._count)
+            # Inbound sessions stream sync replies back to the dialer;
+            # their peer is an ephemeral client port the shim cannot
+            # name, so netem impairment on them matches wildcard-link
+            # components only (documented in agent/netem.py).
+            session = Session(
+                reader, writer, counter=self._count,
+                netem=self._netem,
+                peer=writer.get_extra_info("peername"),
+            )
+            self._accepted.add(writer)
             try:
                 while True:
                     msg = await read_frame(reader)
@@ -508,6 +651,7 @@ class Transport:
             except ValueError:
                 pass  # malformed frame: drop the connection cleanly
             finally:
+                self._accepted.discard(writer)
                 session.close()
 
         self._server = await asyncio.start_server(
@@ -546,6 +690,12 @@ class Transport:
     def close(self) -> None:
         for addr in list(self._pool):
             self._drop(addr)
+        for w in list(self._accepted):
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._accepted.clear()
         if self._udp is not None:
             self._udp.close()
         for t in self._client_udp:
@@ -563,13 +713,27 @@ class Session:
 
     def __init__(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
-        counter=None,
+        counter=None, netem=None, peer=None,
     ):
         self.reader = reader
         self.writer = writer
         self._count = counter or (lambda key, n=1: None)
+        # Session sends are the sync plane's wire surface (the only
+        # streaming exchange): the netem shim paces them with "sync"
+        # delay components — which is exactly what the adaptive chunker
+        # and the blocking-send stall guard observe — and a cut link
+        # fails them after the stall.
+        self._netem = netem
+        self._peer = peer
 
     async def send(self, msg: dict) -> int:
+        if self._netem is not None and self._peer is not None:
+            v = self._netem.stream_fault("sync", self._peer)
+            if v.block_s is not None:
+                await asyncio.sleep(v.block_s)
+                raise ConnectionError("netem: sync link cut")
+            if v.delay_s > 0.0:
+                await asyncio.sleep(v.delay_s)
         frame = encode_frame(msg)
         self.writer.write(frame)
         await self.writer.drain()

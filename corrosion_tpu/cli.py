@@ -286,6 +286,38 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     crp.add_argument("repro", help="chaos repro JSON path")
 
+    # Host chaos plane (corrosion_tpu/hostchaos + agent/netem.py,
+    # docs/CHAOS.md "Host plane"): deterministic WAN impairment against
+    # real agents, crash/restart scenarios, post-heal invariants, and
+    # mechanical machinery-fired assertions.
+    hc = add("hostchaos", help="host-plane chaos: WAN fault injection, "
+             "crash/restart, machinery-fired proof")
+    hc_sub = hc.add_subparsers(dest="hostchaos_cmd", required=True)
+
+    hcl = hc_sub.add_parser(
+        "list", parents=[common], help="list the standing host scenarios"
+    )
+    hcl.add_argument("--json", action="store_true")
+
+    hcr = hc_sub.add_parser(
+        "run", parents=[common],
+        help="run a standing scenario (real loopback agents + netem + "
+        "oracle + post-heal invariants); exit 1 on any failure",
+    )
+    hcr.add_argument("scenario", help="scenario name (hostchaos list)")
+    hcr.add_argument("--seed", type=int, default=0)
+    hcr.add_argument("--dir", default=None,
+                     help="data dir (default: a fresh tempdir)")
+    hcr.add_argument("--out", default=None, help="report JSON path")
+    hcr.add_argument("--json", action="store_true")
+
+    hcp = hc_sub.add_parser(
+        "replay", parents=[common],
+        help="verify a report's impairment schedule replays identically "
+        "from its (plan, seed) — the determinism contract",
+    )
+    hcp.add_argument("report", help="hostchaos run report JSON path")
+
     # Static-analysis plane (corrosion_tpu/analysis, docs/ANALYSIS.md):
     # kernel-purity + schema-parity + concurrency lints, and the
     # strict-dtype/debug-nans/retrace sanitizer.
@@ -463,6 +495,8 @@ async def _dispatch(args, cfg: Config) -> int:
         return _obs(args)
     if args.command == "chaos":
         return _chaos(args)
+    if args.command == "hostchaos":
+        return await _hostchaos(args)
     if args.command == "loadgen":
         return await _loadgen(args)
     if args.command == "fidelity":
@@ -537,6 +571,92 @@ async def _dispatch(args, cfg: Config) -> int:
 
         await run_consul_sync(cfg)
         return 0
+    return 2
+
+
+async def _hostchaos(args) -> int:
+    """`corrosion hostchaos {list,run,replay}` — the host chaos plane
+    (docs/CHAOS.md "Host plane"). Exit 0 = green, 1 = a failed
+    invariant / idle machinery / schedule mismatch."""
+    import tempfile
+
+    from corrosion_tpu.hostchaos import SCENARIOS, get_scenario, run_scenario
+    from corrosion_tpu.hostchaos.harness import verify_schedule_determinism
+
+    if args.hostchaos_cmd == "list":
+        if args.json:
+            print(json.dumps({
+                name: {
+                    "summary": SCENARIOS[name]().summary(),
+                    "notes": SCENARIOS[name]().notes,
+                }
+                for name in sorted(SCENARIOS)
+            }, indent=2))
+            return 0
+        for name in sorted(SCENARIOS):
+            spec = SCENARIOS[name]()
+            print(f"{name:16s} {spec.summary()}")
+            print(f"{'':16s}   {spec.notes}")
+        return 0
+
+    if args.hostchaos_cmd == "run":
+        try:
+            spec = get_scenario(args.scenario)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if args.dir:
+            report = await run_scenario(
+                spec, args.dir, seed=args.seed, progress=sys.stderr
+            )
+        else:
+            with tempfile.TemporaryDirectory() as tmp:
+                report = await run_scenario(
+                    spec, tmp, seed=args.seed, progress=sys.stderr
+                )
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+            print(f"wrote {args.out}", file=sys.stderr)
+        if args.json:
+            slim = dict(report)
+            if slim.get("netem"):
+                slim["netem"] = {
+                    "seed": slim["netem"]["seed"],
+                    "agents": {
+                        k: {kk: vv for kk, vv in v.items() if kk != "trace"}
+                        for k, v in slim["netem"]["agents"].items()
+                    },
+                }
+            print(json.dumps(slim, indent=1))
+        else:
+            print(
+                f"{report['scenario']}: "
+                f"{'OK' if report['ok'] else 'FAILED'} — "
+                f"oracle violations={report['oracle']['violations']}, "
+                f"converged={report['converged']}, "
+                f"machinery={report['machinery']}"
+            )
+            for f_ in report["failures"]:
+                print(f"  FAIL: {f_}")
+        return 0 if report["ok"] else 1
+
+    if args.hostchaos_cmd == "replay":
+        with open(args.report) as f:
+            report = json.load(f)
+        ok, problems = verify_schedule_determinism(report)
+        if ok:
+            agents = sorted((report.get("netem") or {})
+                            .get("agents", {}))
+            print(
+                f"schedule replay OK: seed {report.get('seed')} "
+                f"reproduces every recorded decision on {agents}"
+            )
+            return 0
+        print("schedule replay MISMATCH:", file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
     return 2
 
 

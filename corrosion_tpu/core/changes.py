@@ -63,7 +63,17 @@ class AdaptiveChunker:
         self.max_bytes = max_bytes
         self.min_bytes = min_bytes
         self.threshold_s = threshold_s
+        self.halvings = 0
 
-    def record(self, send_seconds: float) -> None:
+    def record(self, send_seconds: float) -> bool:
+        """Feed one send duration. Returns True when the chunk target
+        actually halved (already-at-floor slow sends don't count — the
+        defense has no smaller step left to take), so the caller can
+        surface halvings as a counter."""
         if send_seconds > self.threshold_s:
-            self.max_bytes = max(self.min_bytes, self.max_bytes // 2)
+            new = max(self.min_bytes, self.max_bytes // 2)
+            if new < self.max_bytes:
+                self.max_bytes = new
+                self.halvings += 1
+                return True
+        return False

@@ -287,13 +287,32 @@ class SubscriptionPump:
             return True
         return False
 
-    async def stop(self) -> None:
+    def request_stop(self) -> None:
+        """Synchronously mark the pump stopping and cut its stream, so a
+        teardown path can pre-mark EVERY pump before awaiting the batched
+        ``stop()``s — a pump whose ``async for`` breaks after the mark
+        exits instead of spending reconnect retries against a stopping
+        cluster."""
         self._stopping = True
         if self.stream is not None:
             self.stream.close()
+
+    async def stop(self) -> None:
+        self.request_stop()
         if self._task is not None:
             try:
                 await asyncio.wait_for(self._task, 5.0)
             except (asyncio.TimeoutError, asyncio.CancelledError):
                 self._task.cancel()
         self._task = None
+
+
+async def stop_pumps(pumps: list["SubscriptionPump"]) -> None:
+    """Tear down a fleet of pumps: pre-mark EVERY pump stopping (so none
+    spends reconnect retries against a stopping cluster), then await the
+    stops in bounded batches — the one teardown shared by the loadgen
+    scenarios and the host chaos harness."""
+    for p in pumps:
+        p.request_stop()
+    for base in range(0, len(pumps), 256):
+        await asyncio.gather(*(p.stop() for p in pumps[base:base + 256]))

@@ -134,6 +134,12 @@ class FanoutOracle:
             self.lag_hist.observe(lag)
             self.lag_max_s = max(self.lag_max_s, lag)
 
+    def committed(self) -> dict:
+        """Acked commits as ``{key: payload}`` — the ground-truth row
+        set a converged cluster must contain (the host chaos harness's
+        serial-merge analogue)."""
+        return {c.key: c.payload for c in self._commits.values()}
+
     # -- subscription side ---------------------------------------------------
 
     def attach_stream(

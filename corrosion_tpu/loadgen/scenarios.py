@@ -30,7 +30,11 @@ import resource
 import time
 
 from corrosion_tpu.agent.testing import launch_test_cluster, stop_cluster
-from corrosion_tpu.loadgen.harness import LoadHarness, SubscriptionPump
+from corrosion_tpu.loadgen.harness import (
+    LoadHarness,
+    SubscriptionPump,
+    stop_pumps,
+)
 from corrosion_tpu.loadgen.oracle import FanoutOracle
 from corrosion_tpu.loadgen.pgread import PgReadClient
 from corrosion_tpu.loadgen.report import serving_context
@@ -261,14 +265,7 @@ async def fanout_storm(
             pg_client.close()
         if pg_server is not None:
             pg_server.close()
-        for p in pumps:
-            p._stopping = True
-            if p.stream is not None:
-                p.stream.close()
-        for base in range(0, len(pumps), 256):
-            await asyncio.gather(
-                *(p.stop() for p in pumps[base:base + 256])
-            )
+        await stop_pumps(pumps)
         await _stop_cluster(agents)
 
 

@@ -1,0 +1,156 @@
+"""CI host-chaos smoke gate: WAN steady-state + SIGKILL-restart vs a
+budget (docs/CHAOS.md "Host plane").
+
+Runs the two CI-scale standing scenarios — ``wan_steady`` (80 ms RTT ±
+jitter + 1 % loss over real loopback agents, oracle-checked fan-out) and
+``kill_restart`` (SIGKILL mid-storm, same-dir restart, durable-sub
+resume) — emits ONE self-describing report
+(``hostchaos.report.emit_hostchaos_report``), writes it as a JSON
+artifact, and exits 1 when the ``hostchaos`` entry of bench_budget.json
+is breached:
+
+- any fan-out-oracle violation — never tolerance-scaled;
+- a scenario whose REQUIRED defensive machinery never fired (the
+  mechanical "the defenses actually engaged" proof) — never
+  tolerance-scaled;
+- failed post-heal invariants (CRDT agreement, bookkeeping contiguity,
+  convergence) — never tolerance-scaled;
+- a drain/convergence wall-time ceiling (tolerance-scaled).
+
+The long flap/partition soak (``flap_soak``) and the full acceptance
+scenario (``wan_full``) are slow-marked pytest territory (the chaos CI
+job), not this gate.
+
+Usage:
+    python scripts/hostchaos_smoke.py [--out report.json] [--budget FILE]
+    python scripts/hostchaos_smoke.py --update   # refresh the budget entry
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+import argparse
+import asyncio
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+SCENARIOS = ("wan_steady", "kill_restart")
+SEED = 0
+UPDATE_HEADROOM = 3.0
+# Floor for --update: a fast loopback drain must not make any later
+# nonzero drain a breach.
+UPDATE_FLOOR_S = 10.0
+
+CEILING_PATHS = tuple(
+    f"scenarios.{name}.{key}"
+    for name in SCENARIOS
+    for key in ("drain_s", "convergence_s")
+)
+
+
+async def measure(progress) -> dict:
+    from corrosion_tpu.hostchaos import get_scenario, run_scenario
+    from corrosion_tpu.hostchaos.report import (
+        emit_hostchaos_report,
+        hostchaos_context,
+    )
+
+    blocks: dict[str, dict] = {}
+    for name in SCENARIOS:
+        spec = get_scenario(name)
+        with tempfile.TemporaryDirectory() as d:
+            blocks[name] = await run_scenario(
+                spec, d, seed=SEED, progress=progress
+            )
+    nodes = max(b["agents"] for b in blocks.values())
+    report = {
+        **hostchaos_context(nodes, *SCENARIOS, SEED),
+        "seed": SEED,
+        "scenarios": blocks,
+    }
+    return emit_hostchaos_report(report)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None, help="report JSON path")
+    ap.add_argument(
+        "--budget", default=str(Path(__file__).parent.parent
+                                / "bench_budget.json")
+    )
+    ap.add_argument(
+        "--update", action="store_true",
+        help="rewrite the budget's `hostchaos` entry from this "
+        "measurement with x3 headroom",
+    )
+    args = ap.parse_args()
+
+    report = asyncio.run(measure(sys.stderr))
+
+    from corrosion_tpu.hostchaos.report import check_hostchaos_budget
+    from corrosion_tpu.sim import benchlib
+
+    budget_path = Path(args.budget)
+    budget_all = json.loads(budget_path.read_text())
+
+    if args.update:
+        entry = {
+            "platform": report["platform"],
+            "scenario": "host_chaos_smoke",
+            "scenarios": list(SCENARIOS),
+            "tolerance": 3.0,
+            "ceilings_s": {
+                p: round(
+                    max(
+                        float(benchlib.get_path(report, p) or 0.0)
+                        * UPDATE_HEADROOM,
+                        UPDATE_FLOOR_S,
+                    ), 1,
+                )
+                for p in CEILING_PATHS
+            },
+            "oracle_violations_max": 0,
+            "require_machinery_fired": True,
+            "require_converged": True,
+        }
+        budget_all["hostchaos"] = entry
+        budget_path.write_text(json.dumps(budget_all, indent=2) + "\n")
+        print(f"refreshed `hostchaos` entry in {budget_path}")
+
+    budget = budget_all.get("hostchaos")
+    if budget is None:
+        print("bench_budget.json has no `hostchaos` entry "
+              "(run with --update)", file=sys.stderr)
+        return 2
+    ok, breaches = check_hostchaos_budget(report, budget)
+    report["budget_gate"] = {"ok": ok, "breaches": breaches}
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+    for name in SCENARIOS:
+        blk = report["scenarios"][name]
+        print(
+            f"{name}: ok={blk['ok']} violations="
+            f"{blk['oracle']['violations']} machinery={blk['machinery']} "
+            f"drain={blk['drain_s']}s"
+        )
+    if not ok:
+        print("HOSTCHAOS BUDGET BREACHED:", file=sys.stderr)
+        for b in breaches:
+            print(f"  {b}", file=sys.stderr)
+        return 1
+    print("hostchaos gate ok=true breaches=[]")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

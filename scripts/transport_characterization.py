@@ -33,6 +33,14 @@ calibrate --from-characterization`` derives a round model from the
 under-bulk probe percentiles and loss tail
 (``fidelity.calibrate.from_characterization``) — which is why its
 provenance is now held to the same standard as the outputs it feeds.
+
+``--netem wan80`` re-runs the whole characterization under the
+deterministic impairment shim (agent/netem.py): 40 ms one-way delay ±
+8 ms jitter on every plane + 1 % probe/bcast loss — an ~80 ms-RTT WAN
+instead of clean loopback. The emitted artifact (scenario
+``transport_characterization_wan80``) feeds ``fidelity calibrate`` a
+genuinely-impaired RoundModel (docs/FIDELITY.md "Impaired calibration"),
+closing the "calibration inputs are loopback RTTs" gap.
 """
 
 from __future__ import annotations
@@ -40,6 +48,7 @@ from __future__ import annotations
 import os as _os, sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
+import argparse
 import asyncio
 import json
 import tempfile
@@ -53,6 +62,24 @@ SCHEMA = (
     "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY,"
     " text TEXT NOT NULL DEFAULT '')"
 )
+
+NETEM_SEED = 0
+
+
+def wan80_plan() -> dict:
+    """The standing impaired-characterization plan: ~80 ms RTT + 1% loss."""
+    from corrosion_tpu.agent.netem import HostFault, HostFaultPlan
+
+    return HostFaultPlan(
+        name="wan80",
+        faults=(
+            HostFault(kind="delay", delay_ms=40.0, jitter_ms=8.0),
+            HostFault(kind="loss", prob=0.01, planes=("probe", "bcast")),
+        ),
+    ).to_json_obj()
+
+
+NETEM_PLANS = {"none": None, "wan80": wan80_plan}
 
 
 async def sample_probe_rtts(a, peer_addr, n=60, gap=0.02):
@@ -68,9 +95,27 @@ async def sample_probe_rtts(a, peer_addr, n=60, gap=0.02):
 
 
 async def main() -> None:
-    rows = int(_sys.argv[1]) if len(_sys.argv) > 1 else 20_000
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("rows", nargs="?", type=int, default=20_000)
+    ap.add_argument(
+        "--netem", choices=sorted(NETEM_PLANS), default="none",
+        help="run under a deterministic impairment plan (agent/netem.py)",
+    )
+    args = ap.parse_args()
+    rows = args.rows
+    plan = NETEM_PLANS[args.netem]
+    netem_kw: dict = {}
+    scenario = "transport_characterization"
+    if plan is not None:
+        plan = plan()
+        scenario = f"transport_characterization_{args.netem}"
     with tempfile.TemporaryDirectory() as d:
-        a = await launch_test_agent(d + "/a", schema=SCHEMA)
+        if plan is not None:
+            netem_kw = dict(netem_plan=plan, netem_seed=NETEM_SEED)
+        a = await launch_test_agent(
+            d + "/a", schema=SCHEMA,
+            **({**netem_kw, "netem_node": "a"} if plan is not None else {}),
+        )
         # Seed A BEFORE B exists: B's whole catch-up must flow through
         # the anti-entropy sync plane (pooled TCP), not live broadcast.
         t0 = time.perf_counter()
@@ -84,8 +129,16 @@ async def main() -> None:
             )
         seed_s = time.perf_counter() - t0
         b = await launch_test_agent(
-            d + "/b", schema=SCHEMA, bootstrap=[a.gossip_addr]
+            d + "/b", schema=SCHEMA, bootstrap=[a.gossip_addr],
+            **({**netem_kw, "netem_node": "b"} if plan is not None else {}),
         )
+        if plan is not None:
+            # Both directions impaired: a's shim delays pings/frames
+            # toward b, b's shim the replies — 2x one-way = the RTT.
+            a.agent.netem.register_peer(b.gossip_addr, "b")
+            b.agent.netem.register_peer(a.gossip_addr, "a")
+            a.agent.netem.arm()
+            b.agent.netem.arm()
         try:
             await poll_until(
                 lambda: asyncio.sleep(0, len(b.agent.members.alive()) > 0),
@@ -151,9 +204,11 @@ async def main() -> None:
 
             report = telemetry.check_bench_invariants({
                 **benchlib.bench_context(
-                    "transport_characterization", rows, a.agent.cfg.fanout,
+                    scenario, rows, a.agent.cfg.fanout,
                 ),
-                "scenario": "transport_characterization",
+                "scenario": scenario,
+                "netem": plan,
+                "netem_seed": NETEM_SEED if plan is not None else None,
                 "nodes": 2,
                 "rows": rows,
                 "seed_s": round(seed_s, 1),

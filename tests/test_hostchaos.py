@@ -1,0 +1,628 @@
+"""Host-plane chaos: the netem shim, the hardened defensive machinery,
+and the standing scenarios (docs/CHAOS.md "Host plane").
+
+Fast units pin the shim's determinism contract (same seed ⇒ identical
+fault schedule, mechanically replayable), the plan schema, the
+zero-impairment bit-identity promise, one-way blackhole asymmetry, and
+the Breaker/Backoff/AdaptiveChunker defense primitives the counters now
+make visible. The slow-marked tests launch real loopback clusters: the
+SIGKILL-rehydrate-reconnect regression and the standing scenarios up to
+the ``wan_full`` acceptance run (80 ms WAN + 1 % loss + partition-heal +
+SIGKILL-restart, zero oracle violations, all three defenses fired, seed
+replay identical) — they run unfiltered in the chaos CI job.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from corrosion_tpu.agent.netem import (
+    HostFault,
+    HostFaultPlan,
+    NetemShim,
+    PLAN_SCHEMA,
+    replay_schedule,
+)
+from corrosion_tpu.agent.testing import (
+    hard_kill,
+    launch_test_agent,
+    relaunch_test_agent,
+)
+from corrosion_tpu.agent.transport import Breaker, Transport
+from corrosion_tpu.core.changes import AdaptiveChunker
+from corrosion_tpu.utils.backoff import Backoff
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- plan schema --------------------------------------------------------------
+
+
+def test_plan_json_round_trip():
+    plan = HostFaultPlan(
+        name="rt",
+        faults=(
+            HostFault(kind="delay", delay_ms=40.0, jitter_ms=10.0),
+            HostFault(kind="loss", prob=0.02, planes=("probe", "bcast"),
+                      start_s=1.0, stop_s=5.0),
+            HostFault(kind="blackhole", src=("a",), dst=("b",),
+                      stall_s=0.2, start_s=2.0, stop_s=3.0),
+            HostFault(kind="partition", a=("n1",), one_way=True,
+                      start_s=0.5, stop_s=4.0),
+            HostFault(kind="flap", a=("n2",), b=("n0",), period_s=0.5,
+                      start_s=0.0, stop_s=8.0),
+            HostFault(kind="dup", prob=0.5, planes=("probe",)),
+            HostFault(kind="reorder", prob=0.25, extra_ms=80.0),
+        ),
+    )
+    again = HostFaultPlan.from_json(plan.to_json())
+    assert again == plan
+    obj = plan.to_json_obj()
+    assert obj["schema"] == PLAN_SCHEMA
+    with pytest.raises(ValueError, match="schema"):
+        HostFaultPlan.from_json({"schema": "corro-fault-plan/1"})
+    # JSON loading must not soften validation: a delay component whose
+    # document lacks delay_ms is an error, not a ~0 ms impairment.
+    with pytest.raises(ValueError, match="delay_ms"):
+        HostFaultPlan.from_json(
+            {"schema": PLAN_SCHEMA, "faults": [{"kind": "delay"}]}
+        )
+
+
+def test_plan_validation_rejects_nonsense():
+    with pytest.raises(ValueError, match="kind"):
+        HostFault(kind="gremlins", start_s=0)
+    with pytest.raises(ValueError, match="start_s"):
+        HostFault(kind="delay", delay_ms=1.0, start_s=5.0, stop_s=2.0)
+    # Loss on the sync stream is a category error: TCP doesn't lose
+    # frames — it gets slow (that's the delay kind's job).
+    with pytest.raises(ValueError, match="unsupported"):
+        HostFault(kind="loss", prob=0.1, planes=("sync",))
+    with pytest.raises(ValueError, match="period_s"):
+        HostFault(kind="flap", a=("n0",))
+    with pytest.raises(ValueError, match="side"):
+        HostFault(kind="partition")
+    with pytest.raises(ValueError, match="prob"):
+        HostFault(kind="loss", prob=1.5)
+    with pytest.raises(ValueError, match="negative"):
+        HostFault(kind="delay", delay_ms=5.0, jitter_ms=10.0)
+
+
+def test_plan_horizon():
+    always_on = HostFault(kind="delay", delay_ms=10.0)
+    windowed = HostFault(kind="partition", a=("n0",), start_s=1.0,
+                         stop_s=4.5)
+    assert HostFaultPlan(faults=(always_on,)).horizon_s() == 0.0
+    assert HostFaultPlan(faults=(always_on, windowed)).horizon_s() == 4.5
+
+
+# -- shim determinism ---------------------------------------------------------
+
+
+def _drive(shim: NetemShim, clock: list):
+    """A fixed event sequence through a shim with an injected clock."""
+    shim.register_peer(("10.0.0.2", 1), "n1")
+    shim.register_peer(("10.0.0.3", 1), "n2")
+    shim.arm()
+    for i in range(40):
+        clock[0] += 0.1
+        shim.udp_fault(("10.0.0.2", 1))
+        shim.stream_fault("bcast", ("10.0.0.3", 1))
+        if i % 3 == 0:
+            shim.stream_fault("sync", ("10.0.0.2", 1))
+
+
+def _mixed_plan() -> HostFaultPlan:
+    return HostFaultPlan(
+        name="mixed",
+        faults=(
+            HostFault(kind="delay", delay_ms=30.0, jitter_ms=10.0),
+            HostFault(kind="loss", prob=0.2, planes=("probe", "bcast")),
+            HostFault(kind="dup", prob=0.1, planes=("probe",)),
+            HostFault(kind="partition", a=("n2",), start_s=2.0,
+                      stop_s=3.0),
+        ),
+    )
+
+
+def test_same_seed_identical_schedule():
+    traces = []
+    for _ in range(2):
+        clock = [0.0]
+        shim = NetemShim(
+            _mixed_plan(), seed=7, local="n0", clock=lambda: clock[0]
+        )
+        _drive(shim, clock)
+        traces.append((shim.trace, shim.fingerprint()))
+    assert traces[0][1] == traces[1][1]
+    assert traces[0][0] == traces[1][0]
+    # And a different seed yields a different schedule (the loss/dup
+    # draws flip somewhere in 40 events at these probabilities).
+    clock = [0.0]
+    other = NetemShim(
+        _mixed_plan(), seed=8, local="n0", clock=lambda: clock[0]
+    )
+    _drive(other, clock)
+    assert other.fingerprint() != traces[0][1]
+
+
+def test_replay_schedule_verifies_and_detects_tamper():
+    clock = [0.0]
+    shim = NetemShim(
+        _mixed_plan(), seed=3, local="n0", clock=lambda: clock[0]
+    )
+    _drive(shim, clock)
+    assert shim.trace, "the fixed drive must produce impaired events"
+    ok, mismatches = replay_schedule(_mixed_plan(), 3, "n0", shim.trace)
+    assert ok, mismatches
+    # Tampering with one recorded decision must be caught.
+    tampered = [dict(e) for e in shim.trace]
+    tampered[5]["drop"] = not tampered[5]["drop"]
+    ok, mismatches = replay_schedule(_mixed_plan(), 3, "n0", tampered)
+    assert not ok and mismatches
+    # Structural corruption (component index outside the plan, missing
+    # keys) is a diagnosed mismatch, never a traceback.
+    corrupt = [dict(e) for e in shim.trace]
+    corrupt[0]["f"] = [99]
+    del corrupt[1]["plane"]
+    ok, mismatches = replay_schedule(_mixed_plan(), 3, "n0", corrupt)
+    assert not ok
+    assert sum("structurally invalid" in m for m in mismatches) == 2
+
+
+def test_shim_windows_wait_for_arm():
+    """Scheduled windows must not fire while the cluster is still
+    launching: before arm() only always-on components apply."""
+    clock = [10.0]  # construction-time origin far in the "past"
+    plan = HostFaultPlan(faults=(
+        HostFault(kind="partition", a=("n1",), start_s=0.0, stop_s=1e9),
+        HostFault(kind="delay", delay_ms=20.0),
+    ))
+    shim = NetemShim(plan, seed=0, local="n0", clock=lambda: clock[0])
+    shim.register_peer(("10.0.0.2", 1), "n1")
+    clock[0] = 500.0
+    v = shim.stream_fault("bcast", ("10.0.0.2", 1))
+    assert v.block_s is None and v.delay_s > 0  # delay yes, partition no
+    shim.arm()
+    clock[0] += 0.1
+    v = shim.stream_fault("bcast", ("10.0.0.2", 1))
+    assert v.block_s is not None
+
+
+def test_flap_half_cycles():
+    f = HostFault(kind="flap", a=("n0",), start_s=1.0, stop_s=5.0,
+                  period_s=1.0)
+    assert f.active_at(1.5)       # first half-cycle: cut
+    assert not f.active_at(2.5)   # second: up
+    assert f.active_at(3.5)
+    assert not f.active_at(0.5) and not f.active_at(5.5)
+    assert f.cuts("n0", "n1") and f.cuts("n1", "n0")
+    one_way = HostFault(kind="partition", a=("n0",), one_way=True,
+                        start_s=0.0, stop_s=1.0)
+    assert one_way.cuts("n0", "n1") and not one_way.cuts("n1", "n0")
+    # Unresolved peers never sit inside a partition side.
+    assert not f.cuts("n0", "?")
+
+
+def test_forced_loss_dup_delay_verdicts():
+    clock = [0.0]
+    plan = HostFaultPlan(faults=(
+        HostFault(kind="loss", prob=1.0, planes=("bcast",)),
+        HostFault(kind="dup", prob=1.0, planes=("probe",)),
+        HostFault(kind="delay", delay_ms=50.0, planes=("sync",)),
+    ))
+    shim = NetemShim(plan, seed=0, local="n0", clock=lambda: clock[0])
+    shim.register_peer(("h", 1), "n1")
+    shim.arm()
+    u = shim.udp_fault(("h", 1))
+    assert u.dup and not u.drop  # probe: duplicated, loss is bcast-only
+    assert shim.stream_fault("bcast", ("h", 1)).drop
+    assert shim.stream_fault("sync", ("h", 1)).delay_s == pytest.approx(
+        0.05
+    )
+    # dup/delay stay on their declared planes
+    assert shim.stream_fault("sync", ("h", 1)).drop is False
+    # Duplication is datagram-shaped: declaring it on a stream plane is
+    # a plan error, not a silent no-op.
+    with pytest.raises(ValueError, match="unsupported"):
+        HostFault(kind="dup", prob=0.5, planes=("bcast",))
+
+
+def test_empty_plan_is_disabled():
+    shim = NetemShim(HostFaultPlan(name="empty"), seed=0, local="n0")
+    assert not shim.enabled
+    assert HostFaultPlan.from_json(
+        HostFaultPlan(name="empty").to_json()
+    ).empty
+
+
+# -- zero-impairment bit-identity + one-way blackhole -------------------------
+
+
+async def _echo_transport(received: list):
+    t = Transport()
+
+    async def handler(_session, msg):
+        received.append(msg)
+
+    addr = await t.serve("127.0.0.1", 0, handler)
+    return t, addr
+
+
+def test_zero_impairment_transport_path_identical(tmp_path):
+    """A shim whose components never match the current window leaves
+    transport behavior and frame bytes identical — and records nothing."""
+
+    async def main():
+        received: list = []
+        server, addr = await _echo_transport(received)
+        msg = {"t": "bcast", "actor": "ff" * 16, "blob": b"\x01\x02"}
+
+        plain = Transport()
+        assert await plain.send_frame(addr, msg)
+
+        future_only = HostFaultPlan(faults=(
+            HostFault(kind="delay", delay_ms=500.0, start_s=1e6,
+                      stop_s=2e6),
+        ))
+        shim = NetemShim(future_only, seed=0, local="a")
+        shim.arm()
+        impaired = Transport(netem=shim)
+        t0 = time.monotonic()
+        assert await impaired.send_frame(addr, msg)
+        assert time.monotonic() - t0 < 0.4  # no delay applied
+        assert shim.trace == [] and shim.stats["events"] == 0
+
+        await asyncio.sleep(0.1)
+        assert len(received) == 2
+        assert received[0] == received[1] == msg  # byte-for-byte decode
+        plain.close()
+        impaired.close()
+        server.close()
+
+    run(main())
+
+
+def test_one_way_blackhole_asymmetry(tmp_path):
+    """The same plan installed on both endpoints cuts ONLY the a→b
+    direction: locality + the src/dst filter do the asymmetry."""
+
+    async def main():
+        recv_a: list = []
+        recv_b: list = []
+        ta, addr_a = await _echo_transport(recv_a)
+        tb, addr_b = await _echo_transport(recv_b)
+        plan = HostFaultPlan(faults=(
+            HostFault(kind="blackhole", src=("a",), dst=("b",),
+                      stall_s=0.05),
+        ))
+        shim_a = NetemShim(plan, seed=0, local="a")
+        shim_b = NetemShim(plan, seed=0, local="b")
+        ta._netem = shim_a
+        tb._netem = shim_b
+        shim_a.register_peer(addr_b, "b")
+        shim_b.register_peer(addr_a, "a")
+        shim_a.arm()
+        shim_b.arm()
+
+        # a -> b: cut, and repeated failures trip a's breaker for b.
+        for _ in range(ta._breaker_threshold):
+            assert not await ta.send_frame(addr_b, {"t": "x"})
+        assert not ta.breaker(addr_b).available()
+        # b -> a: same plan, same window — flows untouched.
+        assert await tb.send_frame(addr_a, {"t": "y"})
+        await asyncio.sleep(0.05)
+        assert recv_b == [] and recv_a == [{"t": "y"}]
+        ta.close()
+        tb.close()
+
+    run(main())
+
+
+# -- defense primitives -------------------------------------------------------
+
+
+def test_breaker_trip_edge_and_recovery():
+    br = Breaker(threshold=3, base_s=0.05, max_s=0.2)
+    assert br.fail() is False
+    assert br.fail() is False
+    assert br.fail() is True  # the closed->open edge, exactly once
+    assert br.fail() is False  # already open: no second trip edge
+    assert not br.available()
+    assert br.ok() is True  # recovery edge
+    assert br.ok() is False  # already closed: no second recovery
+    assert br.available() and br.fails == 0
+
+
+def test_breaker_cooldown_expiry_and_retrip():
+    br = Breaker(threshold=2, base_s=0.05, max_s=0.1)
+    assert not br.fail()
+    assert br.fail()  # trip, 0.05 s cooldown
+    assert not br.available()
+    time.sleep(0.12)
+    assert br.available()  # cooldown expired without a success
+    assert br.fail() is True  # failing again while cooled-down re-trips
+
+
+def test_breaker_success_resets_count():
+    br = Breaker(threshold=3)
+    br.fail()
+    br.fail()
+    br.ok()
+    assert br.fails == 0
+    assert br.fail() is False  # streak restarted: 1/3, no trip
+
+
+def test_backoff_growth_cap_and_stop():
+    b = Backoff(min_wait=1.0, max_wait=8.0, factor=2.0, jitter=False,
+                max_retries=5)
+    assert list(b) == [1.0, 2.0, 4.0, 8.0, 8.0]  # growth then cap
+    with pytest.raises(StopIteration):
+        next(b)
+    b.reset()
+    assert next(b) == 1.0
+
+
+def test_backoff_jitter_floor_and_seed_determinism():
+    waits1 = list(Backoff(min_wait=0.5, max_wait=60.0, seed=42,
+                          max_retries=50))
+    waits2 = list(Backoff(min_wait=0.5, max_wait=60.0, seed=42,
+                          max_retries=50))
+    assert waits1 == waits2  # injectable seed pins the jitter
+    assert all(w >= 0.5 for w in waits1)  # full jitter never dips below
+    assert all(w <= 60.0 for w in waits1)
+    assert waits1 != list(Backoff(min_wait=0.5, max_wait=60.0, seed=43,
+                                  max_retries=50))
+
+
+def test_backoff_on_wait_hook():
+    ticks: list[float] = []
+    b = Backoff(min_wait=1.0, jitter=False, max_retries=3,
+                on_wait=ticks.append)
+    list(b)
+    assert ticks == [1.0, 2.0, 4.0]
+
+
+def test_adaptive_chunker_halving_counter():
+    c = AdaptiveChunker(max_bytes=8192, min_bytes=1024, threshold_s=0.5)
+    assert c.record(0.4) is False  # fast send: no halving
+    assert c.record(0.6) is True and c.max_bytes == 4096
+    assert c.record(0.6) is True and c.max_bytes == 2048
+    assert c.record(0.6) is True and c.max_bytes == 1024
+    # At the floor a slow send has no smaller step left: NOT a halving.
+    assert c.record(0.6) is False and c.max_bytes == 1024
+    assert c.halvings == 3
+
+
+def test_counter_total_sums_labeled_series():
+    from corrosion_tpu.hostchaos.harness import _counter_total
+
+    snaps = [
+        {"corro_peer_breaker_trips_total{addr=\"h:1\"}": 2.0,
+         "corro_peer_breaker_trips_total{addr=\"h:2\"}": 1.0,
+         "corro_peer_breaker_trips_totally_not": 9.0},
+        {"corro_peer_breaker_trips_total": 4.0},
+    ]
+    assert _counter_total(snaps, "corro_peer_breaker_trips_total") == 7.0
+
+
+# -- crash-recovery regression (satellite 3) ---------------------------------
+
+
+@pytest.mark.slow
+def test_hard_kill_rehydrates_and_reconnect_replays_gap(tmp_path):
+    """SIGKILL (no graceful leave, no final flushes) + same-dir restart:
+    the bookie rehydrates from __corro_bookkeeping, and a client
+    SubscriptionStream.reconnect replays EXACTLY the missed gap —
+    oracle-clean, strictly monotonic change ids, no duplicates."""
+    from corrosion_tpu.loadgen.oracle import FanoutOracle
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"))
+        oracle = FanoutOracle()
+        sid = oracle.attach_stream()
+        stream = await a.client.subscribe("SELECT id, text FROM tests")
+
+        async def pull_until(pred, timeout=10.0):
+            async def go():
+                while True:
+                    ev = await stream.__anext__()
+                    if "change" in ev:
+                        _k, _rid, cells, cid = ev["change"]
+                        oracle.change(
+                            sid, _k, cells[0], tuple(cells[1:]), cid, 0.0
+                        )
+                    elif "row" in ev:
+                        _rid, cells = ev["row"]
+                        oracle.snapshot_row(sid, cells[0], tuple(cells[1:]))
+                    if pred(ev):
+                        return ev
+            return await asyncio.wait_for(go(), timeout)
+
+        await pull_until(lambda ev: "eoq" in ev)
+        oracle.snapshot_done(sid, 0.0)
+
+        async def write(client, i):
+            await client.execute(
+                [["INSERT INTO tests (id, text) VALUES (?, ?)",
+                  [i, f"w{i}"]]]
+            )
+            oracle.commit(i, (f"w{i}",), t_ack=0.0)
+
+        for i in range(3):
+            await write(a.client, i)
+        await pull_until(
+            lambda ev: "change" in ev and ev["change"][2][0] == 2
+        )
+        head_before = a.agent.bookie.for_actor(a.agent.actor_id).last()
+        assert head_before == 3
+
+        await hard_kill(a)
+        b = await relaunch_test_agent(a)
+        try:
+            # Same ports, rehydrated bookkeeping: the next local write
+            # continues the version sequence (no reuse, no gap).
+            assert b.agent.api_addr == a.agent.api_addr
+            assert b.agent.bookie.for_actor(
+                b.agent.actor_id
+            ).last() == head_before
+            for i in range(3, 6):
+                await write(b.client, i)
+            await stream.reconnect(retries=25)
+            await pull_until(
+                lambda ev: "change" in ev and ev["change"][2][0] == 5
+            )
+            rep = oracle.finish()
+            assert rep["violations"] == 0, rep["violation_examples"]
+            assert rep["missing"] == 0
+            assert stream.last_change_id == 6  # exactly the gap, no more
+        finally:
+            stream.close()
+            await b.stop()
+
+    run(main())
+
+
+# -- standing scenarios (chaos CI job territory) ------------------------------
+
+
+def _run_named(tmp_path, name: str, seed: int = 0) -> dict:
+    from corrosion_tpu.hostchaos import get_scenario, run_scenario
+
+    async def main():
+        return await run_scenario(
+            get_scenario(name), str(tmp_path), seed=seed
+        )
+
+    return run(main())
+
+
+@pytest.mark.slow
+def test_scenario_wan_steady(tmp_path):
+    rep = _run_named(tmp_path, "wan_steady")
+    assert rep["ok"], rep["failures"]
+    assert rep["oracle"]["violations"] == 0
+    assert rep["converged"] and rep["bookkeeping_contiguous"]
+    # The WAN was genuinely present: impairment events were decided.
+    stats = rep["netem"]["agents"]
+    assert all(blk["stats"]["delayed"] > 0 for blk in stats.values())
+
+
+@pytest.mark.slow
+def test_scenario_kill_restart(tmp_path):
+    rep = _run_named(tmp_path, "kill_restart")
+    assert rep["ok"], rep["failures"]
+    assert rep["machinery"]["breaker_trips"] >= 1
+    assert rep["oracle"]["reconnects"] >= 1  # durable subs resumed
+    assert rep["kill"]["agent"] == 0
+
+
+@pytest.mark.slow
+def test_scenario_link_flap(tmp_path):
+    rep = _run_named(tmp_path, "link_flap")
+    assert rep["ok"], rep["failures"]
+    assert rep["machinery"]["breaker_trips"] >= 1
+    assert rep["machinery"]["breaker_recoveries"] >= 1
+
+
+@pytest.mark.slow
+def test_scenario_partition_heal(tmp_path):
+    rep = _run_named(tmp_path, "partition_heal")
+    assert rep["ok"], rep["failures"]
+    for key in ("breaker_trips", "chunk_halvings", "stall_aborts"):
+        assert rep["machinery"][key] >= 1, (key, rep["machinery"])
+
+
+@pytest.mark.slow
+def test_wan_full_acceptance(tmp_path):
+    """ISSUE 14 acceptance: the seeded 80 ms-WAN + 1 %-loss +
+    partition-then-heal + SIGKILL-restart scenario completes with zero
+    fan-out-oracle violations, post-heal CRDT agreement, metrics proving
+    stall abort + chunk halving + breaker trip each fired, and a fault
+    schedule that replays identically from the seed."""
+    from corrosion_tpu.hostchaos.harness import verify_schedule_determinism
+
+    rep = _run_named(tmp_path, "wan_full", seed=0)
+    assert rep["ok"], rep["failures"]
+    assert rep["oracle"]["violations"] == 0
+    assert rep["converged"] and rep["bookkeeping_contiguous"]
+    for key in ("breaker_trips", "chunk_halvings", "stall_aborts"):
+        assert rep["machinery"][key] >= 1, (key, rep["machinery"])
+    assert rep["kill"] and rep["kill"]["agent"] == 0
+    ok, problems = verify_schedule_determinism(rep)
+    assert ok, problems
+    # And the budget-gate path accepts a green report.
+    from corrosion_tpu.hostchaos.report import check_hostchaos_budget
+
+    gate_ok, breaches = check_hostchaos_budget(
+        {"platform": "cpu", "scenario": "host_chaos_smoke",
+         "scenarios": {"wan_full": rep}},
+        {"platform": "cpu", "scenario": "host_chaos_smoke",
+         "scenarios": ["wan_full"], "oracle_violations_max": 0,
+         "require_machinery_fired": True, "require_converged": True},
+    )
+    assert gate_ok, breaches
+
+
+@pytest.mark.slow
+def test_scenario_flap_soak(tmp_path):
+    """The long flap/partition churn soak (slow-marked out of tier-1
+    AND the smoke gate; the chaos job runs it unfiltered)."""
+    rep = _run_named(tmp_path, "flap_soak")
+    assert rep["ok"], rep["failures"]
+    assert rep["machinery"]["breaker_trips"] >= 3
+    assert rep["machinery"]["breaker_recoveries"] >= 1
+
+
+def test_budget_gate_refuses_idle_machinery_and_violations():
+    from corrosion_tpu.hostchaos.report import check_hostchaos_budget
+
+    budget = {
+        "platform": "cpu", "scenario": "host_chaos_smoke",
+        "scenarios": ["s"], "oracle_violations_max": 0,
+        "require_machinery_fired": True, "require_converged": True,
+        "ceilings_s": {"scenarios.s.drain_s": 1.0}, "tolerance": 2.0,
+    }
+    good = {
+        "oracle": {"violations": 0}, "machinery_ok": True,
+        "machinery_required": ["breaker_trips"],
+        "machinery": {"breaker_trips": 2},
+        "converged": True, "bookkeeping_contiguous": True, "ok": True,
+        "drain_s": 1.5,
+    }
+    ok, breaches = check_hostchaos_budget(
+        {"platform": "cpu", "scenario": "host_chaos_smoke",
+         "scenarios": {"s": good}}, budget,
+    )
+    assert ok, breaches  # 1.5 < 1.0 x2 tolerance
+
+    idle = dict(good, machinery_ok=False)
+    ok, breaches = check_hostchaos_budget(
+        {"platform": "cpu", "scenario": "host_chaos_smoke",
+         "scenarios": {"s": idle}}, budget,
+    )
+    assert not ok and any("never fired" in b for b in breaches)
+
+    violating = dict(good, oracle={"violations": 1})
+    ok, breaches = check_hostchaos_budget(
+        {"platform": "cpu", "scenario": "host_chaos_smoke",
+         "scenarios": {"s": violating}}, budget,
+    )
+    assert not ok and any("oracle violations" in b for b in breaches)
+
+    slow = dict(good, drain_s=2.5)
+    ok, breaches = check_hostchaos_budget(
+        {"platform": "cpu", "scenario": "host_chaos_smoke",
+         "scenarios": {"s": slow}}, budget,
+    )
+    assert not ok and any("drain_s" in b for b in breaches)
+
+    missing = {"platform": "cpu", "scenario": "host_chaos_smoke",
+               "scenarios": {}}
+    ok, breaches = check_hostchaos_budget(missing, budget)
+    assert not ok and any("missing" in b for b in breaches)
