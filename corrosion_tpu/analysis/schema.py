@@ -9,8 +9,9 @@ possibly hours into a queue slot). This module turns that runtime
 ValueError into a lint: it extracts the canonical tuples from
 telemetry.py without importing it (no jax), finds every
 ``round_curves(...)`` call site, resolves its keywords — including
-``**delivery_latency_hist(...)`` expansions through one local-assignment
-hop — and diffs.
+``**delivery_latency_hist(...)`` / ``**prop_curves(...)`` /
+``**link_curves(...)`` expansions through one local-assignment hop —
+and diffs.
 
 The restricted evaluator executes only top-level ``NAME = <expr>``
 assignments from telemetry.py against a tuple/range/len-only builtin
@@ -34,8 +35,10 @@ def extract_canonical(telemetry_path: str) -> dict[str, tuple]:
 
     Returns the module-level constants that evaluated cleanly (expected:
     VIS_LAT_EDGES, VIS_LAT_KEYS, HEALTH_CURVE_KEYS, ROUND_CURVE_KEYS,
-    LEVEL_CURVE_KEYS). tests/test_analysis.py pins this against the
-    imported module so the evaluator can never silently drift.
+    LEVEL_CURVE_KEYS, plus the propagation plane's LINK_CURVE_KEYS /
+    RUMOR_AGE_KEYS / PROP_CURVE_KEYS). tests/test_analysis.py pins this
+    against the imported module so the evaluator can never silently
+    drift.
     """
     with open(telemetry_path, encoding="utf-8") as f:
         tree = ast.parse(f.read(), filename=telemetry_path)
@@ -62,18 +65,27 @@ def extract_canonical(telemetry_path: str) -> dict[str, tuple]:
 
 
 def _resolve_star(mod: SourceModule, call: ast.Call, star: ast.AST,
-                  vis_keys: tuple) -> tuple | None:
+                  canonical: dict[str, tuple]) -> tuple | None:
     """Keys contributed by a ``**expr`` in a round_curves call: a direct
-    ``**delivery_latency_hist(...)`` or one hop through a local
-    ``name = delivery_latency_hist(...)`` assignment in the enclosing
-    function. None = statically unresolvable."""
-    def hist_call(expr: ast.AST) -> bool:
-        return isinstance(expr, ast.Call) and dotted_name(
-            expr.func
-        ).split(".")[-1] == "delivery_latency_hist"
+    call to one of the telemetry key-set helpers
+    (``delivery_latency_hist`` → VIS_LAT_KEYS, ``prop_curves`` →
+    PROP_CURVE_KEYS, ``link_curves`` → LINK_CURVE_KEYS) or one hop
+    through a local ``name = <helper>(...)`` assignment in the
+    enclosing function. None = statically unresolvable."""
+    helpers = {
+        "delivery_latency_hist": tuple(canonical.get("VIS_LAT_KEYS", ())),
+        "prop_curves": tuple(canonical.get("PROP_CURVE_KEYS", ())),
+        "link_curves": tuple(canonical.get("LINK_CURVE_KEYS", ())),
+    }
 
-    if hist_call(star):
-        return vis_keys
+    def helper_keys(expr: ast.AST) -> tuple | None:
+        if not isinstance(expr, ast.Call):
+            return None
+        return helpers.get(dotted_name(expr.func).split(".")[-1])
+
+    got = helper_keys(star)
+    if got is not None:
+        return got
     if isinstance(star, ast.Name):
         fn = mod.enclosing_function(call)
         scope = fn.node if fn is not None else mod.tree
@@ -83,9 +95,10 @@ def _resolve_star(mod: SourceModule, call: ast.Call, star: ast.AST,
                 and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
                 and node.targets[0].id == star.id
-                and hist_call(node.value)
             ):
-                return vis_keys
+                got = helper_keys(node.value)
+                if got is not None:
+                    return got
     return None
 
 
@@ -97,7 +110,6 @@ def emitted_keys(
     keys: set[str] = set()
     findings: list[Finding] = []
     canon = set(canonical.get("ROUND_CURVE_KEYS", ()))
-    vis_keys = tuple(canonical.get("VIS_LAT_KEYS", ()))
     calls = [
         node for node in ast.walk(mod.tree)
         if isinstance(node, ast.Call)
@@ -106,7 +118,7 @@ def emitted_keys(
     for call in calls:
         for kw in call.keywords:
             if kw.arg is None:
-                got = _resolve_star(mod, call, kw.value, vis_keys)
+                got = _resolve_star(mod, call, kw.value, canonical)
                 if got is None:
                     findings.append(Finding(
                         rule="CT010", path=mod.path, line=kw.value.lineno,

@@ -137,6 +137,68 @@ def _build_parser() -> argparse.ArgumentParser:
     orc.add_argument("--rounds", type=int, default=64)
     orc.add_argument("--churn", action="store_true")
     orc.add_argument("--seed", type=int, default=0)
+    orc.add_argument("--geo", action="store_true",
+                     help="WAN variant: 4 regions on the synthetic "
+                     "circle geography with the propagation-topology "
+                     "plane enabled (the `obs epidemic` source)")
+
+    # Propagation-topology plane (corrosion_tpu/obs/epidemic.py,
+    # docs/OBSERVABILITY.md "Propagation plane"): SI-model fit over the
+    # rumor-age coverage curve, traffic-matrix shares, redundancy, and
+    # the EPIDEMIC_BASELINE diff gate.
+    oep = ob_sub.add_parser(
+        "epidemic", parents=[common],
+        help="epidemic-model analyzer: fit/report/diff the "
+        "corro-epidemic/1 propagation verdicts from a flight JSONL",
+    )
+    oep_sub = oep.add_subparsers(dest="epidemic_cmd", required=True)
+
+    def _epi_common(p):
+        p.add_argument("--fanout", type=int, default=4,
+                       help="config fanout_near+fanout_far for the "
+                       "push-gossip theory comparison (default 4)")
+        p.add_argument("--nodes", type=int, default=None,
+                       help="cluster size for the theoretical "
+                       "half-coverage prediction")
+        p.add_argument("--round-ms", type=float, default=500.0)
+        p.add_argument("--geo-regions", type=int, default=None,
+                       help="region count of the synthetic geo "
+                       "geography (adds ring-resolved traffic shares)")
+
+    oer = oep_sub.add_parser(
+        "report", parents=[common],
+        help="derive the corro-epidemic/1 report from a flight JSONL "
+        "(exit 1 when the on-device accounting fails to reconcile)",
+    )
+    oer.add_argument("flight", help="flight-recorder JSONL path")
+    _epi_common(oer)
+    oer.add_argument("--oracle-records", default=None,
+                     help="loadgen oracle delivery-records JSON: adds "
+                     "the host-plane spread fit as a cross-validation "
+                     "block (docs/FIDELITY.md)")
+    oer.add_argument("--json", action="store_true")
+    oer.add_argument("--out", default=None, help="report JSON path")
+
+    oef = oep_sub.add_parser(
+        "fit", parents=[common],
+        help="print the SI/logit fit detail (per-bucket coverage "
+        "points) for a flight JSONL",
+    )
+    oef.add_argument("flight")
+    _epi_common(oef)
+    oef.add_argument("--json", action="store_true")
+
+    oed = oep_sub.add_parser(
+        "diff", parents=[common],
+        help="flag propagation regressions between two reports (or "
+        "flights) — the EPIDEMIC_BASELINE CI gate",
+    )
+    oed.add_argument("baseline", help="flight JSONL or epidemic report")
+    oed.add_argument("candidate", help="flight JSONL or epidemic report")
+    oed.add_argument("--tolerance", type=float, default=0.25,
+                     help="relative regression tolerance (default 0.25)")
+    _epi_common(oed)
+    oed.add_argument("--json", action="store_true")
 
     otm = ob_sub.add_parser(
         "timeline", parents=[common],
@@ -1052,9 +1114,11 @@ async def _fidelity(args) -> int:
 
 
 def _obs(args) -> int:
-    """`corrosion obs {report,tail,diff,record,timeline}` — delegates to
-    the obs package (corrosion_tpu/obs/commands.py), which owns the
-    convergence-plane verdicts and the causal-tracing correlator."""
+    """`corrosion obs {report,tail,diff,record,epidemic,timeline,cost,
+    trajectory}` — delegates to the obs package
+    (corrosion_tpu/obs/commands.py), which owns the convergence-plane
+    verdicts, the propagation/epidemic analyzer, and the causal-tracing
+    correlator."""
     from corrosion_tpu.obs import commands as obs_commands
 
     return obs_commands.run(args)

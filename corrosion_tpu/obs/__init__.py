@@ -28,8 +28,17 @@ CLI group exposes (promoted out of ``cli.py``):
   ``BENCH_r*``/``MULTICHIP_r*`` artifacts as one provenance-checked
   series (``corro-bench-trajectory/1``) that refuses cross-platform/
   kernel deltas.
+- :mod:`corrosion_tpu.obs.epidemic` — the propagation-topology
+  analyzer (``corro-epidemic/1``): coverage curves S(t) reconstructed
+  from the rumor-age histogram, the SI/logistic spread-exponent fit vs
+  push-gossip theory, region-pair traffic shares, redundancy, the
+  traffic-model and host-oracle cross-validations, and the
+  ``EPIDEMIC_BASELINE`` diff gate.
+- :mod:`corrosion_tpu.obs.metrics_ref` — the metrics-name drift check:
+  the documented ``corro_*`` series table vs every name the codebase
+  can register (static literals + the dynamic kernel publishers).
 - :mod:`corrosion_tpu.obs.commands` — the CLI entrypoints
-  (``obs report|tail|diff|record|timeline|cost|trajectory``).
+  (``obs report|tail|diff|record|epidemic|timeline|cost|trajectory``).
 
 Everything host-side; ``journey``/``commands`` import jax transitively
 through ``sim`` (``costs``/``ledger`` import jax directly),

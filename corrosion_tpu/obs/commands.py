@@ -2,10 +2,11 @@
 
 Promoted out of ``cli.py`` so the observability logic lives with the
 plane it operates on: ``report``/``tail``/``diff``/``record`` drive the
-kernel convergence plane (``sim/health.py``), ``timeline`` drives the
-causal-tracing correlator (:mod:`corrosion_tpu.obs.timeline` +
-:mod:`corrosion_tpu.obs.journey`). ``cli.py`` keeps the argparse surface
-and delegates here.
+kernel convergence plane (``sim/health.py``), ``epidemic`` drives the
+propagation-topology analyzer (:mod:`corrosion_tpu.obs.epidemic`), and
+``timeline`` drives the causal-tracing correlator
+(:mod:`corrosion_tpu.obs.timeline` + :mod:`corrosion_tpu.obs.journey`).
+``cli.py`` keeps the argparse surface and delegates here.
 
 Exit codes: 0 = verdict ok, 1 = regression / failed invariant, 2 =
 usage. Note any ``corrosion_tpu.sim`` import pulls in jax (the package
@@ -27,6 +28,8 @@ def run(args) -> int:
         return _cost(args)
     if args.obs_cmd == "trajectory":
         return _trajectory(args)
+    if args.obs_cmd == "epidemic":
+        return _epidemic(args)
 
     from corrosion_tpu.sim import health
 
@@ -99,9 +102,100 @@ def run(args) -> int:
         facts = health.record_demo_flight(
             args.out, nodes=args.nodes, rounds=args.rounds,
             churn=args.churn, seed=args.seed, progress=sys.stderr,
+            geo=args.geo,
         )
         print(json.dumps(facts))
         return 0
+    return 2
+
+
+def _epidemic(args) -> int:
+    """`obs epidemic {report,fit,diff}` — the propagation-topology
+    plane's analyzer (obs/epidemic.py, docs/OBSERVABILITY.md
+    "Propagation plane"). Exit 0 = verdict ok, 1 = regression or an
+    accounting identity failed to reconcile, 2 = usage."""
+    from corrosion_tpu.obs import epidemic
+
+    kw = dict(
+        fanout=args.fanout, nodes=args.nodes, round_ms=args.round_ms,
+        geo_regions=args.geo_regions,
+    )
+
+    if args.epidemic_cmd == "report":
+        try:
+            rep = epidemic.report_from_flight(args.flight, **kw)
+        except (OSError, ValueError) as e:
+            print(f"obs epidemic report: {e}", file=sys.stderr)
+            return 2
+        if args.oracle_records:
+            try:
+                with open(args.oracle_records) as f:
+                    rep["oracle"] = epidemic.oracle_coverage(
+                        json.load(f), round_ms=args.round_ms
+                    )
+            except (OSError, ValueError) as e:
+                print(
+                    f"obs epidemic report: bad --oracle-records: {e!r}",
+                    file=sys.stderr,
+                )
+                return 2
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(json.dumps(rep, indent=2) + "\n")
+        print(json.dumps(rep) if args.json else epidemic.render_report(rep))
+        if not rep["checks_ok"]:
+            for p in rep["check_problems"]:
+                print(f"obs epidemic report: ACCOUNTING: {p}",
+                      file=sys.stderr)
+            return 1
+        return 0
+
+    if args.epidemic_cmd == "fit":
+        try:
+            rep = epidemic.report_from_flight(args.flight, **kw)
+        except (OSError, ValueError) as e:
+            print(f"obs epidemic fit: {e}", file=sys.stderr)
+            return 2
+        fit = rep["fit"]
+        if args.json:
+            print(json.dumps(fit))
+        else:
+            for p in fit["points"]:
+                logit = p.get("logit")
+                print(
+                    f"age<={p['age']:g}r coverage={p['coverage']:.4f}"
+                    + (f" logit={logit:+.3f}" if logit is not None else "")
+                )
+            if fit["fitted"]:
+                print(
+                    f"beta={fit['spread_exponent']:.4f}/round "
+                    f"half={fit['half_coverage_round']:.1f}r "
+                    f"r2={fit['r2']:.3f}"
+                )
+            else:
+                print("fit abstained (fewer than 2 interior points)")
+        return 0 if fit["fitted"] else 1
+
+    if args.epidemic_cmd == "diff":
+        try:
+            base = epidemic.load_report(args.baseline, **kw)
+            cand = epidemic.load_report(args.candidate, **kw)
+        except (OSError, ValueError) as e:
+            print(f"obs epidemic diff: {e}", file=sys.stderr)
+            return 2
+        diff = epidemic.diff_reports(base, cand, tolerance=args.tolerance)
+        if args.json:
+            print(json.dumps(diff))
+        else:
+            for row in diff["rows"]:
+                mark = "ok" if row["ok"] else "REGRESSION"
+                print(
+                    f"{row['metric']}: {row['baseline']} -> "
+                    f"{row['candidate']} [{mark}]"
+                )
+            for r in diff["regressions"]:
+                print(f"REGRESSION: {r}", file=sys.stderr)
+        return 1 if diff["regressions"] else 0
     return 2
 
 

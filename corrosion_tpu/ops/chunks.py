@@ -47,6 +47,13 @@ class ChunkConfig:
     sync_interval: int = 5
     gap_requests: int = 4  # partial-need ranges requested per session
     sync_seq_budget: int = 4096  # seqs granted per session
+    # Propagation-topology observables (sim/telemetry.PROP_CURVE_KEYS).
+    # The chunk plane has no region structure, so its traffic matrix is
+    # the degenerate single-region link_00 = chunks gossiped; useful =
+    # chunks accepted by bounded intake, redundant = the rest. Static —
+    # False keeps the pre-propagation trace bit-identical (the chaos
+    # axes' zero-cost-skip contract).
+    prop_observe: bool = False
 
     @property
     def rows(self) -> int:

@@ -107,6 +107,14 @@ class GossipConfig:
     # interpret-mode off-TPU), or None = auto by platform. Static, so
     # the choice bakes into the trace like every other config field.
     kernel_backend: str | None = None
+    # Propagation-topology observables (sim/telemetry.PROP_CURVE_KEYS):
+    # per-round region-pair traffic matrix + effective-fanout split
+    # computed inside the broadcast round, and the rumor-age histogram
+    # in the engine scan bodies. Static — False (the default) keeps the
+    # pre-propagation trace bit-identical with zero extra work, the
+    # same skip contract as the chaos axes. Requires the topology's
+    # region count <= telemetry.PROP_REGIONS.
+    prop_observe: bool = False
 
     def __post_init__(self):
         if self.window_k < 0 or self.window_k % 32 != 0:
@@ -552,6 +560,39 @@ def _merge_versions_dense(
     return out, n_merges
 
 
+def _region_link_matrix(
+    m_ok: jax.Array,  # bool[n, kk] delivered (post-loss) message mask
+    recv_region: jax.Array,  # i32[n] receiver region per local row
+    src_region: jax.Array,  # i32[n, F] source region per sampled source
+    q_cap: int,
+    n_regions: int,
+) -> jax.Array:
+    """u32[R, R] delivered-copies matrix over this caller's receiver
+    rows: entry (i, j) counts copies a region-i receiver pulled from a
+    region-j source queue this round. Post-loss, so the matrix's mass
+    equals the ``msgs`` curve exactly (the conservation check the
+    epidemic analyzer pins). One [n, kk] pass per source region plus
+    R^2 scalar reduces — cheap, and only traced when
+    ``cfg.prop_observe`` is set."""
+    n, kk = m_ok.shape
+    sr = jnp.repeat(src_region[:, :, None], q_cap, axis=2).reshape(n, kk)
+    rows = []
+    for j in range(n_regions):
+        cj = jnp.sum(
+            m_ok & (sr == j), axis=1, dtype=jnp.uint32
+        )  # u32[n] copies each local receiver heard from region j
+        rows.append(
+            jnp.stack([
+                jnp.sum(
+                    jnp.where(recv_region == i, cj, jnp.uint32(0)),
+                    dtype=jnp.uint32,
+                )
+                for i in range(n_regions)
+            ])
+        )
+    return jnp.stack(rows, axis=1)  # [R_recv, R_src]
+
+
 def _broadcast_round(
     data: DataState,
     topo: Topology,
@@ -940,6 +981,11 @@ def _broadcast_round(
                 )
                 n_merges += m
 
+            if cfg.prop_observe:
+                # Fast path: ``fresh`` is exactly the newly-possessed
+                # first-receipt mask (stale copies were dropped before
+                # the sort), so the propagation counter reads it as-is.
+                prop_fresh = fresh
             in_mask, in_payloads = routing.rebuild_bounded_queue(
                 fresh,
                 -v2.astype(jnp.int32),  # oldest versions first
@@ -1108,6 +1154,14 @@ def _broadcast_round(
             # newly applied changes and rebroadcast like any other
             # (agent.rs:2040-2057).
             fresh = run & valid2 & ~prev_same
+            if cfg.prop_observe:
+                # Propagation counter: newly POSSESSED first receipts
+                # only — under rebroadcast_stale the intake mask below
+                # also re-admits already-held versions, which are
+                # redundant copies by the epidemic's accounting.
+                prop_fresh = (
+                    (run & valid2 & (v2 > base) & ~prev_same) | extra_poss
+                )
             if not cfg.rebroadcast_stale:
                 fresh &= v2 > base
             fresh = fresh | extra_poss
@@ -1126,6 +1180,18 @@ def _broadcast_round(
             in_w, in_v, in_tx = in_payloads[:3]
             in_gw = in_payloads[3] if track else None
             in_w = jnp.where(in_mask, in_w, -1)
+        # Propagation-topology observables (prop_observe): the region-
+        # pair traffic matrix over delivered copies and the effective-
+        # fanout split. ``prop_fresh`` (both delivery flavors set it) is
+        # the per-message first-receipt-of-a-newly-possessed-version
+        # mask — the epidemic's productive pushes; everything else
+        # delivered was redundant.
+        if cfg.prop_observe:
+            prop_useful = jnp.sum(prop_fresh, dtype=jnp.uint32)
+            prop_link = _region_link_matrix(
+                m_ok, region_r, topo.region[src], q_cap,
+                partition.shape[0],
+            )
         # A source's budgets burn when at least one receiver pulled it.
         # Sources live on arbitrary shards, so the sharded driver counts
         # pulls into the FULL vector, psums across shards, and keeps its
@@ -1162,6 +1228,11 @@ def _broadcast_round(
         oo_new, oo_any_new = data.oo, data.oo_any
         n_degraded = jnp.uint32(0)
         n_lost = jnp.uint32(0)
+        if cfg.prop_observe:
+            prop_useful = jnp.uint32(0)
+            prop_link = jnp.zeros(
+                (partition.shape[0], partition.shape[0]), jnp.uint32
+            )
 
     # ---- 5. queue rebuild (oldest versions first, like the FIFO buffer) ----
     # An entry's tx budget burns only when the sender actually reached at
@@ -1215,16 +1286,30 @@ def _broadcast_round(
         # One coalesced cross-shard scalar reduction for the round's
         # stats, plus the global OR for the window-live flag (a psum of
         # a replicated flag still reduces to the right truth value, so
-        # the windowless/sync-only branches need no special case).
-        applied_b, n_msgs, n_merges, n_degraded, n_lost, oo_cnt = (
-            jax.lax.psum(
+        # the windowless/sync-only branches need no special case). The
+        # propagation counters (local-receiver-row partial sums) join
+        # the same coalesced reduction when the plane is on.
+        if cfg.prop_observe:
+            (
+                applied_b, n_msgs, n_merges, n_degraded, n_lost, oo_cnt,
+                prop_useful, prop_link,
+            ) = jax.lax.psum(
                 (
                     applied_b, n_msgs, n_merges, n_degraded, n_lost,
-                    oo_any_new.astype(jnp.uint32),
+                    oo_any_new.astype(jnp.uint32), prop_useful, prop_link,
                 ),
                 shard.axes,
             )
-        )
+        else:
+            applied_b, n_msgs, n_merges, n_degraded, n_lost, oo_cnt = (
+                jax.lax.psum(
+                    (
+                        applied_b, n_msgs, n_merges, n_degraded, n_lost,
+                        oo_any_new.astype(jnp.uint32),
+                    ),
+                    shard.axes,
+                )
+            )
         oo_any_new = oo_cnt > 0
     stats = {
         "applied_broadcast": applied_b,
@@ -1239,6 +1324,16 @@ def _broadcast_round(
         # plan) this round — the chaos plane's ground-truth drop count.
         "lost_msgs": n_lost,
     }
+    if cfg.prop_observe:
+        # Delivered copies partition exactly into useful (first receipt
+        # of a newly possessed version) + redundant; the link matrix's
+        # mass equals msgs. Both identities are pinned by the epidemic
+        # analyzer's conservation checks.
+        stats["prop_link"] = prop_link
+        stats["prop_useful"] = prop_useful
+        stats["prop_dup"] = (
+            n_msgs.astype(jnp.uint32) - prop_useful
+        )
     return (
         DataState(
             head=head,

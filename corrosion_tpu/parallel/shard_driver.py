@@ -248,14 +248,17 @@ def make_sharded_broadcast(mesh: Mesh):
 
         dspecs = _data_specs(mesh)
         topo_specs = jax.tree.map(lambda _: P(), topo)
-        stats_specs = {
-            k: P()
-            for k in (
-                "applied_broadcast", "msgs", "cell_merges",
-                "window_degraded", "lost_msgs",
-                "xshard_bytes_ici", "xshard_bytes_dcn",
-            )
-        }
+        stat_keys = (
+            "applied_broadcast", "msgs", "cell_merges",
+            "window_degraded", "lost_msgs",
+            "xshard_bytes_ici", "xshard_bytes_dcn",
+        )
+        if cfg.prop_observe:
+            # Propagation plane: per-shard partial counts join the
+            # round's coalesced psum inside the body, so the outputs
+            # are replicated like every other stat.
+            stat_keys = stat_keys + ("prop_link", "prop_useful", "prop_dup")
+        stats_specs = {k: P() for k in stat_keys}
         in_specs = [dspecs, topo_specs, P(), P(), P(), P()]
         args = [data, topo, alive, partition, writes, rng]
         if loss is not None:

@@ -57,6 +57,19 @@ def _scan_impl(state, vis, last_seq, alive, base_key, xs, cfg):
             applied = chunk_ops.applied_mask(st, last_seq, cfg)
             newly = (vis < 0) & applied
             vis = jnp.where(newly, r, vis)
+        # Propagation plane, degenerate single-region form (the chunk
+        # plane has no geography): all gossiped chunks land in link_00,
+        # intake-accepted chunks are the useful pushes, and rumor age =
+        # the round a (node, stream) pair first reassembled (streams
+        # commit at round 0). Static skip when cfg.prop_observe is off.
+        prop_stats = telemetry_mod.prop_curves(
+            cfg.prop_observe,
+            stats["chunks_sent"].reshape(1, 1),
+            stats["chunks_applied"],
+            stats["chunks_sent"] - stats["chunks_applied"],
+            jnp.broadcast_to(r, newly.shape),
+            newly,
+        )
         curves = telemetry_mod.round_curves(
             msgs=stats["chunks_sent"],
             applied_broadcast=stats["chunks_applied"],
@@ -80,6 +93,7 @@ def _scan_impl(state, vis, last_seq, alive, base_key, xs, cfg):
             **telemetry_mod.delivery_latency_hist(
                 jnp.broadcast_to(r, newly.shape), newly
             ),
+            **prop_stats,
         )
         return (st, vis), curves
 

@@ -220,6 +220,17 @@ def _cluster_round(
         )
         stale_sum, stale_max = gossip_ops.staleness(data)
         false_alarms, undetected = swim_impl.health_counts(sw)
+        # Propagation plane (docs/OBSERVABILITY.md "Propagation
+        # plane"): static zero-cost skip when cfg.gossip.prop_observe
+        # is off — prop_curves returns {} and nothing traces.
+        prop_stats = telemetry_mod.prop_curves(
+            cfg.gossip.prop_observe,
+            bstats.get("prop_link"),
+            bstats.get("prop_useful"),
+            bstats.get("prop_dup"),
+            state.round - sample_round[:, None],
+            newly,
+        )
 
     stats = telemetry_mod.round_curves(
         mismatches=swim_impl.mismatches(sw),
@@ -249,6 +260,7 @@ def _cluster_round(
         xshard_bytes_ici=bstats.get("xshard_bytes_ici", jnp.float32(0.0)),
         xshard_bytes_dcn=bstats.get("xshard_bytes_dcn", jnp.float32(0.0)),
         **lat_hist,
+        **prop_stats,
     )
     return (
         ClusterState(

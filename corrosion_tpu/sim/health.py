@@ -38,6 +38,7 @@ import numpy as np
 from corrosion_tpu.sim.telemetry import (
     VIS_LAT_EDGES,
     VIS_LAT_KEYS,
+    curve_array,
     replay_flight,
 )
 
@@ -72,40 +73,109 @@ def iter_flight(path: str, follow: bool = False, poll_s: float = 0.25,
     (``follow=False``). Garbage lines (a crash's torn write) are
     skipped, like ``replay_flight``. ``idle_timeout_s`` bounds how long
     a follow waits without new data before giving up (None = forever).
+
+    **Rotation-aware** (``follow=True``): the size-capped recorder
+    renames the live file to ``path.N`` and opens a fresh ``path``
+    (``FlightRecorder.max_bytes``) — a follower holding the old handle
+    would silently stop seeing records. At EOF the live file's inode is
+    re-checked; on a rotation the old handle is drained to completion
+    (the renamed file keeps serving its fd), then the follower replays
+    any gap through the rotated segment chain (segment headers carry
+    their index; header ``S`` lives at ``path.{S+1}`` once rotated —
+    the ``flight_segments`` naming contract) before resuming on the
+    live file. No record is lost or re-read across any number of
+    rotations between polls.
     """
-    with open(path) as f:
-        buf = ""
-        idle = 0.0
-        while True:
-            chunk = f.readline()
-            if chunk:
-                buf += chunk
-                if not buf.endswith("\n"):
-                    continue  # partial line: wait for the rest
-                line, buf = buf.strip(), ""
-                idle = 0.0
-                if not line:
-                    continue
-                try:
-                    yield json.loads(line)
-                except ValueError:
-                    continue
-                continue
+    cur_path = path
+    cur_seg: int | None = None
+    opened_any = False
+    idle = 0.0
+    while True:
+        try:
+            f = open(cur_path)
+        except FileNotFoundError:
+            if not opened_any:
+                raise  # a missing/typo'd path is an error, not an empty tail
+            # Mid-rotation race: the live path is briefly absent between
+            # os.replace and the fresh open. Poll, don't die.
             if not follow:
                 return
             if idle_timeout_s is not None and idle >= idle_timeout_s:
                 return
             time.sleep(poll_s)
             idle += poll_s
+            continue
+        opened_any = True
+        redirect = False  # gap-detection already chose the next file
+        with f:
+            ino = os.fstat(f.fileno()).st_ino
+            buf = ""
+            while True:
+                chunk = f.readline()
+                if chunk:
+                    buf += chunk
+                    if not buf.endswith("\n"):
+                        continue  # partial line: wait for the rest
+                    line, buf = buf.strip(), ""
+                    idle = 0.0
+                    if not line:
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        continue
+                    if obj.get("kind") == "flight" and "segment" in obj:
+                        seg = int(obj["segment"])
+                        if cur_seg is not None and seg > cur_seg + 1:
+                            # This file starts PAST the next unread
+                            # segment — the recorder rotated between
+                            # our exists() probe and the open (the
+                            # check-then-open race). Replay the missed
+                            # segment(s) first; this file is revisited
+                            # through the normal chain advance, from
+                            # the top, nothing yielded from this visit.
+                            missed = f"{path}.{cur_seg + 2}"
+                            if os.path.exists(missed):
+                                cur_path = missed
+                                redirect = True
+                                break
+                        cur_seg = seg
+                    yield obj
+                    continue
+                # EOF on the current handle.
+                if not follow:
+                    return
+                if cur_path != path:
+                    break  # finished replaying a rotated segment
+                try:
+                    rotated = os.stat(path).st_ino != ino
+                except FileNotFoundError:
+                    rotated = True
+                if rotated:
+                    break  # old live file fully drained — advance
+                if idle_timeout_s is not None and idle >= idle_timeout_s:
+                    return
+                time.sleep(poll_s)
+                idle += poll_s
+        # Advance along the segment chain: the file just drained carried
+        # header segment ``cur_seg`` (rotated name path.{cur_seg+1}), so
+        # the next unread segment's header is cur_seg+1 — at
+        # path.{cur_seg+2} when it too already rotated, else the live
+        # file (whose header is re-checked on open: if it rotated again
+        # between this probe and the open, the gap detection above
+        # redirects to the missed segment without yielding anything).
+        if not redirect:
+            nxt = None
+            if cur_seg is not None:
+                cand = f"{path}.{cur_seg + 2}"
+                if os.path.exists(cand):
+                    nxt = cand
+            cur_path = nxt if nxt is not None else path
 
 
-def _arr(curves: dict, key: str) -> np.ndarray:
-    """Curve as float64, zero-filled when the record lacks the key (old
-    flight files predating the health plane replay as all-zero health)."""
-    if key in curves:
-        return np.asarray(curves[key], dtype=np.float64)
-    n = len(np.asarray(curves.get("round", curves.get("msgs", []))))
-    return np.zeros(n, dtype=np.float64)
+# Shared zero-fill curve accessor (telemetry.curve_array): old flight
+# files predating a plane replay as all-zero for its keys.
+_arr = curve_array
 
 
 def detection_latencies(undetected: np.ndarray,
@@ -617,17 +687,31 @@ def diff_reports(
     return {"regressions": regressions, "rows": rows}
 
 
+GEO_REGIONS = 4  # region count of the geo scenario family (<= PROP_REGIONS)
+
+
 def churned_demo_cluster(
     nodes: int = 128,
     rounds: int = 64,
     samples: int = 64,
     churn: bool = True,
     seed: int = 0,
+    geo: bool = False,
 ):
     """Small dense cluster with a mid-run kill/revive wave of NON-writer
     nodes (writers stay up so sampled-write bookkeeping remains exact) —
     the one scenario builder shared by `obs record`, the CI convergence
     artifact, and the health-plane tests.
+
+    ``geo=True`` is the WAN variant of the same scenario family: the
+    cluster splits into ``GEO_REGIONS`` contiguous regions on the
+    synthetic circle geography (``region_rtt="geo"`` — ring classes
+    span the full 0-5 RTT bucket range instead of flat ring-1), writers
+    spread evenly across regions, and the propagation-topology plane is
+    enabled (``prop_observe``) — the committed ``EPIDEMIC_BASELINE``
+    scenario. The default (flat) variant's RNG stream and schedule are
+    byte-identical to before the geo axis existed, so the committed
+    ``CONVERGENCE_BASELINE`` stays comparable.
 
     Returns (cfg, topo, sched, kill_rounds). Kills ``nodes // 16``
     victims at ``rounds // 4``, revives them by ``rounds // 2``, and
@@ -639,10 +723,30 @@ def churned_demo_cluster(
     from corrosion_tpu.sim.engine import Schedule
 
     n_writers = max(4, min(16, nodes // 8))
-    cfg, topo = _cfg(
-        nodes, writers=list(range(n_writers)), sync_interval=5,
-        n_cells=0,
-    )
+    if geo:
+        sizes = [nodes // GEO_REGIONS] * GEO_REGIONS
+        sizes[-1] += nodes - sum(sizes)
+        # Writers spread evenly around the circle so the epidemic has to
+        # cross every ring, deduped in case nodes is tiny.
+        writers = sorted({
+            min(round(i * nodes / n_writers), nodes - 1)
+            for i in range(n_writers)
+        })
+        n_writers = len(writers)
+        cfg, topo = _cfg(
+            nodes, writers=writers, regions=sizes, region_rtt="geo",
+            sync_interval=5, n_cells=0, prop_observe=True,
+        )
+        writer_set = set(writers)
+        non_writers = np.asarray(
+            [i for i in range(nodes) if i not in writer_set]
+        )
+    else:
+        cfg, topo = _cfg(
+            nodes, writers=list(range(n_writers)), sync_interval=5,
+            n_cells=0,
+        )
+        non_writers = np.arange(n_writers, nodes)
     rng = np.random.default_rng(seed)
     writes = (rng.random((rounds, n_writers)) < 0.15).astype(np.uint32)
     drain = max(rounds // 3, 1)
@@ -653,7 +757,7 @@ def churned_demo_cluster(
         kill = np.zeros((rounds, nodes), bool)
         revive = np.zeros((rounds, nodes), bool)
         victims = rng.choice(
-            np.arange(n_writers, nodes), size=max(nodes // 16, 1),
+            non_writers, size=max(nodes // 16, 1),
             replace=False,
         )
         k_at = rounds // 4
@@ -674,10 +778,13 @@ def record_demo_flight(
     churn: bool = False,
     seed: int = 0,
     progress=None,
+    geo: bool = False,
 ) -> dict:
     """Run a small dense cluster (optionally with churn) recording a
     flight JSONL — the `obs record` backend and the CI convergence
     artifact. Returns run facts (kill rounds, convergence booleans).
+    ``geo=True`` records the WAN-ring variant with the propagation
+    plane enabled — the `obs epidemic` / ``EPIDEMIC_BASELINE`` source.
 
     Deliberately modest: a CPU-friendly cluster whose flight record
     exercises every health key, not a benchmark.
@@ -688,7 +795,7 @@ def record_demo_flight(
     from corrosion_tpu.sim.telemetry import FlightRecorder, KernelTelemetry
 
     cfg, topo, sched, kill_rounds = churned_demo_cluster(
-        nodes=nodes, rounds=rounds, churn=churn, seed=seed
+        nodes=nodes, rounds=rounds, churn=churn, seed=seed, geo=geo
     )
     tele = KernelTelemetry(
         engine="dense", progress=progress,
@@ -703,6 +810,9 @@ def record_demo_flight(
         "flight": os.path.abspath(out),
         "nodes": nodes,
         "rounds": rounds,
+        "geo": geo,
+        "regions": GEO_REGIONS if geo else 1,
+        "fanout": cfg.gossip.fanout,
         "kill_rounds": kill_rounds,
         "need_last": float(np.asarray(curves["need"])[-1]),
         "staleness_last": float(np.asarray(curves["staleness_sum"])[-1]),

@@ -317,6 +317,20 @@ def mixed_round(
     # them), `need` carries both planes' outstanding mass.
     stale_sum, stale_max = gossip_ops.staleness(data)
     false_alarms, undetected = swim_impl.health_counts(sw)
+    # Propagation plane over the version-plane broadcast traffic (the
+    # chunk plane has no region structure; its copies are excluded from
+    # the link matrix by construction). Rumor ages ride the composite
+    # visibility latch, so a big version first delivered through chunk
+    # reassembly ages like any other first delivery. Static skip when
+    # cfg.gossip.prop_observe is off.
+    prop_stats = telemetry_mod.prop_curves(
+        cfg.gossip.prop_observe,
+        bstats.get("prop_link"),
+        bstats.get("prop_useful"),
+        bstats.get("prop_dup"),
+        state.round - sample_round[:, None],
+        newly,
+    )
     stats = telemetry_mod.round_curves(
         msgs=bstats["msgs"],
         applied_broadcast=bstats["applied_broadcast"],
@@ -354,6 +368,7 @@ def mixed_round(
         **telemetry_mod.delivery_latency_hist(
             state.round - sample_round[:, None], newly
         ),
+        **prop_stats,
     )
     return (
         MixedState(

@@ -302,6 +302,18 @@ def _epoch_scan_impl(
             )
             stale_sum, stale_max = gossip_ops.staleness(st.data)
             false_alarms, undetected = swim_impl.health_counts(sw)
+            # Propagation plane over the hot-slot broadcast traffic;
+            # rumor ages track the hot-plane samples (cold-plane
+            # resolutions happen at epoch granularity outside the scan,
+            # exactly like vis_count). Static skip when disabled.
+            prop_stats = telemetry_mod.prop_curves(
+                cfg.gossip.prop_observe,
+                bstats.get("prop_link"),
+                bstats.get("prop_useful"),
+                bstats.get("prop_dup"),
+                r - s_round[:, None],
+                newly,
+            )
 
         stats = telemetry_mod.round_curves(
             mismatches=swim_impl.mismatches(sw),
@@ -337,6 +349,7 @@ def _epoch_scan_impl(
                 "xshard_bytes_dcn", jnp.float32(0.0)
             ),
             **lat_hist,
+            **prop_stats,
         )
         return (st, sw, vr_new), stats
 

@@ -92,6 +92,44 @@ XSHARD_CURVE_KEYS = (
     "xshard_bytes_dcn",  # queue-exchange bytes across dcn groups
 )
 
+# Propagation-topology plane (docs/OBSERVABILITY.md "Propagation
+# plane"): epidemic *structure* observables, opt-in per config
+# (``GossipConfig.prop_observe`` / ``ChunkConfig.prop_observe``) with
+# the chaos axes' static zero-cost-skip contract — a disabled config
+# emits constants and traces no extra work. Region count is bounded by
+# ``PROP_REGIONS`` (the fixed committed-scenario geography); larger
+# topologies must keep the plane off or shrink their region axis.
+PROP_REGIONS = 4
+
+# Per-round region-pair traffic matrix, row = receiver region, col =
+# source region, flattened row-major into fixed scalar keys so the
+# matrix rides the canonical RoundCurves schema (CT010-checkable).
+# Entries beyond a scenario's actual region count stay zero.
+LINK_CURVE_KEYS = tuple(
+    f"link_{i}{j}" for i in range(PROP_REGIONS) for j in range(PROP_REGIONS)
+)
+
+# Rumor-age histogram bucket upper edges, in ROUNDS: age since commit at
+# FIRST delivery (watermark crossing or window possession) per tracked
+# (sample, node) pair. Same shape-static bucketize machinery as
+# VIS_LAT_EDGES but finer — the epidemic analyzer (obs/epidemic.py)
+# reconstructs the coverage curve S(t) from these buckets, and the
+# logistic fit needs resolution around the half-coverage knee.
+RUMOR_AGE_EDGES = (1, 2, 3, 4, 5, 6, 8, 10, 12, 16, 24, 32, 48, 64)
+RUMOR_AGE_KEYS = tuple(
+    f"rumor_age_b{i}" for i in range(len(RUMOR_AGE_EDGES) + 1)
+)
+
+# Effective-fanout counters: of the broadcast copies delivered this
+# round, how many were NEW to their receiver (first receipt of a newly
+# possessed version — the epidemic's productive pushes) vs redundant
+# (stale / duplicate / far-ahead copies). dup / (useful + dup) is the
+# wasted-push ratio the SI model predicts grows as coverage saturates.
+PROP_CURVE_KEYS = (
+    "prop_useful_msgs",
+    "prop_dup_msgs",
+) + LINK_CURVE_KEYS + RUMOR_AGE_KEYS
+
 # Canonical per-round curve keys. Every engine's scan body emits exactly
 # this set (superset of the former ad-hoc dicts); semantics per key are
 # documented in docs/OBSERVABILITY.md ("Kernel plane" + "Convergence
@@ -108,7 +146,7 @@ ROUND_CURVE_KEYS = (
     "sync_regrant",
     "cold_healed",
     "vis_count",
-) + HEALTH_CURVE_KEYS + XSHARD_CURVE_KEYS
+) + HEALTH_CURVE_KEYS + XSHARD_CURVE_KEYS + PROP_CURVE_KEYS
 
 # Level-style curves whose end-of-run value is a convergence verdict on
 # its own: published additionally as ``<series>_last`` gauges.
@@ -138,26 +176,77 @@ def series_name(key: str) -> str:
     return prefix + key
 
 
-def delivery_latency_hist(lat_rounds, newly) -> dict:
+def delivery_latency_hist(lat_rounds, newly, edges=None, keys=None) -> dict:
     """Fixed-bucket delivery-latency histogram for one round, on-device.
 
     ``lat_rounds`` (int[...]) is commit-to-visible latency in rounds for
     every tracked pair; ``newly`` (bool[...], same shape) masks the pairs
     that became visible THIS round. Bucket b counts newly-visible pairs
-    with ``VIS_LAT_EDGES[b-1] < lat <= VIS_LAT_EDGES[b]`` (b0 =
-    ``lat <= edges[0]``; the final bucket is the overflow past the last
-    edge). Shape-static bucketize + one-hot sum — a handful of
-    elementwise compares and reductions, TPU-friendly inside a scan
-    body. Returns ``{vis_lat_b0: u32, ...}`` ready for ``round_curves``.
+    with ``edges[b-1] < lat <= edges[b]`` (b0 = ``lat <= edges[0]``; the
+    final bucket is the overflow past the last edge). Shape-static
+    bucketize + one-hot sum — a handful of elementwise compares and
+    reductions, TPU-friendly inside a scan body. Defaults to the
+    ``VIS_LAT_EDGES``/``VIS_LAT_KEYS`` pair; the propagation plane
+    reuses the machinery with the finer ``RUMOR_AGE_EDGES`` buckets.
+    Returns ``{keys[0]: u32, ...}`` ready for ``round_curves``.
     """
+    edges = VIS_LAT_EDGES if edges is None else edges
+    keys = VIS_LAT_KEYS if keys is None else keys
     lat = lat_rounds.astype(jnp.int32)
     idx = jnp.zeros(lat.shape, jnp.int32)
-    for e in VIS_LAT_EDGES:
+    for e in edges:
         idx = idx + (lat > e).astype(jnp.int32)
     return {
         k: jnp.sum(newly & (idx == b), dtype=jnp.uint32)
-        for b, k in enumerate(VIS_LAT_KEYS)
+        for b, k in enumerate(keys)
     }
+
+
+def link_curves(link) -> dict:
+    """Flatten a [R, R] region-pair traffic matrix (R <= PROP_REGIONS)
+    into the fixed ``LINK_CURVE_KEYS`` scalars; entries beyond the
+    scenario's region count zero-fill so the flattened key set is
+    shape-independent."""
+    r = link.shape[0]
+    if r > PROP_REGIONS:
+        raise ValueError(
+            f"propagation plane supports at most {PROP_REGIONS} regions, "
+            f"got {r}; disable prop_observe or shrink the region axis"
+        )
+    return {
+        f"link_{i}{j}": (
+            link[i, j] if i < r and j < r else jnp.uint32(0)
+        )
+        for i in range(PROP_REGIONS)
+        for j in range(PROP_REGIONS)
+    }
+
+
+def prop_curves(enabled: bool, link, useful, dup, lat_rounds, newly) -> dict:
+    """Per-round propagation-plane stats for a scan body, or {} when the
+    plane is disabled (the static zero-cost skip: nothing traces).
+
+    ``link`` is the [R, R] delivered-copies matrix (receiver region row,
+    source region column), ``useful``/``dup`` the effective-fanout
+    split, and ``lat_rounds``/``newly`` feed the rumor-age histogram —
+    ages since commit of the pairs first delivered THIS round, on the
+    ``RUMOR_AGE_EDGES`` buckets. The analysis plane (CT010) resolves a
+    ``**prop_curves(...)`` expansion to ``PROP_CURVE_KEYS`` statically,
+    so schema parity stays checkable.
+    """
+    if not enabled:
+        return {}
+    out = {
+        "prop_useful_msgs": useful.astype(jnp.uint32),
+        "prop_dup_msgs": dup.astype(jnp.uint32),
+    }
+    out.update(link_curves(link))
+    out.update(
+        delivery_latency_hist(
+            lat_rounds, newly, edges=RUMOR_AGE_EDGES, keys=RUMOR_AGE_KEYS
+        )
+    )
+    return out
 
 
 def round_curves(**stats) -> dict:
@@ -176,6 +265,17 @@ def round_curves(**stats) -> dict:
         k: stats[k] if k in stats else jnp.uint32(0)
         for k in ROUND_CURVE_KEYS
     }
+
+
+def curve_array(curves: dict, key: str) -> np.ndarray:
+    """Curve as float64, zero-filled to the record's round count when
+    the key is absent (old flight files predating a plane replay as
+    all-zero for it) — the one fallback convention every host-side
+    analyzer (sim/health.py, obs/epidemic.py) shares."""
+    if key in curves:
+        return np.asarray(curves[key], dtype=np.float64)
+    n = len(np.asarray(curves.get("round", curves.get("msgs", []))))
+    return np.zeros(n, dtype=np.float64)
 
 
 FLIGHT_SCHEMA = "corro-flight/1"
@@ -385,10 +485,36 @@ def publish_curves(registry, curves: dict, engine: str = "dense") -> None:
     ``<series>_last{engine=...}`` gauges to their end-of-run value
     (their sums are still published so totals always equal summed
     curves). ``corro_kernel_rounds_total`` counts simulated rounds.
+
+    The propagation plane's per-link and per-bucket curves stay in the
+    flight record only (16 + 15 series per engine would bloat the
+    scrape surface); the metrics bridge carries their AGGREGATES
+    instead: ``corro_kernel_prop_link_same_region_total`` /
+    ``corro_kernel_prop_link_cross_region_total`` (delivered copies by
+    region relation) and ``corro_kernel_prop_rumor_events_total``
+    (first deliveries the rumor-age histogram bucketed).
     """
+    link_total = {"same": 0.0, "cross": 0.0}
+    link_seen = False
+    rumor_total = 0.0
+    rumor_seen = False
     n = 0
     for k in ROUND_CURVE_KEYS:
         if k not in curves:
+            continue
+        if k in LINK_CURVE_KEYS:
+            link_seen = True
+            i, j = k[len("link_"):]
+            rel = "same" if i == j else "cross"
+            link_total[rel] += float(
+                np.asarray(curves[k], dtype=np.float64).sum()
+            )
+            continue
+        if k in RUMOR_AGE_KEYS:
+            rumor_seen = True
+            rumor_total += float(
+                np.asarray(curves[k], dtype=np.float64).sum()
+            )
             continue
         arr = np.asarray(curves[k], dtype=np.float64)
         n = max(n, arr.size)
@@ -401,6 +527,19 @@ def publish_curves(registry, curves: dict, engine: str = "dense") -> None:
                 f"{series_name(k)}_last",
                 f"kernel plane: end-of-run {k}",
             ).set(float(arr[-1]), engine=engine)
+    if link_seen:
+        for rel, help_ in (
+            ("same", "within one region"), ("cross", "between regions"),
+        ):
+            registry.counter(
+                f"corro_kernel_prop_link_{rel}_region_total",
+                f"propagation plane: delivered copies {help_}",
+            ).inc(link_total[rel], engine=engine)
+    if rumor_seen:
+        registry.counter(
+            "corro_kernel_prop_rumor_events_total",
+            "propagation plane: first deliveries bucketed by rumor age",
+        ).inc(rumor_total, engine=engine)
     registry.counter(
         "corro_kernel_rounds_total", "kernel plane: simulated rounds"
     ).inc(float(n), engine=engine)

@@ -1,0 +1,107 @@
+"""Propagation-plane smoke: record the geo scenario, fit, and gate.
+
+The local/CI acceptance harness for the propagation-topology plane
+(docs/OBSERVABILITY.md "Propagation plane"): runs the fixed-seed
+WAN/geo churned scenario with the propagation observables on, derives
+the ``corro-epidemic/1`` report, asserts the hard identities —
+
+- on-device accounting reconciles (link mass == msgs, rumor mass ==
+  first deliveries, useful + dup == msgs),
+- the SI fit stands with a positive spread exponent bounded above by
+  the push-gossip theory beta = ln(1 + F),
+
+— and, when a committed ``EPIDEMIC_BASELINE.json`` exists next to the
+repo root, diffs the fresh report against it at the CI tolerance. Exit
+0 = all green; 1 = a broken identity, a failed fit, or a baseline
+regression.
+
+Usage: python scripts/epidemic_smoke.py [--out REPORT.json]
+       [--nodes N] [--rounds R] [--tolerance T]
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(_sys.argv[0] or ".")))
+)
+
+import json
+import os
+import sys
+import tempfile
+
+
+def _arg(flag: str, default, cast):
+    for i, a in enumerate(sys.argv):
+        if a == flag and i + 1 < len(sys.argv):
+            return cast(sys.argv[i + 1])
+        if a.startswith(flag + "="):
+            return cast(a.split("=", 1)[1])
+    return default
+
+
+def main() -> int:
+    from corrosion_tpu.obs import epidemic
+    from corrosion_tpu.sim import health
+
+    nodes = _arg("--nodes", 96, int)
+    rounds = _arg("--rounds", 48, int)
+    tolerance = _arg("--tolerance", 0.35, float)
+    out = _arg("--out", None, str)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        flight = os.path.join(tmp, "epidemic_smoke.jsonl")
+        facts = health.record_demo_flight(
+            flight, nodes=nodes, rounds=rounds, churn=True, seed=0,
+            progress=sys.stderr, geo=True,
+        )
+        rep = epidemic.report_from_flight(
+            flight, fanout=facts["fanout"], nodes=nodes,
+            geo_regions=facts["regions"],
+        )
+    failures: list[str] = []
+    if not rep["checks_ok"]:
+        failures += [f"accounting: {p}" for p in rep["check_problems"]]
+    if not rep["fit"]["fitted"]:
+        failures.append("SI fit abstained on the geo scenario")
+    else:
+        beta = rep["spread_exponent"]
+        theory = rep["theory"]["spread_exponent"]
+        if not 0.0 < beta <= 1.1 * theory:
+            failures.append(
+                f"spread exponent {beta:.4f} outside (0, 1.1*theory="
+                f"{1.1 * theory:.4f}] — theory is an upper bound"
+            )
+    baseline = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "EPIDEMIC_BASELINE.json",
+    )
+    diff = None
+    if os.path.exists(baseline):
+        base = epidemic.load_report(baseline)
+        diff = epidemic.diff_reports(base, rep, tolerance=tolerance)
+        failures += [f"baseline: {r}" for r in diff["regressions"]]
+
+    report = {
+        "ok": not failures,
+        "failures": failures,
+        "facts": facts,
+        "report": rep,
+        "baseline_diff": diff,
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(epidemic.render_report(rep))
+    for fmsg in failures:
+        print(f"epidemic_smoke: FAIL {fmsg}", file=sys.stderr)
+    print(f"epidemic_smoke: {'OK' if not failures else 'FAILED'}",
+          file=sys.stderr)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,378 @@
+"""Propagation-topology plane tests (sim/telemetry.PROP_CURVE_KEYS +
+obs/epidemic.py).
+
+Covers the on-device observables' conservation identities (link-matrix
+mass == msgs, rumor-age mass == first deliveries, useful + dup == msgs)
+under clean and churn+loss schedules, the static zero-cost-skip pin
+(disabled propagation leaves every other curve and the final state
+bit-identical), CT010 static parity of the new keys across all four
+engines, shard-count invariance of the link matrix plus the
+traffic_model cross-check, the SI/logit fit, the corro-epidemic/1
+report + diff gate, and the host-oracle cross-validation path.
+"""
+
+import json
+import math
+
+import jax
+import numpy as np
+import pytest
+
+from corrosion_tpu.obs import epidemic
+from corrosion_tpu.sim import health, simulate
+from corrosion_tpu.sim import telemetry as T
+from corrosion_tpu.sim.engine import Schedule
+
+
+def _geo_run(nodes=64, rounds=32, seed=0, **sched_kw):
+    cfg, topo, sched, kills = health.churned_demo_cluster(
+        nodes=nodes, rounds=rounds, samples=32, churn=True, seed=seed,
+        geo=True,
+    )
+    for k, v in sched_kw.items():
+        setattr(sched, k, v)
+    final, curves = simulate(cfg, topo, sched, seed=seed)
+    return cfg, topo, sched, final, curves
+
+
+@pytest.fixture(scope="module")
+def geo_run():
+    return _geo_run()
+
+
+def _mass(curves, keys):
+    return sum(np.asarray(curves[k], np.float64) for k in keys)
+
+
+def test_conservation_identities_geo(geo_run):
+    """Per round: the link matrix partitions msgs, the rumor-age
+    histogram partitions first deliveries, useful+dup partitions the
+    delivered copies."""
+    *_, curves = geo_run
+    np.testing.assert_array_equal(
+        _mass(curves, T.LINK_CURVE_KEYS), curves["msgs"]
+    )
+    np.testing.assert_array_equal(
+        _mass(curves, T.RUMOR_AGE_KEYS), curves["vis_count"]
+    )
+    np.testing.assert_array_equal(
+        curves["prop_useful_msgs"] + curves["prop_dup_msgs"],
+        curves["msgs"],
+    )
+    ok, problems = epidemic.conservation_checks(curves)
+    assert ok, problems
+    # The geo geography actually exercises cross-region links.
+    m = epidemic.link_matrix(curves)
+    assert np.trace(m) > 0 and m.sum() > np.trace(m)
+
+
+def test_rumor_mass_conserved_under_churn_and_loss():
+    """Satellite property: mass conservation must survive the chaos
+    axes — injected per-region loss, probe loss, and the scenario's
+    kill/revive wave all composing in one schedule."""
+    rng = np.random.default_rng(7)
+    rounds = 32
+    loss = (rng.random((rounds, health.GEO_REGIONS)) * 0.4).astype(
+        np.float32
+    )
+    probe = (rng.random(rounds) * 0.3).astype(np.float32)
+    *_, curves = _geo_run(
+        rounds=rounds, seed=7, loss=loss, probe_loss=probe
+    )
+    np.testing.assert_array_equal(
+        _mass(curves, T.RUMOR_AGE_KEYS), curves["vis_count"]
+    )
+    np.testing.assert_array_equal(
+        _mass(curves, T.LINK_CURVE_KEYS), curves["msgs"]
+    )
+    np.testing.assert_array_equal(
+        curves["prop_useful_msgs"] + curves["prop_dup_msgs"],
+        curves["msgs"],
+    )
+    assert curves["chaos_lost_msgs"].sum() > 0  # the loss really fired
+
+
+def test_disabled_prop_is_bit_identical(geo_run):
+    """The static-skip pin, applied to this plane: observation must
+    change nothing. The same schedule with prop_observe off produces
+    bit-identical non-propagation curves and final state (no RNG is
+    consumed, no protocol work reordered), and the propagation keys
+    zero-fill."""
+    from dataclasses import replace
+
+    cfg, topo, sched, final, curves = geo_run
+    cfg_off = replace(cfg, gossip=replace(cfg.gossip, prop_observe=False))
+    final_off, curves_off = simulate(cfg_off, topo, sched, seed=0)
+    for k in T.ROUND_CURVE_KEYS:
+        if k in T.PROP_CURVE_KEYS:
+            assert (np.asarray(curves_off[k]) == 0).all(), k
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(curves[k]), np.asarray(curves_off[k]), err_msg=k
+            )
+    for a, b in zip(
+        jax.tree.leaves((final.data, final.swim, final.vis_round)),
+        jax.tree.leaves((final_off.data, final_off.swim,
+                         final_off.vis_round)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prop_keys_statically_emitted_by_all_engines():
+    """CT010 parity: every engine's round_curves call site resolves the
+    propagation keys statically (the ``**prop_curves(...)`` expansion),
+    so an engine dropping the plane fails the lint, not a run."""
+    import os
+
+    from corrosion_tpu.analysis import schema
+    from corrosion_tpu.analysis.source import SourceModule
+
+    pkg = os.path.dirname(
+        os.path.dirname(os.path.abspath(T.__file__))
+    )
+    canonical = schema.extract_canonical(
+        os.path.join(pkg, "sim", "telemetry.py")
+    )
+    assert canonical["PROP_CURVE_KEYS"] == T.PROP_CURVE_KEYS
+    assert canonical["LINK_CURVE_KEYS"] == T.LINK_CURVE_KEYS
+    assert canonical["RUMOR_AGE_KEYS"] == T.RUMOR_AGE_KEYS
+    for eng in ("engine.py", "sparse_engine.py", "chunk_engine.py",
+                "mixed_engine.py"):
+        path = os.path.join(pkg, "sim", eng)
+        mod = SourceModule(path, open(path).read())
+        keys, findings = schema.emitted_keys(mod, canonical)
+        assert not findings, (eng, [f.message for f in findings])
+        assert set(T.PROP_CURVE_KEYS) <= set(keys), eng
+
+
+def test_link_matrix_shard_invariant_and_traffic_model():
+    """Acceptance: on a sharded run the kernel link matrix equals the
+    unsharded one bit-for-bit and the measured exchange bytes equal
+    shard_driver.traffic_model per round."""
+    from dataclasses import replace
+
+    from jax.sharding import Mesh
+
+    from corrosion_tpu import models, parallel
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cfg, topo, sched = models.wan_100k(
+        n=32, n_regions=4, n_writers=8, rounds=10, samples=8,
+        partition=False,
+    )
+    sched.writes[:, :] = 0
+    sched.writes[:4, :] = 1
+    sched = sched.make_samples(8)
+    cfg = replace(cfg, gossip=replace(cfg.gossip, prop_observe=True))
+    _, ref = simulate(cfg, topo, sched, seed=0)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("node",))
+    _, got = parallel.shard_driver.simulate_sharded(
+        cfg, topo, sched, mesh, seed=0
+    )
+    for k in T.PROP_CURVE_KEYS:
+        np.testing.assert_array_equal(
+            np.asarray(ref[k]), np.asarray(got[k]), err_msg=k
+        )
+    ok, problems = epidemic.xshard_model_check(got, cfg.gossip, mesh)
+    assert ok, problems
+    ok_ref, _ = epidemic.conservation_checks(got)
+    assert ok_ref
+
+
+def test_fit_si_recovers_logistic_beta():
+    """The logit fit on exact logistic coverage recovers beta and the
+    half-coverage point to float precision."""
+    beta, n = 0.9, 500.0
+    pts = []
+    for t in T.RUMOR_AGE_EDGES:
+        s = n / (1.0 + (n - 1.0) * math.exp(-beta * t))
+        pts.append((float(t), s / n))
+    fit = epidemic.fit_si(pts)
+    assert fit["fitted"]
+    assert abs(fit["spread_exponent"] - beta) < 1e-6
+    assert abs(fit["half_coverage_round"] - math.log(n - 1.0) / beta) < 1e-4
+    assert fit["r2"] > 0.999999
+
+
+def test_fit_abstains_on_degenerate_curve():
+    fit = epidemic.fit_si([(1.0, 1.0), (2.0, 1.0), (4.0, 1.0)])
+    assert not fit["fitted"]
+    assert fit["spread_exponent"] is None
+
+
+def test_epidemic_report_fits_geo_scenario(geo_run):
+    """Acceptance: the fixed-seed geo scenario's report fits the SI
+    model with a positive spread exponent bounded above by the
+    push-gossip theory (theory assumes zero redundancy, so measured
+    must sit below it but within the same order)."""
+    cfg, topo, sched, _final, curves = geo_run
+    rep = epidemic.build_report(
+        curves, engine="dense", fanout=cfg.gossip.fanout, nodes=64,
+        geo_regions=health.GEO_REGIONS,
+    )
+    assert rep["checks_ok"], rep["check_problems"]
+    assert rep["fit"]["fitted"]
+    beta = rep["spread_exponent"]
+    theory = rep["theory"]["spread_exponent"]
+    assert 0.15 * theory < beta <= 1.1 * theory, (beta, theory)
+    assert rep["fit_r2"] > 0.5
+    assert 0.0 < rep["redundancy_ratio"] < 1.0
+    assert rep["half_coverage_round"] is not None
+    assert rep["traffic"]["cross_region_share"] > 0
+    assert "ring_shares" in rep["traffic"]
+    # Renders without error and mentions the verdict surface.
+    text = epidemic.render_report(rep)
+    assert "spread:" in text and "accounting: OK" in text
+
+
+def test_epidemic_diff_clean_and_regression(geo_run):
+    cfg, *_rest, curves = geo_run
+    rep = epidemic.build_report(curves, fanout=cfg.gossip.fanout)
+    clean = epidemic.diff_reports(rep, rep, tolerance=0.25)
+    assert not clean["regressions"]
+    worse = dict(rep)
+    worse["spread_exponent"] = rep["spread_exponent"] * 0.4
+    worse["effective_fanout"] = rep["effective_fanout"] * 0.3
+    diff = epidemic.diff_reports(rep, worse, tolerance=0.25)
+    assert any("spread_exponent" in r for r in diff["regressions"])
+    assert any("effective_fanout" in r for r in diff["regressions"])
+    broken = dict(rep)
+    broken["checks_ok"] = False
+    broken["check_problems"] = ["synthetic"]
+    assert epidemic.diff_reports(rep, broken)["regressions"]
+
+
+def test_report_from_flight_and_cli_roundtrip(tmp_path, geo_run):
+    """Flight JSONL -> report -> load_report round-trips; a flight
+    recorded without the plane is refused loudly."""
+    cfg, topo, sched, _final, _curves = geo_run
+    path = str(tmp_path / "geo.jsonl")
+    tele = T.KernelTelemetry(
+        engine="dense", recorder=T.FlightRecorder(path, engine="dense")
+    )
+    simulate(cfg, topo, sched, seed=0, max_chunk=16, telemetry=tele)
+    tele.recorder.close()
+    rep = epidemic.report_from_flight(
+        path, fanout=cfg.gossip.fanout, nodes=64, geo_regions=4
+    )
+    assert rep["checks_ok"] and rep["engine"] == "dense"
+    out = tmp_path / "rep.json"
+    out.write_text(json.dumps(rep))
+    loaded = epidemic.load_report(str(out))
+    assert loaded["spread_exponent"] == rep["spread_exponent"]
+    # load_report also accepts the raw flight.
+    from_flight = epidemic.load_report(
+        path, fanout=cfg.gossip.fanout, nodes=64, geo_regions=4
+    )
+    assert from_flight["spread_exponent"] == rep["spread_exponent"]
+    # A prop-less flight is refused with a pointed error.
+    cfg2, topo2, sched2, _k = health.churned_demo_cluster(
+        nodes=32, rounds=16, samples=8, churn=False, seed=1
+    )
+    p2 = str(tmp_path / "flat.jsonl")
+    tele2 = T.KernelTelemetry(
+        engine="dense", recorder=T.FlightRecorder(p2, engine="dense")
+    )
+    simulate(cfg2, topo2, sched2, seed=1, max_chunk=8, telemetry=tele2)
+    tele2.recorder.close()
+    with pytest.raises(ValueError, match="prop_observe off"):
+        epidemic.report_from_flight(p2)
+
+
+def test_committed_baseline_schema_and_self_diff():
+    """The committed EPIDEMIC_BASELINE.json is a valid corro-epidemic/1
+    report whose accounting reconciled and whose fit stood — the CI
+    gate's left-hand side can never be a broken instrument."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = epidemic.load_report(
+        os.path.join(root, "EPIDEMIC_BASELINE.json")
+    )
+    assert base["schema"] == epidemic.EPIDEMIC_SCHEMA
+    assert base["checks_ok"] and base["fit"]["fitted"]
+    assert not epidemic.diff_reports(base, base)["regressions"]
+
+
+def test_oracle_coverage_cross_validation():
+    """Host-plane path: synthetic oracle delivery records whose ages
+    follow a logistic spread land on the same bucket axis and fit."""
+    rng = np.random.default_rng(3)
+    round_s = 0.5
+    writes, deliveries = [], []
+    beta, n = 0.8, 64
+    for w in range(40):
+        ack = 100.0 + w * 0.3
+        writes.append({"key": w, "t_ack_wall": ack})
+        # Inverse-CDF sample of the logistic first-delivery age.
+        for _ in range(16):
+            u = rng.uniform(1.0 / n, 1.0 - 1e-3)
+            age = max(
+                (math.log(u / (1 - u)) + math.log(n - 1.0)) / beta, 0.05
+            )
+            deliveries.append({
+                "kind": "change", "key": w,
+                "t_wall": ack + age * round_s,
+            })
+    block = epidemic.oracle_coverage(
+        {"writes": writes, "deliveries": deliveries}, round_ms=500.0
+    )
+    assert block["events"] == len(deliveries)
+    assert block["fit"]["fitted"]
+    assert abs(block["spread_exponent"] - beta) < 0.35 * beta
+    assert sum(block["rumor_age_hist"]) == block["events"]
+
+
+def test_publish_epidemic_and_curve_aggregates(geo_run):
+    from corrosion_tpu.utils.metrics import MetricsRegistry
+
+    cfg, *_rest, curves = geo_run
+    reg = MetricsRegistry()
+    T.publish_curves(reg, curves, engine="dense")
+    same = reg.counter(
+        "corro_kernel_prop_link_same_region_total"
+    ).get(engine="dense")
+    cross = reg.counter(
+        "corro_kernel_prop_link_cross_region_total"
+    ).get(engine="dense")
+    assert same + cross == float(np.asarray(curves["msgs"]).sum())
+    assert reg.counter(
+        "corro_kernel_prop_rumor_events_total"
+    ).get(engine="dense") == float(
+        np.asarray(curves["vis_count"]).sum()
+    )
+    rep = epidemic.build_report(curves, fanout=cfg.gossip.fanout)
+    epidemic.publish_epidemic(reg, rep, engine="dense")
+    got = reg.gauge("corro_kernel_epidemic_spread_exponent").get(
+        engine="dense"
+    )
+    assert got == pytest.approx(rep["spread_exponent"])
+
+
+def test_geo_scenario_variant_shape():
+    """The geo family: 4 contiguous regions, ring classes spanning the
+    synthetic circle's range, writers spread across regions, prop plane
+    on — while the default (flat) variant is untouched (writers 0..W-1,
+    single region, prop off)."""
+    cfg, topo, sched, kills = health.churned_demo_cluster(
+        nodes=64, rounds=16, samples=8, churn=True, seed=0, geo=True
+    )
+    assert cfg.gossip.prop_observe
+    region = np.asarray(topo.region)
+    assert region.max() == health.GEO_REGIONS - 1
+    rtt = np.asarray(topo.region_rtt)
+    assert rtt.max() == 5 and rtt.min() == 0
+    writer_regions = set(region[np.asarray(topo.writer_nodes)].tolist())
+    assert len(writer_regions) == health.GEO_REGIONS
+    # Kill victims never host writers (sampled-write bookkeeping).
+    kill_nodes = np.nonzero(np.asarray(sched.kill).any(axis=0))[0]
+    assert not set(kill_nodes) & set(np.asarray(topo.writer_nodes))
+    cfg2, topo2, *_ = health.churned_demo_cluster(
+        nodes=64, rounds=16, samples=8, churn=True, seed=0
+    )
+    assert not cfg2.gossip.prop_observe
+    assert np.asarray(topo2.region).max() == 0
+    np.testing.assert_array_equal(
+        np.asarray(topo2.writer_nodes), np.arange(8)
+    )

@@ -121,12 +121,26 @@ def test_flight_recorder_chunked_run_and_metrics_bridge(tmp_path):
 
     # Metrics bridge: totals equal summed curves, on the same renderer
     # the agent plane uses. Health-plane keys render under the
-    # corro_kernel_health_ prefix (T.series_name).
+    # corro_kernel_health_ prefix (T.series_name). The propagation
+    # plane's per-link/per-bucket curves stay flight-record-only: the
+    # bridge carries their aggregates instead (publish_curves
+    # docstring; the aggregate identities are pinned in
+    # tests/test_epidemic.py).
     text = reg.render()
+    per_key_agg = set(T.LINK_CURVE_KEYS) | set(T.RUMOR_AGE_KEYS)
     for k in T.ROUND_CURVE_KEYS:
+        if k in per_key_agg:
+            assert f"{T.series_name(k)}_total" not in text, k
+            continue
         got = reg.counter(f"{T.series_name(k)}_total").get(engine="dense")
         assert got == float(curves[k].astype(np.float64).sum()), k
         assert f"{T.series_name(k)}_total" in text
+    for agg in (
+        "corro_kernel_prop_link_same_region_total",
+        "corro_kernel_prop_link_cross_region_total",
+        "corro_kernel_prop_rumor_events_total",
+    ):
+        assert agg in text
     assert reg.counter("corro_kernel_rounds_total").get(engine="dense") == 24
     assert reg.gauge("corro_kernel_need_last").get(engine="dense") == float(
         curves["need"][-1]

@@ -462,3 +462,150 @@ def test_log_format_selection(capsys):
         for h in list(root.handlers):
             if getattr(h, "_corro_log", False):
                 root.removeHandler(h)
+
+
+def test_tail_follow_survives_flight_rotation(tmp_path):
+    """`obs tail --follow` regression (propagation-plane PR satellite):
+    the size-capped recorder renames the live flight file at chunk
+    boundaries; a follower holding the old handle must drain it, replay
+    any rotated segments it missed, and resume on the fresh live file —
+    every round record seen exactly once, in order, across multiple
+    rotations. Driven single-threaded through the generator's own state
+    machine: the writer rotates while the reader generator is suspended
+    mid-iteration."""
+    import numpy as np
+
+    from corrosion_tpu.sim import health
+    from corrosion_tpu.sim import telemetry as T2
+
+    path = str(tmp_path / "flight.jsonl")
+    # Cap sized so EVERY chunk overflows it: each record_chunk rotates,
+    # leaving the follower multiple whole segments behind.
+    rec = T2.FlightRecorder(path, engine="dense", mode="w", max_bytes=200)
+
+    def chunk(start, n=3):
+        rec.record_chunk(
+            start,
+            {"msgs": np.arange(start, start + n, dtype=np.uint32)},
+        )
+
+    gen = health.iter_flight(
+        path, follow=True, poll_s=0.01, idle_timeout_s=0.4
+    )
+    # Attach the reader to the ORIGINAL live file (consume its header),
+    # so every subsequent rotation happens under the open handle.
+    first = next(gen)
+    assert first.get("kind") == "flight" and first.get("segment") == 0
+    seen = []
+    chunk(0)
+    chunk(3)
+    chunk(6)
+    rec.close()
+    for obj in gen:  # drains the old handle, then replays the chain
+        if obj.get("kind") == "round":
+            seen.append(obj["round"])
+    assert seen == list(range(9)), seen
+    # The cap really rotated, repeatedly (else this test pins nothing).
+    assert len(T2.flight_segments(path)) >= 4
+    # And the offline reader agrees with the follower.
+    curves, _chunks = T2.replay_flight(path)
+    assert curves["round"].tolist() == list(range(9))
+
+
+def test_metric_names_match_docs():
+    """Metrics-name drift gate (propagation-plane PR satellite): the
+    docs/OBSERVABILITY.md reference table must equal the set of series
+    this codebase can actually register — literal registrations found
+    statically plus the dynamically-built kernel names. A new metric
+    (including the epidemic gauges) cannot land undocumented, and a
+    documented row cannot outlive its series."""
+    import os
+
+    from corrosion_tpu.obs import metrics_ref
+
+    docs = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "OBSERVABILITY.md",
+    )
+    documented = metrics_ref.documented_metric_names(docs)
+    registered = metrics_ref.registered_metric_names()
+    undocumented = sorted(registered - documented)
+    stale = sorted(documented - registered)
+    assert not undocumented and not stale, (
+        f"metrics reference drift — undocumented: {undocumented}; "
+        f"stale doc rows: {stale}. Regenerate the block between the "
+        f"metrics-ref markers with obs/metrics_ref.render_reference()."
+    )
+
+
+def test_tail_missing_flight_path_raises():
+    """A missing/typo'd flight path must raise, not read as a
+    successful empty tail — only the mid-rotation absence of an
+    already-followed live file is tolerated."""
+    from corrosion_tpu.sim import health
+
+    with pytest.raises(FileNotFoundError):
+        next(health.iter_flight("/definitely/not/a/flight.jsonl"))
+    with pytest.raises(FileNotFoundError):
+        next(health.iter_flight(
+            "/definitely/not/a/flight.jsonl", follow=True,
+            idle_timeout_s=0.1,
+        ))
+
+
+def test_tail_follow_replays_segment_missed_by_probe_race(
+    tmp_path, monkeypatch
+):
+    """The check-then-open race: the recorder rotates between the
+    follower's exists() probe and its open of the live file, so the
+    follower lands on a live file whose header segment is PAST the next
+    unread one. It must redirect to the missed rotated segment (yielding
+    nothing from the aborted visit) and only then resume — no record
+    lost or duplicated. Simulated by failing the exists() probe once."""
+    import json as _json
+    import os
+
+    from corrosion_tpu.sim import health
+
+    path = str(tmp_path / "flight.jsonl")
+
+    def seg_file(p, seg, rounds):
+        with open(p, "w") as f:
+            f.write(_json.dumps(
+                {"kind": "flight", "schema": "corro-flight/1",
+                 "version": 1, "engine": "dense", "segment": seg}
+            ) + "\n")
+            for r in rounds:
+                f.write(_json.dumps({"kind": "round", "round": r}) + "\n")
+
+    seg_file(path, 0, range(0, 3))
+    gen = health.iter_flight(
+        path, follow=True, poll_s=0.01, idle_timeout_s=0.4
+    )
+    seen = []
+    for obj in gen:
+        if obj.get("kind") == "round":
+            seen.append(obj["round"])
+        if len(seen) == 3:
+            break
+    # Two rotations happen "while" the follower is suspended; the probe
+    # for the first missed segment is then made to fail exactly once,
+    # modeling a third rotation landing between probe and open.
+    os.replace(path, path + ".1")
+    seg_file(path + ".2", 1, range(3, 6))
+    seg_file(path, 2, range(6, 9))
+    real_exists = os.path.exists
+    missed_once = {"done": False}
+
+    def flaky_exists(p):
+        if p == path + ".2" and not missed_once["done"]:
+            missed_once["done"] = True
+            return False
+        return real_exists(p)
+
+    monkeypatch.setattr(os.path, "exists", flaky_exists)
+    for obj in gen:
+        if obj.get("kind") == "round":
+            seen.append(obj["round"])
+    assert seen == list(range(9)), seen
+    assert missed_once["done"]  # the race path really ran
