@@ -149,6 +149,17 @@ class AgentConfig:
     # — deterministic per trace id, so every hop of a kept trace keeps it
     # and a 2k-subscription storm can thin its span volume consistently.
     trace_sample: float = 1.0
+    # Endurance plane (docs/OBSERVABILITY.md "Endurance plane"): stream
+    # one whole-registry snapshot per runtime-metrics tick to a
+    # corro-metric-series/1 JSONL (obs/series.py). None = not installed;
+    # the loop takes ONE `is None` branch and is otherwise bit-identical
+    # (pinned). Relaunch in the same process reattaches (mode="a"), so
+    # kill_restart soaks keep one continuous, reset-annotated series.
+    metric_series_path: str | None = None
+    metric_series_max_bytes: int | None = None
+    # Runtime-metrics/series sampling cadence; soak lanes compress it
+    # into test time like every other interval knob.
+    runtime_metrics_interval: float = 1.0
 
 
 @dataclass
@@ -254,6 +265,12 @@ class Agent:
         _removed = self.metrics.counter(
             "corro_gossip_member_removed", "members forgotten (down GC)"
         )
+        # Zero-seed: a churn-free life must still EXPOSE the series (a 0
+        # on the scrape and in every metric-series snapshot), so the
+        # endurance plane's probe-false-alarm budget arms on a clean
+        # soak instead of silently never evaluating.
+        _added.inc(0)
+        _removed.inc(0)
         self.members.on_added = lambda _aid: _added.inc()
         self.members.on_removed = lambda _aid: _removed.inc()
         self.tracer = Tracer(
@@ -264,6 +281,7 @@ class Agent:
         )
         self._trace_writes = cfg.trace_writes
         self._prom_server = None
+        self._series_recorder = None  # endurance plane, installed lazily
         self.pool = None  # SplitPool, started with the event loop
         # Hot-path metric handles, resolved once.
         self._m_recv_lag = self.metrics.histogram(
@@ -559,6 +577,12 @@ class Agent:
                 srv.close()
         if self.pool is not None:
             await self.pool.close()
+        if self._series_recorder is not None:
+            # Refcounted release (obs/series.py): closing on BOTH the
+            # stop() and abort() paths means a same-process relaunch
+            # reopens the series mode="a" and the record continues.
+            self._series_recorder.close()
+            self._series_recorder = None
         self.tracer.close()
         self.store.close()
 
@@ -1470,8 +1494,25 @@ class Agent:
         # hours-long soak's leak signals are on /metrics, not just in
         # post-hoc reports.
         rss_g, fds_g, lag_g = register_process_gauges(self.metrics)
+        if (
+            self.cfg.metric_series_path
+            and self._series_recorder is None
+        ):
+            # Endurance plane install: attach() is idempotent per path,
+            # so an in-process relaunch (kill_restart) adopts the
+            # previous life's live recorder instead of raising or
+            # double-sampling; a cleanly-closed life reopens mode="a"
+            # and the series continues across the restart discontinuity
+            # (obs/endurance.py rebases the counter drop).
+            from corrosion_tpu.obs.series import MetricSeriesRecorder
+
+            self._series_recorder = MetricSeriesRecorder.attach(
+                self.cfg.metric_series_path,
+                source=f"agent:{self.actor_id[:8]}",
+                max_bytes=self.cfg.metric_series_max_bytes,
+            )
         log = logging.getLogger(__name__)
-        interval = 1.0
+        interval = self.cfg.runtime_metrics_interval
         while not self.tripwire.tripped:
             t0 = time.monotonic()
             await asyncio.sleep(interval)
@@ -1493,6 +1534,13 @@ class Agent:
             fds = process_open_fds()
             if fds is not None:
                 fds_g.set(fds)
+            if self._series_recorder is not None:
+                try:
+                    self._series_recorder.sample(self.metrics)
+                except ValueError:
+                    # Closed under us (abort racing the tick) — the
+                    # loop is about to see the tripwire anyway.
+                    pass
 
     async def _wal_checkpoint_loop(self) -> None:
         """Periodic WAL truncation on the writer, timed (the reference's

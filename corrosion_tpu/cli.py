@@ -200,6 +200,48 @@ def _build_parser() -> argparse.ArgumentParser:
     _epi_common(oed)
     oed.add_argument("--json", action="store_true")
 
+    # Endurance plane (corrosion_tpu/obs/series.py + obs/endurance.py,
+    # docs/OBSERVABILITY.md "Endurance plane"): leak/wedge/stall/SLO
+    # detectors over a recorded corro-metric-series/1 JSONL, and the
+    # SOAK_BASELINE diff gate.
+    osk = ob_sub.add_parser(
+        "soak", parents=[common],
+        help="endurance analyzer: leak/wedge/stall/SLO verdicts from a "
+        "corro-metric-series/1 record, and the SOAK_BASELINE diff gate",
+    )
+    osk_sub = osk.add_subparsers(dest="soak_cmd", required=True)
+
+    osr = osk_sub.add_parser(
+        "report", parents=[common],
+        help="derive the corro-endurance/1 verdict from a metric-series "
+        "JSONL (exit 1 on any leak/wedge/stall/SLO breach)",
+    )
+    osr.add_argument("series", help="corro-metric-series/1 JSONL path")
+    osr.add_argument("--t-scale-s", type=float, default=1.0,
+                     help="seconds per sample-t unit (1.0 for wall-clock "
+                     "series; kernel series record t in rounds)")
+    osr.add_argument("--label", default="",
+                     help="label stamped into the report")
+    osr.add_argument("--wedge-min-span-s", type=float, default=5.0,
+                     help="min flat-while-offered span to call a wedge")
+    osr.add_argument("--leak-ceiling", action="append", default=None,
+                     metavar="NAME=PER_HOUR",
+                     help="override a leak-slope ceiling (repeatable)")
+    osr.add_argument("--json", action="store_true")
+    osr.add_argument("--out", default=None, help="report JSON path")
+
+    osd = osk_sub.add_parser(
+        "diff", parents=[common],
+        help="flag endurance regressions between two soak reports — "
+        "the SOAK_BASELINE.json CI gate",
+    )
+    osd.add_argument("baseline", help="soak/endurance report JSON")
+    osd.add_argument("candidate", help="soak/endurance report JSON")
+    osd.add_argument("--tolerance", type=float, default=0.5,
+                     help="relative leak-slope tolerance (default 0.5); "
+                     "new breaches are never tolerated")
+    osd.add_argument("--json", action="store_true")
+
     otm = ob_sub.add_parser(
         "timeline", parents=[common],
         help="correlate a traced loadgen run's spans + oracle delivery "
@@ -464,6 +506,11 @@ def _build_parser() -> argparse.ArgumentParser:
     lgk.add_argument("--starved-intake", type=int, default=1)
     lgk.add_argument("--seed", type=int, default=0)
     lgk.add_argument("--out", default=None)
+    lgk.add_argument(
+        "--series-out", default=None,
+        help="keep the corro-metric-series/1 process record at this "
+        "path (feedable to `obs soak report`)",
+    )
 
     # Fidelity plane (corrosion_tpu/fidelity, docs/FIDELITY.md): the
     # calibrated round-length model and the mixed-mode live-vs-kernel
@@ -956,7 +1003,7 @@ async def _loadgen(args) -> int:
             write_prob=args.write_prob,
             intake_margin=args.intake_margin,
             starved_intake=args.starved_intake, seed=args.seed,
-            progress=sys.stderr,
+            progress=sys.stderr, series_path=args.series_out,
         )
         report = {
             **serving_context(

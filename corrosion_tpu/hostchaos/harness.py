@@ -29,6 +29,7 @@ verify that the same seed reproduces the identical fault schedule.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -170,9 +171,20 @@ async def run_scenario(
     data_dir: str,
     seed: int = 0,
     progress=None,
+    series_dir: str | None = None,
+    series_interval: float = 0.25,
+    endurance_kw: dict | None = None,
 ) -> dict:
     """Run one scenario end to end; returns the report dict (``ok`` is
-    the overall verdict — oracle, convergence, bookkeeping, machinery)."""
+    the overall verdict — oracle, convergence, bookkeeping, machinery).
+
+    ``series_dir`` arms the ENDURANCE plane: every agent streams one
+    whole-registry snapshot per ``series_interval`` to
+    ``<series_dir>/n<i>.series.jsonl`` (obs/series.py; a killed+
+    relaunched agent reopens its series ``mode="a"`` so the restart
+    discontinuity lands in ONE record), and the report gains an
+    ``endurance`` block with one corro-endurance/1 verdict per agent
+    (obs/endurance.py detectors, tuned via ``endurance_kw``)."""
 
     def note(msg: str) -> None:
         if progress is not None:
@@ -184,12 +196,22 @@ async def run_scenario(
     netem_on = not spec.plan.empty
     cluster_kw: dict = dict(spec.agent_cfg)
     cfg_for = None
-    if netem_on:
+    if netem_on or series_dir is not None:
         def cfg_for(i, _plan=plan_obj, _seed=seed):
-            return {
-                "netem_plan": _plan, "netem_seed": _seed,
-                "netem_node": f"n{i}",
-            }
+            cfg: dict = {}
+            if netem_on:
+                cfg.update({
+                    "netem_plan": _plan, "netem_seed": _seed,
+                    "netem_node": f"n{i}",
+                })
+            if series_dir is not None:
+                cfg.update({
+                    "metric_series_path": os.path.join(
+                        series_dir, f"n{i}.series.jsonl"
+                    ),
+                    "runtime_metrics_interval": series_interval,
+                })
+            return cfg
     note(f"launching {spec.n_agents} agents (netem={netem_on}, seed={seed})")
     agents = await launch_test_cluster(
         data_dir, spec.n_agents, wait_membership=True,
@@ -373,6 +395,32 @@ async def run_scenario(
                 f"(machinery={machinery})"
             )
 
+        endurance_block = None
+        if series_dir is not None:
+            # Judge each agent's recorded series (flush-per-line: the
+            # record is complete up to the last tick even though the
+            # recorders are still open). Replay + detectors live in the
+            # jax-free obs modules.
+            from corrosion_tpu.obs.endurance import build_report
+            from corrosion_tpu.obs.series import replay_series
+
+            per_agent_end: dict[str, dict] = {}
+            for i in range(spec.n_agents):
+                path = os.path.join(series_dir, f"n{i}.series.jsonl")
+                try:
+                    samples = replay_series(path)["samples"]
+                except OSError:
+                    samples = []
+                per_agent_end[f"n{i}"] = build_report(
+                    samples, label=f"{spec.name}:n{i}",
+                    **(endurance_kw or {}),
+                )
+            endurance_block = {
+                "dir": series_dir,
+                "interval_s": series_interval,
+                "agents": per_agent_end,
+            }
+
         netem_block = {}
         if netem_on:
             per_agent = {}
@@ -406,6 +454,7 @@ async def run_scenario(
             "machinery": machinery,
             "machinery_required": list(spec.require_fired),
             "machinery_ok": machinery_ok,
+            "endurance": endurance_block,
             "netem": netem_block,
             "ok": not failures,
             "failures": failures,

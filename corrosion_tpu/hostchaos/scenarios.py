@@ -183,6 +183,39 @@ def flap_soak() -> HostScenario:
     )
 
 
+def soak_churn(scale: float = 1.0) -> HostScenario:
+    """The ENDURANCE soak composition (docs/OBSERVABILITY.md "Endurance
+    plane"): churn (SIGKILL + same-dir restart) + write storm + WAN
+    netem over a CI-sized horizon, run with the metric-series recorder
+    armed (``run_scenario(series_dir=...)``) so every agent's registry
+    movement feeds the leak/wedge/stall/SLO detectors. ``scale``
+    stretches the horizon for the slow-marked long variant (the storm,
+    fault windows, and kill schedule all scale together; rates stay
+    fixed so total traffic grows with the horizon)."""
+    s = scale
+    plan = HostFaultPlan(
+        name="soak_churn",
+        faults=_wan(30.0, 8.0, 0.01) + (
+            HostFault(kind="flap", a=("n1",), start_s=1.0 * s,
+                      stop_s=4.0 * s, period_s=0.7, stall_s=0.12),
+            HostFault(kind="delay", planes=("sync",), start_s=5.0 * s,
+                      stop_s=7.0 * s, delay_ms=280.0, jitter_ms=40.0),
+        ),
+    )
+    return HostScenario(
+        name="soak_churn",
+        plan=plan,
+        n_agents=3, writes=int(80 * s), write_rate=10.0,
+        subs=9, sub_groups=3, subs_on=0,
+        kill=KillSpec(agent=0, t_kill_s=2.0 * s, t_restart_s=3.2 * s),
+        agent_cfg=dict(_BASE_CFG),
+        require_fired=("breaker_trips", "breaker_recoveries"),
+        drain_timeout_s=60.0 * max(1.0, s),
+        notes="WAN + flap churn + SIGKILL-restart with the metric-series "
+              "recorder armed: the standing endurance lane",
+    )
+
+
 SCENARIOS = {
     "wan_steady": wan_steady,
     "partition_heal": partition_heal,
@@ -190,6 +223,7 @@ SCENARIOS = {
     "kill_restart": kill_restart,
     "wan_full": wan_full,
     "flap_soak": flap_soak,
+    "soak_churn": soak_churn,
 }
 
 

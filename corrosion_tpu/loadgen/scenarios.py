@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import os
 import resource
+import tempfile
 import time
 
 from corrosion_tpu.agent.testing import launch_test_cluster, stop_cluster
@@ -387,6 +388,7 @@ def intake_policy(
     starved_intake: int = 1,
     seed: int = 0,
     progress=None,
+    series_path: str | None = None,
 ) -> dict:
     """Scenario (c): the docs/SCALING.md collapse rule, measured.
 
@@ -404,17 +406,37 @@ def intake_policy(
     import numpy as np
 
     from corrosion_tpu.models.baselines import _cfg
+    from corrosion_tpu.obs.series import (
+        MetricSeriesRecorder,
+        record_process_sample,
+        replay_series,
+        series_values,
+    )
     from corrosion_tpu.sim import simulate
     from corrosion_tpu.sim.engine import Schedule
-    from corrosion_tpu.utils.metrics import process_stats
+    from corrosion_tpu.utils.metrics import MetricsRegistry
 
     def note(msg):
         if progress is not None:
             progress.write(f"[loadgen soak] {msg}\n")
             progress.flush()
 
-    proc_start = process_stats()
+    # Process self-observability rides the ONE sampling path every
+    # endurance surface shares (obs/series.record_process_sample):
+    # gauges set from live /proc reads, then a whole-registry snapshot
+    # per section boundary. ``series_path`` keeps the
+    # corro-metric-series/1 record as an artifact (`loadgen soak
+    # --series-out`); by default it lands in a scratch dir.
+    registry = MetricsRegistry()
+    _scratch = None
+    if series_path is None:
+        _scratch = tempfile.TemporaryDirectory()
+        series_path = os.path.join(_scratch.name, "soak.series.jsonl")
+    recorder = MetricSeriesRecorder(
+        series_path, source="loadgen-soak", mode="w"
+    )
     t_start = time.monotonic()
+    record_process_sample(recorder, registry, lag_s=0.0)
 
     # Sustained storm: no drain tail — the collapse rule is about steady
     # state under load, and a drain would let even a starved intake
@@ -455,6 +477,9 @@ def intake_policy(
         }
 
     sized = run_with_intake(int(round(write_rate)) + intake_margin)
+    record_process_sample(
+        recorder, registry, lag_s=time.monotonic() - t_start
+    )
     starved = run_with_intake(starved_intake)
     # Bounded vs divergent, empirically: the sized run's end-of-run
     # backlog holds at a few rounds' worth of cluster write mass
@@ -466,7 +491,21 @@ def intake_policy(
     divergence_ratio = (
         starved["staleness_last"] / max(sized["staleness_last"], 1.0)
     )
-    proc_end = process_stats()
+    record_process_sample(
+        recorder, registry, lag_s=time.monotonic() - t_start
+    )
+    recorder.close()
+    proc_samples = replay_series(series_path)["samples"]
+    if _scratch is not None:
+        _scratch.cleanup()
+        series_path = None
+
+    def _endpoints(name: str) -> tuple[float | None, float | None]:
+        _, vals = series_values(proc_samples, name, family="gauges")
+        return (vals[0], vals[-1]) if vals else (None, None)
+
+    rss0, rss1 = _endpoints("corro_runtime_rss_bytes")
+    fds0, fds1 = _endpoints("corro_runtime_open_fds")
     return {
         "kernel_nodes": nodes,
         "rounds": rounds,
@@ -475,17 +514,20 @@ def intake_policy(
         # ROADMAP-5 soaks need): RSS/fd growth across the run, plus how
         # long the synchronous kernel sections held the event loop —
         # the soak's own loop-lag figure (the whole section IS lag when
-        # run from an async caller; the agent plane samples the same
-        # gauges live on /metrics).
+        # run from an async caller). Start/end are the first/last
+        # samples of the corro-metric-series/1 record above — the same
+        # recorder+gauges path the agent runtime loop and the endurance
+        # detectors consume, not a parallel ad-hoc probe.
         "process": {
-            "start": proc_start,
-            "end": proc_end,
+            "start": {"rss_bytes": rss0, "open_fds": fds0},
+            "end": {"rss_bytes": rss1, "open_fds": fds1},
             "rss_growth_bytes": (
-                proc_end["rss_bytes"] - proc_start["rss_bytes"]
-                if proc_end["rss_bytes"] is not None
-                and proc_start["rss_bytes"] is not None else None
+                rss1 - rss0
+                if rss1 is not None and rss0 is not None else None
             ),
             "loop_held_s": round(time.monotonic() - t_start, 3),
+            "samples": len(proc_samples),
+            "series_path": series_path,
         },
         "sized": sized,
         "starved": starved,

@@ -34,15 +34,26 @@ CLI group exposes (promoted out of ``cli.py``):
   push-gossip theory, region-pair traffic shares, redundancy, the
   traffic-model and host-oracle cross-validations, and the
   ``EPIDEMIC_BASELINE`` diff gate.
+- :mod:`corrosion_tpu.obs.series` — the endurance plane's temporal
+  half: periodic whole-registry snapshots (counters/gauges/histogram
+  bucket vectors) streamed to a rotating ``corro-metric-series/1``
+  JSONL, installable in the agent runtime loop and at KernelTelemetry
+  chunk boundaries (byte-deterministic under a clock-less recorder).
+- :mod:`corrosion_tpu.obs.endurance` — the detectors over recorded
+  series (``corro-endurance/1``): Theil–Sen leak slopes in units/hour,
+  counter-reset classification (restart/wraparound/decrease), wedge and
+  loop-lag stall runs, multi-window SLO burn rates, plus the soak
+  report diff and the bench_budget ``soak`` gate with its
+  machinery-fired rule.
 - :mod:`corrosion_tpu.obs.metrics_ref` — the metrics-name drift check:
   the documented ``corro_*`` series table vs every name the codebase
   can register (static literals + the dynamic kernel publishers).
 - :mod:`corrosion_tpu.obs.commands` — the CLI entrypoints
-  (``obs report|tail|diff|record|epidemic|timeline|cost|trajectory``).
+  (``obs report|tail|diff|record|epidemic|timeline|cost|trajectory|soak``).
 
 Everything host-side; ``journey``/``commands`` import jax transitively
 through ``sim`` (``costs``/``ledger`` import jax directly),
-``timeline``/``trajectory`` do not.
+``timeline``/``trajectory``/``series``/``endurance`` do not.
 """
 
 from corrosion_tpu.obs.timeline import (  # noqa: F401

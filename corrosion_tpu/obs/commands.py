@@ -30,6 +30,8 @@ def run(args) -> int:
         return _trajectory(args)
     if args.obs_cmd == "epidemic":
         return _epidemic(args)
+    if args.obs_cmd == "soak":
+        return _soak(args)
 
     from corrosion_tpu.sim import health
 
@@ -184,6 +186,70 @@ def _epidemic(args) -> int:
             print(f"obs epidemic diff: {e}", file=sys.stderr)
             return 2
         diff = epidemic.diff_reports(base, cand, tolerance=args.tolerance)
+        if args.json:
+            print(json.dumps(diff))
+        else:
+            for row in diff["rows"]:
+                mark = "ok" if row["ok"] else "REGRESSION"
+                print(
+                    f"{row['metric']}: {row['baseline']} -> "
+                    f"{row['candidate']} [{mark}]"
+                )
+            for r in diff["regressions"]:
+                print(f"REGRESSION: {r}", file=sys.stderr)
+        return 1 if diff["regressions"] else 0
+    return 2
+
+
+def _soak(args) -> int:
+    """`obs soak {report,diff}` — the endurance plane's analyzer
+    (obs/series.py + obs/endurance.py, docs/OBSERVABILITY.md "Endurance
+    plane"). jax-free: judging a recorded series must not pay the
+    kernel import. Exit 0 = verdict ok, 1 = breach/regression, 2 =
+    usage."""
+    from corrosion_tpu.obs import endurance
+    from corrosion_tpu.obs.series import replay_series
+
+    if args.soak_cmd == "report":
+        try:
+            samples = replay_series(args.series)["samples"]
+        except (OSError, ValueError) as e:
+            print(f"obs soak report: {e}", file=sys.stderr)
+            return 2
+        ceilings: dict = {}
+        for spec in args.leak_ceiling or ():
+            name, _, val = spec.partition("=")
+            try:
+                ceilings[name] = float(val)
+            except ValueError:
+                print(
+                    f"obs soak report: bad --leak-ceiling {spec!r} "
+                    f"(want NAME=UNITS_PER_HOUR)", file=sys.stderr,
+                )
+                return 2
+        rep = endurance.build_report(
+            samples, t_scale_s=args.t_scale_s, label=args.label,
+            leak_ceilings=ceilings or None,
+            wedge_min_span_s=args.wedge_min_span_s,
+        )
+        _emit(
+            rep, args,
+            text=None if args.json else endurance.render_report(rep),
+        )
+        for b in rep["breaches"]:
+            print(f"obs soak report: BREACH: {b}", file=sys.stderr)
+        return 0 if rep["ok"] else 1
+
+    if args.soak_cmd == "diff":
+        try:
+            with open(args.baseline) as f:
+                base = json.load(f)
+            with open(args.candidate) as f:
+                cand = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"obs soak diff: {e}", file=sys.stderr)
+            return 2
+        diff = endurance.diff_soak(base, cand, tolerance=args.tolerance)
         if args.json:
             print(json.dumps(diff))
         else:

@@ -570,6 +570,16 @@ class KernelTelemetry:
     ``watermarks`` (obs.costs.MemoryWatermarks) samples live per-device
     buffer bytes at every chunk boundary — the measured side of the
     predicted-vs-live memory reconciliation (obs.costs.reconcile_memory).
+
+    ``series`` (obs.series.MetricSeriesRecorder, duck-typed so this
+    module never imports obs) flushes one whole-registry snapshot per
+    chunk boundary with ``t`` = the absolute post-chunk round index:
+    the endurance plane's kernel lane. Level-curve ``_last`` gauges are
+    refreshed from the chunk tail FIRST, so the series carries the
+    convergence watermarks as they move, not only at run end. With a
+    clock-less recorder and ``series_exclude`` dropping the wall-clock
+    chunk histogram (the default), a seeded rerun reproduces the series
+    file byte for byte.
     """
 
     engine: str = "dense"
@@ -580,6 +590,8 @@ class KernelTelemetry:
     chunk_walls: list = field(default_factory=list)
     ledger: object | None = None
     watermarks: object | None = None
+    series: object | None = None
+    series_exclude: tuple = ("corro_kernel_chunk_seconds",)
 
     def run_chunk(self, start_round: int, fn: Callable):
         """Execute one chunk ``fn() -> (state, curves)`` under a span,
@@ -647,6 +659,26 @@ class KernelTelemetry:
             ).observe(wall_s, engine=self.engine)
         if self.recorder is not None:
             self.recorder.record_chunk(start_round, curves, wall_s)
+        if self.series is not None and self.registry is not None:
+            # Refresh the level-gauge watermarks from the chunk tail
+            # (same names publish_curves sets at run end), then flush
+            # one snapshot at t = absolute round index — deterministic
+            # for a seeded run once the wall-clock histogram is
+            # excluded.
+            for k in LEVEL_CURVE_KEYS:
+                if k in curves and n:
+                    self.registry.gauge(
+                        f"{series_name(k)}_last",
+                        f"kernel plane: end-of-run {k}",
+                    ).set(
+                        float(np.asarray(curves[k])[-1]),
+                        engine=self.engine,
+                    )
+            self.series.sample(
+                self.registry,
+                t=float(int(start_round) + n),
+                exclude=self.series_exclude,
+            )
         if self.progress is not None:
             tail = {
                 k: int(np.asarray(curves[k])[-1])

@@ -22,9 +22,39 @@ DEFAULT_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+# Label-cardinality cap per metric (registry-created metrics only).
+# Per-peer series (corro_peer_breaker_trips_total{addr=...}) grow one
+# labelset per address forever, so under churn+relaunch soaks the
+# registry itself leaks; past the cap, NEW labelsets fold into an
+# `other` overflow bucket and corro_metrics_labelsets_dropped_total
+# counts the folded samples. 64 is an order of magnitude above any
+# legitimate labelset count in this codebase (routes, engines, planes).
+DEFAULT_MAX_LABELSETS = 64
+
 
 def _label_key(labels: dict[str, str]) -> tuple:
     return tuple(sorted(labels.items()))
+
+
+def _overflow_key(key: tuple) -> tuple:
+    """The `other` bucket for a folded labelset: same label NAMES, every
+    value replaced — the series keeps its shape for scrapers while the
+    value-space cardinality stays bounded."""
+    return tuple((k, "other") for k, _v in key)
+
+
+def _admit_key(key: tuple, container: dict, max_labelsets) -> tuple[tuple, bool]:
+    """Storage key for ``key`` under the cardinality cap (call holding
+    the metric's lock). Existing labelsets always pass; a NEW one past
+    the cap folds into the overflow bucket. Returns (key, folded)."""
+    if (
+        not key
+        or max_labelsets is None
+        or key in container
+        or len(container) < max_labelsets
+    ):
+        return key, False
+    return _overflow_key(key), True
 
 
 def _fmt_labels(key: tuple) -> str:
@@ -38,13 +68,18 @@ def _fmt_labels(key: tuple) -> str:
 class Counter:
     name: str
     help: str = ""
+    max_labelsets: int | None = None
+    on_fold: object = None  # callable, invoked OUTSIDE the lock
     _values: dict[tuple, float] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def inc(self, value: float = 1.0, **labels: str) -> None:
         key = _label_key(labels)
         with self._lock:
+            key, folded = _admit_key(key, self._values, self.max_labelsets)
             self._values[key] = self._values.get(key, 0.0) + value
+        if folded and self.on_fold is not None:
+            self.on_fold()
 
     def get(self, **labels: str) -> float:
         with self._lock:
@@ -67,17 +102,26 @@ class Counter:
 class Gauge:
     name: str
     help: str = ""
+    max_labelsets: int | None = None
+    on_fold: object = None
     _values: dict[tuple, float] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def set(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
         with self._lock:
-            self._values[_label_key(labels)] = float(value)
+            key, folded = _admit_key(key, self._values, self.max_labelsets)
+            self._values[key] = float(value)
+        if folded and self.on_fold is not None:
+            self.on_fold()
 
     def add(self, value: float, **labels: str) -> None:
         key = _label_key(labels)
         with self._lock:
+            key, folded = _admit_key(key, self._values, self.max_labelsets)
             self._values[key] = self._values.get(key, 0.0) + value
+        if folded and self.on_fold is not None:
+            self.on_fold()
 
     def get(self, **labels: str) -> float:
         with self._lock:
@@ -101,6 +145,8 @@ class Histogram:
     name: str
     help: str = ""
     buckets: tuple = DEFAULT_BUCKETS
+    max_labelsets: int | None = None
+    on_fold: object = None
     _counts: dict[tuple, list] = field(default_factory=dict)
     _sums: dict[tuple, float] = field(default_factory=dict)
     _totals: dict[tuple, int] = field(default_factory=dict)
@@ -109,12 +155,15 @@ class Histogram:
     def observe(self, value: float, **labels: str) -> None:
         key = _label_key(labels)
         with self._lock:
+            key, folded = _admit_key(key, self._totals, self.max_labelsets)
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+        if folded and self.on_fold is not None:
+            self.on_fold()
 
     def count(self, **labels: str) -> int:
         with self._lock:
@@ -172,28 +221,69 @@ class Histogram:
 
 
 class MetricsRegistry:
-    """Per-agent metric registry (the `metrics` facade role)."""
+    """Per-agent metric registry (the `metrics` facade role).
 
-    def __init__(self) -> None:
+    Registration is get-or-create BY NAME and type-checked: re-requesting
+    an existing series returns the same object (so re-registration on an
+    in-process agent relaunch is idempotent), while re-requesting it as
+    a different metric kind raises instead of handing back an object
+    whose API the caller will misuse. Registry-created metrics carry the
+    label-cardinality cap (``max_labelsets``); samples folded into the
+    `other` overflow bucket tick ``corro_metrics_labelsets_dropped_total``.
+    """
+
+    def __init__(self, max_labelsets: int | None = DEFAULT_MAX_LABELSETS):
         self._metrics: dict[str, object] = {}
         self._lock = threading.Lock()
+        self.max_labelsets = max_labelsets
+        self._labelsets_dropped = self.counter(
+            "corro_metrics_labelsets_dropped_total",
+            "samples folded into the `other` overflow labelset by the "
+            "label-cardinality cap",
+        )
+
+    def _note_fold(self) -> None:
+        self._labelsets_dropped.inc()
 
     def counter(self, name: str, help: str = "") -> Counter:
-        return self._get(name, lambda: Counter(name, help))
+        return self._get(
+            name, Counter,
+            lambda: Counter(
+                name, help, max_labelsets=self.max_labelsets,
+                on_fold=self._note_fold,
+            ),
+        )
 
     def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get(name, lambda: Gauge(name, help))
+        return self._get(
+            name, Gauge,
+            lambda: Gauge(
+                name, help, max_labelsets=self.max_labelsets,
+                on_fold=self._note_fold,
+            ),
+        )
 
     def histogram(
         self, name: str, help: str = "", buckets: tuple = DEFAULT_BUCKETS
     ) -> Histogram:
-        return self._get(name, lambda: Histogram(name, help, buckets))
+        return self._get(
+            name, Histogram,
+            lambda: Histogram(
+                name, help, buckets, max_labelsets=self.max_labelsets,
+                on_fold=self._note_fold,
+            ),
+        )
 
-    def _get(self, name: str, mk):
+    def _get(self, name: str, kind: type, mk):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = mk()
+            elif not isinstance(m, kind):
+                raise ValueError(
+                    f"metric {name!r} is already registered as a "
+                    f"{type(m).__name__}, not a {kind.__name__}"
+                )
             return m
 
     def render(self) -> str:
@@ -222,6 +312,44 @@ class MetricsRegistry:
                 for key, t, s in items:
                     out[name + "_count" + _fmt_labels(key)] = t
                     out[name + "_sum" + _fmt_labels(key)] = s
+        return out
+
+    def series_snapshot(self) -> dict:
+        """Typed whole-registry snapshot for the endurance plane's
+        MetricSeriesRecorder (obs/series.py): counters and gauges as
+        ``{rendered_name: value}``, histograms as bucket VECTORS — the
+        flat ``snapshot()`` collapses them to ``_count``/``_sum``, which
+        loses the distribution the SLO burn-rate windows need. Each
+        metric is read under its own lock so a bucket/sum/total trio can
+        never tear; cross-metric skew is bounded by one sampling pass."""
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            metrics = list(self._metrics.items())
+        for name, m in metrics:
+            if isinstance(m, Counter):
+                with m._lock:
+                    items = list(m._values.items())
+                for key, v in items:
+                    out["counters"][name + _fmt_labels(key)] = v
+            elif isinstance(m, Gauge):
+                with m._lock:
+                    items = list(m._values.items())
+                for key, v in items:
+                    out["gauges"][name + _fmt_labels(key)] = v
+            elif isinstance(m, Histogram):
+                with m._lock:
+                    snap = [
+                        (key, list(m._counts[key]), m._sums[key],
+                         m._totals[key])
+                        for key in sorted(m._totals)
+                    ]
+                for key, counts, s, t in snap:
+                    out["histograms"][name + _fmt_labels(key)] = {
+                        "le": [float(b) for b in m.buckets],
+                        "counts": counts,
+                        "sum": s,
+                        "count": t,
+                    }
         return out
 
 
@@ -315,7 +443,12 @@ def register_process_gauges(registry: "MetricsRegistry") -> tuple:
     ``corro_runtime_loop_lag_last_seconds`` (the most recent event-loop
     wakeup lag — the gauge companion of the existing
     ``corro_runtime_loop_lag_seconds`` histogram). Returns the three
-    gauges; the caller's sampling loop sets them."""
+    gauges; the caller's sampling loop sets them.
+
+    Idempotent: registration is get-or-create by name, so calling this
+    again (an agent relaunched in the same process, a second recorder
+    install) returns the SAME gauge objects — no raise, no duplicate
+    series, no double-sampling."""
     return (
         registry.gauge(
             "corro_runtime_rss_bytes", "process resident set size"

@@ -1,0 +1,362 @@
+"""CI endurance-soak gate: kernel + host metric series through the
+leak/wedge/stall/SLO detectors vs a budget (docs/OBSERVABILITY.md
+"Endurance plane").
+
+Two CI-sized lanes, one self-describing ``corro-soak/1`` report:
+
+- **kernel**: the seeded churned demo cluster run chunked with a
+  clock-less :class:`~corrosion_tpu.obs.series.MetricSeriesRecorder` on
+  ``KernelTelemetry`` (t = absolute round index) — run TWICE, and the
+  two series files must be byte-identical (``determinism_ok``: replay
+  determinism of the record itself is part of the gate);
+- **host**: the ``soak_churn`` hostchaos scenario (WAN netem + link
+  flap + SIGKILL-restart churn + write storm) with every agent
+  streaming one registry snapshot per tick; the killed agent's series
+  continues ``mode="a"`` across its restart, exercising the
+  counter-reset rebase for real.
+
+The ``soak`` entry of bench_budget.json gates the report
+(obs/endurance.check_soak_budget): leak-slope ceilings and the wall
+ceiling are tolerance-scaled; wedge/SLO/stall maxima (0), the
+detectors-armed rule (a soak passing with detectors never armed is a
+harness failure), and kernel series determinism are NEVER
+tolerance-scaled. ``--update`` refreshes the entry with x3 headroom on
+the measured leak slopes (with absolute floors so a flat run doesn't
+make any later nonzero slope a breach) and rewrites SOAK_BASELINE.json
+— the slim committed baseline ``obs soak diff`` gates PRs against.
+
+The multi-minute variant is slow-marked pytest territory
+(tests/test_endurance.py), not this gate.
+
+Usage:
+    python scripts/soak_smoke.py [--out report.json] [--budget FILE]
+    python scripts/soak_smoke.py --update   # refresh budget + baseline
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+import argparse
+import asyncio
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+SEED = 0
+UPDATE_HEADROOM = 3.0
+
+# Kernel lane shape (CI-sized: seconds on a 2-vCPU box).
+K_NODES, K_ROUNDS, K_CHUNK = 16, 48, 8
+
+# Absolute per-hour floors for --update: a flat measured slope must not
+# make any later nonzero (but harmless) slope a breach.
+UPDATE_SLOPE_FLOORS = {
+    "host:corro_runtime_rss_bytes": 256 * 2 ** 20,  # 256 MiB/h
+    "host:corro_runtime_open_fds": 120.0,
+    "host:corro_broadcast_pending": 20000.0,
+    "kernel:corro_kernel_health_queue_backlog_last": 20000.0,
+}
+WALL_FLOOR_S = 60.0
+
+# Host detector tuning for a CI-sized (seconds-long) churn window:
+# - wedge needs a 6 s+ flat-while-offered run (fault windows last ~3 s);
+# - loop-lag on a loaded CI box spikes past 0.5 s legitimately, stall
+#   runs need 3 ticks > 0.75 s;
+# - the in-report leak ceilings tolerate the startup allocation/socket
+#   ramp a 9 s window extrapolates to hours (~0.7 GiB/h rss, ~3k fds/h
+#   measured); genuine leaks (the positive controls inject 10x that)
+#   still flag, and cross-PR drift is bounded by the budget's measured
+#   x3 ceilings, not these;
+# - fan-out lag p99 at the 10 s bucket edge / 0.9 objective: changesets
+#   legitimately age seconds across flap+partition windows, but a clean
+#   lane drains well under 10 s — only a genuine slow-burn pushes
+#   deliveries past it;
+# - probe false alarms (member removals) budgeted at 3600/h ~ 1 per
+#   soak-sized window beyond the scheduled kill.
+HOST_ENDURANCE_KW = dict(
+    wedge_min_span_s=6.0,
+    stall_threshold_s=0.75,
+    leak_ceilings={
+        "corro_runtime_rss_bytes": 4 * 2 ** 30,
+        "corro_runtime_open_fds": 20000.0,
+    },
+    slos=(
+        {
+            "name": "fanout_lag_p99",
+            "kind": "histogram",
+            "series": "corro_broadcast_recv_lag_seconds",
+            "threshold_s": 10.0,
+            "objective": 0.90,
+        },
+        {
+            "name": "convergence_staleness",
+            "kind": "gauge",
+            "series": "corro_sync_needs",
+            "ceiling": 500.0,
+            "objective": 0.90,
+        },
+        {
+            "name": "probe_false_alarm_budget",
+            "kind": "counter_budget",
+            "series": "corro_gossip_member_removed",
+            "allowed_per_hour": 3600.0,
+        },
+    ),
+)
+
+# Kernel lane detectors: leaks on the level-gauge watermarks + SLO burn
+# on convergence staleness (gauge ceiling scaled to cluster size) and
+# the SWIM false-alarm budget (t is in ROUNDS; treat a round as a
+# second for rate purposes — the ceilings are calibrated in the same
+# unit by --update, so the scale cancels).
+KERNEL_SLOS = (
+    {
+        "name": "convergence_staleness",
+        "kind": "gauge",
+        "series": "corro_kernel_health_staleness_sum_last",
+        "ceiling": 40.0 * K_NODES,
+        "objective": 0.80,
+    },
+    {
+        "name": "probe_false_alarm_budget",
+        "kind": "counter_budget",
+        "series": "corro_kernel_health_swim_false_alarms_last",
+        "allowed_per_hour": 3600.0 * K_NODES,
+    },
+)
+
+LEAK_CEILING_PATHS = tuple(UPDATE_SLOPE_FLOORS)
+
+
+def run_kernel_lane(tmp: str, progress) -> dict:
+    from corrosion_tpu.obs import endurance
+    from corrosion_tpu.obs.series import MetricSeriesRecorder, replay_series
+    from corrosion_tpu.sim import health
+    from corrosion_tpu.sim.engine import simulate
+    from corrosion_tpu.sim.telemetry import KernelTelemetry
+    from corrosion_tpu.utils.metrics import MetricsRegistry
+
+    def one(path: str) -> None:
+        cfg, topo, sched, _kills = health.churned_demo_cluster(
+            K_NODES, K_ROUNDS, churn=True, seed=SEED
+        )
+        reg = MetricsRegistry()
+        with MetricSeriesRecorder(
+            path, source="kernel", mode="w", clock=None
+        ) as rec:
+            tele = KernelTelemetry(
+                engine="dense", registry=reg, series=rec,
+                progress=progress,
+            )
+            simulate(
+                cfg, topo, sched, seed=SEED, max_chunk=K_CHUNK,
+                telemetry=tele,
+            )
+
+    p1 = _os.path.join(tmp, "kernel.series.jsonl")
+    p2 = _os.path.join(tmp, "kernel.rerun.series.jsonl")
+    one(p1)
+    one(p2)
+    with open(p1, "rb") as f:
+        b1 = f.read()
+    with open(p2, "rb") as f:
+        b2 = f.read()
+    samples = replay_series(p1)["samples"]
+    end = endurance.build_report(
+        samples, label="kernel", t_scale_s=1.0,
+        wedge_pairs=(),  # per-chunk movement is gauge-only
+        slos=KERNEL_SLOS,
+    )
+    return {
+        "nodes": K_NODES,
+        "rounds": K_ROUNDS,
+        "samples": len(samples),
+        "series_bytes": len(b1),
+        "determinism_ok": b1 == b2,
+        "endurance": end,
+    }
+
+
+async def run_host_lane(tmp: str, progress) -> dict:
+    from corrosion_tpu.hostchaos import get_scenario, run_scenario
+
+    spec = get_scenario("soak_churn")
+    series_dir = _os.path.join(tmp, "host-series")
+    _os.makedirs(series_dir, exist_ok=True)
+    with tempfile.TemporaryDirectory() as d:
+        return await run_scenario(
+            spec, d, seed=SEED, progress=progress,
+            series_dir=series_dir, series_interval=0.2,
+            endurance_kw=dict(HOST_ENDURANCE_KW),
+        )
+
+
+def measure(progress) -> dict:
+    from corrosion_tpu.obs.endurance import SOAK_SCHEMA
+    from corrosion_tpu.sim import benchlib, telemetry
+
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as tmp:
+        kernel = run_kernel_lane(tmp, progress)
+        host = asyncio.run(run_host_lane(tmp, progress))
+    report = {
+        **benchlib.bench_context(
+            "soak_smoke", K_NODES, K_ROUNDS, "soak_churn", SEED
+        ),
+        "schema": SOAK_SCHEMA,
+        "scenario": "soak_smoke",
+        "nodes": K_NODES,
+        "seed": SEED,
+        "wall_s": round(time.monotonic() - t0, 2),
+        "kernel": kernel,
+        "host": host,
+    }
+    return telemetry.check_bench_invariants(
+        report, extra_provenance=("scenario",)
+    )
+
+
+def slim_baseline(report: dict) -> dict:
+    """The committed SOAK_BASELINE.json: provenance + everything
+    diff_soak reads (endurance blocks, determinism, samples), without
+    the netem traces / routes / heads bulk."""
+    host = report["host"]
+    return {
+        k: report[k]
+        for k in (
+            "schema", "platform", "device_count", "config_fingerprint",
+            "scenario", "nodes", "seed", "wall_s",
+        )
+    } | {
+        "kernel": {
+            k: report["kernel"][k]
+            for k in (
+                "nodes", "rounds", "samples", "series_bytes",
+                "determinism_ok", "endurance",
+            )
+        },
+        "host": {
+            "scenario": host["scenario"],
+            "agents": host["agents"],
+            "ok": host["ok"],
+            "machinery_ok": host["machinery_ok"],
+            "endurance": host["endurance"],
+        },
+    }
+
+
+def max_slope(report: dict, path: str) -> float:
+    """Largest measured slope for a ``prefix:stem`` budget path."""
+    from corrosion_tpu.obs.endurance import endurance_blocks
+
+    prefix, _, stem = path.partition(":")
+    best = 0.0
+    for label, blk in endurance_blocks(report).items():
+        if not (label == prefix or label.startswith(prefix + ".")):
+            continue
+        e = blk["leaks"].get(stem)
+        if e and e.get("slope_per_hour") is not None:
+            best = max(best, e["slope_per_hour"])
+    return best
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None, help="report JSON path")
+    ap.add_argument(
+        "--budget", default=str(Path(__file__).parent.parent
+                                / "bench_budget.json")
+    )
+    ap.add_argument(
+        "--baseline", default=str(Path(__file__).parent.parent
+                                  / "SOAK_BASELINE.json")
+    )
+    ap.add_argument(
+        "--update", action="store_true",
+        help="rewrite the budget's `soak` entry (x3 headroom + floors) "
+        "and SOAK_BASELINE.json from this measurement",
+    )
+    args = ap.parse_args()
+
+    report = measure(sys.stderr)
+
+    from corrosion_tpu.obs.endurance import check_soak_budget
+
+    budget_path = Path(args.budget)
+    budget_all = json.loads(budget_path.read_text())
+
+    if args.update:
+        entry = {
+            "platform": report["platform"],
+            "scenario": "soak_smoke",
+            "tolerance": 3.0,
+            "leak_ceilings_per_hour": {
+                p: round(
+                    max(
+                        max_slope(report, p) * UPDATE_HEADROOM,
+                        UPDATE_SLOPE_FLOORS[p],
+                    ), 1,
+                )
+                for p in LEAK_CEILING_PATHS
+            },
+            "wedge_max": 0,
+            "slo_breach_max": 0,
+            "stall_runs_max": 0,
+            "require_detectors_armed": True,
+            "require_determinism": True,
+            "wall_ceiling_s": round(
+                max(report["wall_s"] * UPDATE_HEADROOM, WALL_FLOOR_S), 1
+            ),
+        }
+        budget_all["soak"] = entry
+        budget_path.write_text(json.dumps(budget_all, indent=2) + "\n")
+        Path(args.baseline).write_text(
+            json.dumps(slim_baseline(report), indent=1) + "\n"
+        )
+        print(f"refreshed `soak` entry in {budget_path} and "
+              f"{args.baseline}")
+
+    budget = budget_all.get("soak")
+    if budget is None:
+        print("bench_budget.json has no `soak` entry (run with "
+              "--update)", file=sys.stderr)
+        return 2
+    ok, breaches = check_soak_budget(report, budget)
+    report["budget_gate"] = {"ok": ok, "breaches": breaches}
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+    k = report["kernel"]
+    print(
+        f"kernel: samples={k['samples']} determinism="
+        f"{k['determinism_ok']} endurance_ok={k['endurance']['ok']}"
+    )
+    h = report["host"]
+    harmed = {
+        name: blk["detectors_armed"]
+        for name, blk in (h["endurance"] or {}).get("agents", {}).items()
+    }
+    print(
+        f"host[{h['scenario']}]: ok={h['ok']} machinery={h['machinery']} "
+        f"endurance_armed={harmed}"
+    )
+    if not ok:
+        print("SOAK BUDGET BREACHED:", file=sys.stderr)
+        for b in breaches:
+            print(f"  {b}", file=sys.stderr)
+        return 1
+    print("soak gate ok=true breaches=[]")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

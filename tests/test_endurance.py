@@ -1,0 +1,681 @@
+"""Endurance observability plane tests (obs/series.py + obs/endurance.py).
+
+Covers the corro-metric-series/1 recorder contract (rotation, resume,
+replay, clock-less determinism, idempotent attach), snapshot consistency
+under concurrent hammering, the label-cardinality cap, the detector
+catalog (Theil–Sen leak fits, counter-reset classification, wedge and
+stall runs, multi-window SLO burn rates) including POSITIVE CONTROLS —
+an injected leak/wedge/slow-burn breach must be caught with the correct
+verdict — the soak budget gate's never-tolerance-scaled rules, the
+report diff, the kernel/agent install points with their zero-cost pins,
+and the `obs soak` CLI exit codes.
+"""
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from corrosion_tpu import models
+from corrosion_tpu.obs import endurance as E
+from corrosion_tpu.obs import series as S
+from corrosion_tpu.sim import simulate
+from corrosion_tpu.sim import telemetry as T
+from corrosion_tpu.utils import metrics as M
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- synthetic sample builders -----------------------------------------------
+
+
+def mk_sample(t, counters=None, gauges=None, histograms=None):
+    return {
+        "kind": "sample", "t": float(t), "seq": int(t),
+        "counters": dict(counters or {}),
+        "gauges": dict(gauges or {}),
+        "histograms": dict(histograms or {}),
+    }
+
+
+def mk_hist(le, counts, total=None, s=0.0):
+    return {
+        "le": list(le), "counts": list(counts),
+        "count": total if total is not None else counts[-1], "sum": s,
+    }
+
+
+# -- robust trend fit --------------------------------------------------------
+
+
+def test_theil_sen_recovers_seeded_noisy_slope():
+    """Median-of-pairwise-slopes on a seeded noisy ramp lands on the
+    true slope, and stays there when ~20% of points are outlier spikes
+    (one compaction spike must not set the verdict)."""
+    rng = np.random.default_rng(7)
+    ts = list(np.arange(200, dtype=float))
+    true = 3.5
+    ys = [true * t + 40.0 + float(rng.normal(0, 2.0)) for t in ts]
+    got = E.theil_sen(ts, ys)
+    assert got == pytest.approx(true, rel=0.05)
+    # Contaminate every 5th point with a huge spike: least squares would
+    # be dragged far off; Theil-Sen barely moves.
+    for i in range(0, 200, 5):
+        ys[i] += 5000.0
+    got = E.theil_sen(ts, ys)
+    assert got == pytest.approx(true, rel=0.25)
+
+
+def test_theil_sen_deterministic_and_degenerate():
+    ts = list(np.arange(400, dtype=float))
+    ys = [0.25 * t + ((t * 7919) % 13) for t in ts]
+    # Thinned (n*(n-1)/2 >> max_pairs) but deterministic: same answer
+    # twice, no RNG involved.
+    a = E.theil_sen(ts, ys, max_pairs=500)
+    assert a == E.theil_sen(ts, ys, max_pairs=500)
+    assert E.theil_sen([1.0], [2.0]) is None
+    assert E.theil_sen([], []) is None
+
+
+# -- counter-reset classification --------------------------------------------
+
+
+def test_rebase_counter_restart():
+    """A relaunched agent drops its counters to ~0: classified restart,
+    previous cumulative becomes the base, deltas stay meaningful."""
+    rebased, events = E.rebase_counter([0.0, 10.0, 50.0, 2.0, 8.0])
+    assert [e["kind"] for e in events] == ["restart"]
+    assert rebased == [0.0, 10.0, 50.0, 52.0, 58.0]
+    assert rebased == sorted(rebased)
+
+
+def test_rebase_counter_wraparound():
+    prev = 2.0 ** 32 - 10.0
+    rebased, events = E.rebase_counter([prev, 5.0])
+    assert [e["kind"] for e in events] == ["wraparound"]
+    # The true delta (10 to the base + 5 past it) survives the wrap.
+    assert rebased[1] - rebased[0] == pytest.approx(15.0)
+
+
+def test_rebase_counter_genuine_decrease():
+    """A small dip with no wrap base in reach is a monotonic-contract
+    violation: the cumulative holds flat, never invents negative work."""
+    rebased, events = E.rebase_counter([0.0, 100.0, 95.0, 97.0])
+    assert [e["kind"] for e in events] == ["decrease"]
+    assert rebased == [0.0, 100.0, 100.0, 102.0]
+
+
+# -- recorder contract -------------------------------------------------------
+
+
+def test_recorder_rotation_resume_replay(tmp_path):
+    """Rotation past max_bytes rolls to path.N; replay merges segments
+    oldest-first; mode="a" resumes the segment counter; mode="w" starts
+    fresh and deletes stale segments."""
+    path = str(tmp_path / "s.jsonl")
+    reg = M.MetricsRegistry(max_labelsets=None)
+    c = reg.counter("corro_x_total")
+    rec = S.MetricSeriesRecorder(path, source="t", mode="w",
+                                 max_bytes=600, clock=None)
+    for i in range(12):
+        c.inc()
+        rec.sample(reg, t=float(i))
+    rec.close()
+    segs = S.series_segments(path)
+    assert len(segs) > 1 and segs[-1] == path
+    rep = S.replay_series(path)
+    assert [s["t"] for s in rep["samples"]] == [float(i) for i in range(12)]
+    ts, vals = S.series_values(rep["samples"], "corro_x_total")
+    assert vals == [float(i + 1) for i in range(12)]
+
+    # Append resumes: the segment counter continues past the rotated
+    # chain instead of renaming the live file over an old segment.
+    rec2 = S.MetricSeriesRecorder(path, source="t", mode="a",
+                                  max_bytes=600, clock=None)
+    for i in range(12, 18):
+        c.inc()
+        rec2.sample(reg, t=float(i))
+    rec2.close()
+    rep = S.replay_series(path)
+    assert len(rep["samples"]) == 18
+    assert len(rep["headers"]) >= 2  # one per open/rotation
+    segments = [h["segment"] for h in rep["headers"]]
+    assert segments == sorted(segments)
+
+    # A truncating open kills the stale chain: replay sees ONLY the new
+    # record, not a merge with the previous run's segments.
+    rec3 = S.MetricSeriesRecorder(path, source="t", mode="w", clock=None)
+    rec3.sample(reg, t=0.0)
+    rec3.close()
+    rep = S.replay_series(path)
+    assert len(rep["samples"]) == 1
+    assert S.series_segments(path) == [path]
+
+
+def test_recorder_clockless_needs_explicit_t(tmp_path):
+    rec = S.MetricSeriesRecorder(
+        str(tmp_path / "d.jsonl"), clock=None, mode="w")
+    reg = M.MetricsRegistry()
+    with pytest.raises(ValueError):
+        rec.sample(reg)
+    rec.sample(reg, t=1.0)
+    rec.close()
+    with pytest.raises(ValueError):
+        rec.sample(reg, t=2.0)
+
+
+def test_recorder_event_reserved_kinds(tmp_path):
+    rec = S.MetricSeriesRecorder(
+        str(tmp_path / "e.jsonl"), clock=None, mode="w")
+    with pytest.raises(ValueError):
+        rec.record_event({"kind": "sample", "t": 0})
+    rec.record_event({"kind": "phase", "name": "storm"})
+    rec.close()
+    rep = S.replay_series(str(tmp_path / "e.jsonl"))
+    assert [e["kind"] for e in rep["events"]] == ["phase"]
+
+
+def test_replay_skips_torn_tail(tmp_path):
+    """A crash can tear at most the final in-flight line; replay keeps
+    every whole line before it."""
+    path = str(tmp_path / "torn.jsonl")
+    reg = M.MetricsRegistry()
+    rec = S.MetricSeriesRecorder(path, clock=None, mode="w")
+    rec.sample(reg, t=0.0)
+    rec.sample(reg, t=1.0)
+    rec.close()
+    with open(path, "a") as f:
+        f.write('{"kind": "sample", "t": 2.0, "co')  # torn mid-write
+    rep = S.replay_series(path)
+    assert [s["t"] for s in rep["samples"]] == [0.0, 1.0]
+
+
+def test_attach_is_idempotent_and_refcounted(tmp_path):
+    """Two installs racing one path adopt ONE recorder (no duplicate
+    header, no second handle); close is refcounted to match — the
+    in-process relaunch contract (hostchaos kill_restart)."""
+    path = str(tmp_path / "a.jsonl")
+    r1 = S.MetricSeriesRecorder.attach(path, clock=None, mode="w")
+    r2 = S.MetricSeriesRecorder.attach(path, clock=None, mode="w")
+    assert r1 is r2
+    reg = M.MetricsRegistry()
+    r1.close()  # first release: still open for the second holder
+    r2.sample(reg, t=0.0)
+    r2.close()
+    with pytest.raises(ValueError):
+        r2.sample(reg, t=1.0)
+    rep = S.replay_series(path)
+    assert len(rep["headers"]) == 1
+    # After full release a fresh attach opens a NEW recorder.
+    r3 = S.MetricSeriesRecorder.attach(path, clock=None, mode="a")
+    assert r3 is not r1
+    r3.close()
+
+
+def test_register_process_gauges_idempotent():
+    reg = M.MetricsRegistry()
+    a = M.register_process_gauges(reg)
+    b = M.register_process_gauges(reg)
+    assert all(x is y for x, y in zip(a, b))
+
+
+# -- snapshot consistency + cardinality cap ----------------------------------
+
+
+def test_snapshot_vs_scrape_under_hammering():
+    """Whole-registry snapshots taken while writer threads hammer the
+    metrics never tear: counters are monotone across samples, and each
+    histogram's bucket/count trio is internally consistent (cumulative
+    buckets, last bucket <= count)."""
+    reg = M.MetricsRegistry(max_labelsets=None)
+    c = reg.counter("corro_hammer_total")
+    h = reg.histogram("corro_hammer_seconds")
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            c.inc(source="a")
+            c.inc(source="b")
+            h.observe(0.001 * (i % 50))
+            i += 1
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    snaps = [reg.series_snapshot() for _ in range(200)]
+    stop.set()
+    for t in threads:
+        t.join()
+
+    prev = None
+    for s in snaps:
+        for name, v in s["counters"].items():
+            if prev is not None and name in prev["counters"]:
+                assert v >= prev["counters"][name], name
+        for name, hist in s["histograms"].items():
+            counts = hist["counts"]
+            assert counts == sorted(counts)  # cumulative buckets
+            assert counts[-1] <= hist["count"]
+        prev = s
+    # The render path agrees with the final snapshot's family split.
+    text = reg.render()
+    assert "corro_hammer_total" in text
+
+
+def test_label_cardinality_cap_churn():
+    """A labelset churn storm (every sample a new value) folds into the
+    `other` overflow bucket past the cap: bounded snapshot size, the
+    fold count on corro_metrics_labelsets_dropped_total, and the series
+    keeps its label NAMES."""
+    reg = M.MetricsRegistry(max_labelsets=8)
+    c = reg.counter("corro_churn_total")
+    for i in range(500):
+        c.inc(peer=f"n{i}")
+    snap = reg.series_snapshot()
+    keys = [k for k in snap["counters"] if k.startswith("corro_churn")]
+    assert len(keys) <= 9  # 8 admitted + the `other` bucket
+    assert 'corro_churn_total{peer="other"}' in snap["counters"]
+    assert snap["counters"]['corro_churn_total{peer="other"}'] == 492
+    assert snap["counters"][
+        "corro_metrics_labelsets_dropped_total"] == 492
+    # Existing labelsets keep passing after the cap engaged.
+    c.inc(peer="n0")
+    assert reg.series_snapshot()["counters"][
+        'corro_churn_total{peer="n0"}'] == 2
+
+
+# -- detectors: positive controls --------------------------------------------
+
+
+def _clean_samples(n=30):
+    """A healthy host series: flat rss/fds, progress tracking offers,
+    calm lag, one histogram entirely under threshold."""
+    out = []
+    for i in range(n):
+        out.append(mk_sample(
+            float(i),
+            counters={
+                "corro_changes_committed": 10.0 * i,
+                "corro_changes_applied": 10.0 * i,
+                "corro_gossip_member_removed": 0.0,
+            },
+            gauges={
+                "corro_runtime_rss_bytes": 1e8 + (i % 3) * 1e5,
+                "corro_runtime_open_fds": 40.0,
+                "corro_sync_needs": 5.0,
+                "corro_runtime_loop_lag_last_seconds": 0.01,
+            },
+            histograms={
+                "corro_broadcast_recv_lag_seconds": mk_hist(
+                    [0.1, 1.0, 10.0], [5 * i, 6 * i, 6 * i],
+                    total=6 * i, s=0.05 * i),
+            },
+        ))
+    return out
+
+
+def test_clean_series_reports_ok_with_all_detectors_armed():
+    rep = E.build_report(_clean_samples(), label="clean")
+    assert rep["ok"] and rep["breaches"] == []
+    assert all(rep["detectors_armed"].values()), rep["detectors_armed"]
+    assert rep["schema"] == E.ENDURANCE_SCHEMA
+    text = E.render_report(rep)
+    assert "clean" in text and "BREACH" not in text
+
+
+def test_positive_control_injected_fd_leak():
+    """+5 fds per second = 18000/h against a 600/h ceiling: caught as a
+    leak with the right stem and a units-per-hour verdict."""
+    samples = _clean_samples()
+    for i, s in enumerate(samples):
+        s["gauges"]["corro_runtime_open_fds"] = 40.0 + 5.0 * i
+    rep = E.build_report(samples, label="leaky")
+    assert not rep["ok"]
+    e = rep["leaks"]["corro_runtime_open_fds"]
+    assert e["flagged"] and e["slope_per_hour"] == pytest.approx(
+        18000.0, rel=0.01)
+    assert any(
+        b.startswith("leak: corro_runtime_open_fds")
+        for b in rep["breaches"])
+    assert "LEAK" in E.render_report(rep)
+
+
+def test_positive_control_injected_wedge():
+    """Commits keep arriving while applies go flat for the rest of the
+    run: wedged, with the offered-work evidence in the verdict."""
+    samples = _clean_samples()
+    for i, s in enumerate(samples):
+        if i >= 10:
+            s["counters"]["corro_changes_applied"] = 100.0
+    rep = E.build_report(samples, label="wedged")
+    w = rep["wedges"]["corro_changes_committed->corro_changes_applied"]
+    assert w["wedged"] and w["longest_run"]["offered"] > 0
+    assert any(b.startswith("wedge:") for b in rep["breaches"])
+
+
+def test_restart_does_not_fake_a_wedge_or_leak():
+    """A mid-run agent relaunch (both progress counters drop to ~0) is
+    classified as a restart and rebased: no wedge, no breach, and the
+    reset is reported as relaunch evidence."""
+    samples = _clean_samples()
+    for i, s in enumerate(samples):
+        if i >= 15:  # relaunched life recounting from zero
+            s["counters"]["corro_changes_committed"] = 10.0 * (i - 15)
+            s["counters"]["corro_changes_applied"] = 10.0 * (i - 15)
+    rep = E.build_report(samples, label="relaunch")
+    assert rep["ok"], rep["breaches"]
+    assert rep["resets"]["corro_changes_committed"]["kinds"] == [
+        "restart"]
+
+
+def test_positive_control_slow_burn_slo():
+    """A sustained staleness plateau above the SLO ceiling burns budget
+    in BOTH windows -> breached; the same plateau confined to ancient
+    history (recovered since) leaves the fast window clean -> no
+    breach. The multi-window rule is what separates the two."""
+    burn = _clean_samples()
+    for s in burn:
+        s["gauges"]["corro_sync_needs"] = 900.0  # above the 500 ceiling
+    rep = E.build_report(burn, label="burning")
+    slo = rep["slo"]["convergence_staleness"]
+    assert slo["breached"]
+    assert slo["windows"]["fast"]["burn"] >= 1.0
+    assert slo["windows"]["slow"]["burn"] >= 1.0
+    assert any(
+        b.startswith("slo: convergence_staleness")
+        for b in rep["breaches"])
+
+    recovered = _clean_samples()
+    for i, s in enumerate(recovered):
+        if i < 10:  # bad past, clean tail
+            s["gauges"]["corro_sync_needs"] = 900.0
+    rep = E.build_report(recovered, label="recovered")
+    slo = rep["slo"]["convergence_staleness"]
+    assert slo["armed"] and not slo["breached"]
+    assert slo["windows"]["fast"]["burn"] < 1.0
+
+
+def test_counter_budget_slo_spans_restarts():
+    """The false-alarm budget counts events on the REBASED cumulative,
+    so a relaunch neither hides alarms nor invents them."""
+    samples = _clean_samples()
+    for i, s in enumerate(samples):
+        # 2 removals per tick; agent restarts at i=20.
+        v = 2.0 * (i if i < 20 else i - 20)
+        s["counters"]["corro_gossip_member_removed"] = v
+    rep = E.build_report(samples, label="flappy")
+    slo = rep["slo"]["probe_false_alarm_budget"]
+    # 2 events/s = 7200/h against the 720/h budget: burn ~10x.
+    assert slo["breached"]
+    assert slo["windows"]["slow"]["per_hour"] == pytest.approx(
+        7200.0, rel=0.15)
+
+
+def test_stall_runs_detected():
+    samples = _clean_samples()
+    for i, s in enumerate(samples):
+        if 5 <= i < 10 or 20 <= i < 24:
+            s["gauges"]["corro_runtime_loop_lag_last_seconds"] = 2.0
+    rep = E.build_report(samples, label="stalled")
+    assert rep["stalls"]["runs"] == 2
+    assert rep["stalls"]["longest"] == 5
+    assert any(b.startswith("stall:") for b in rep["breaches"])
+
+
+# -- soak budget gate + diff -------------------------------------------------
+
+
+def _soak_report(host_block, kernel_block=None, determinism=True):
+    return {
+        "schema": E.SOAK_SCHEMA,
+        "platform": "cpu",
+        "scenario": "soak_smoke",
+        "wall_s": 10.0,
+        "kernel": {
+            "determinism_ok": determinism,
+            "endurance": kernel_block or E.build_report(
+                _clean_samples(), label="kernel"),
+        },
+        "host": {"endurance": {"agents": {"n0": host_block}}},
+    }
+
+
+def test_check_soak_budget_clean_and_ceilings():
+    rep = _soak_report(E.build_report(_clean_samples(), label="n0"))
+    budget = {
+        "platform": "cpu", "scenario": "soak_smoke", "tolerance": 3.0,
+        "leak_ceilings_per_hour": {
+            "host:corro_runtime_rss_bytes": 1e9,
+        },
+        "require_detectors_armed": True,
+        "require_determinism": True,
+        "wall_ceiling_s": 60.0,
+    }
+    ok, breaches = E.check_soak_budget(rep, budget)
+    assert ok, breaches
+
+    # An exceeded leak ceiling breaches even under tolerance scaling.
+    leaky = _clean_samples()
+    for i, s in enumerate(leaky):
+        s["gauges"]["corro_runtime_rss_bytes"] = 1e8 + 1e6 * i
+    rep = _soak_report(E.build_report(leaky, label="n0"))
+    budget["leak_ceilings_per_hour"][
+        "host:corro_runtime_rss_bytes"] = 1e6
+    ok, breaches = E.check_soak_budget(rep, budget)
+    assert not ok
+    assert any("corro_runtime_rss_bytes" in b for b in breaches)
+
+
+def test_check_soak_budget_wedge_never_tolerance_scaled():
+    wedged = _clean_samples()
+    for i, s in enumerate(wedged):
+        if i >= 10:
+            s["counters"]["corro_changes_applied"] = 100.0
+    rep = _soak_report(E.build_report(wedged, label="n0"))
+    ok, breaches = E.check_soak_budget(
+        rep, {"tolerance": 100.0, "wedge_max": 0})
+    assert not ok
+    assert any("wedge(s) > max 0" in b for b in breaches)
+
+
+def test_check_soak_budget_harness_failure_on_unarmed_detectors():
+    """The machinery-fired rule: a soak whose detectors never evaluated
+    anything must FAIL as a harness failure, not pass green."""
+    empty = E.build_report([], label="n0")
+    assert empty["ok"]  # no breaches — but nothing was armed either
+    rep = _soak_report(empty, kernel_block=empty)
+    ok, breaches = E.check_soak_budget(
+        rep, {"require_detectors_armed": True,
+              "leak_ceilings_per_hour": {}})
+    assert not ok
+    assert any(b.startswith("test-harness failure") for b in breaches)
+
+
+def test_check_soak_budget_coverage_hole_and_determinism():
+    rep = _soak_report(E.build_report(_clean_samples(), label="n0"),
+                       determinism=False)
+    ok, breaches = E.check_soak_budget(rep, {
+        "leak_ceilings_per_hour": {"host:corro_no_such_series": 1.0},
+        "require_determinism": True,
+    })
+    assert not ok
+    assert any("coverage hole" in b for b in breaches)
+    assert any("not replay-deterministic" in b for b in breaches)
+
+
+def test_diff_soak_flags_regressions_only():
+    base = _soak_report(E.build_report(_clean_samples(), label="n0"))
+    same = E.diff_soak(base, base)
+    assert same["regressions"] == []
+    assert all(r["ok"] for r in same["rows"])
+
+    # Candidate grows a real fd leak: slope regression + new breach.
+    leaky = _clean_samples()
+    for i, s in enumerate(leaky):
+        s["gauges"]["corro_runtime_open_fds"] = 40.0 + 5.0 * i
+    cand = _soak_report(E.build_report(leaky, label="n0"))
+    d = E.diff_soak(base, cand)
+    assert any("corro_runtime_open_fds" in r for r in d["regressions"])
+    assert any("new breaches" in r for r in d["regressions"])
+
+    # Candidate loses detector coverage: never tolerated.
+    lost = _soak_report(E.build_report([], label="n0"))
+    d = E.diff_soak(base, lost)
+    assert any("no longer armed" in r for r in d["regressions"])
+    assert any("coverage collapsed" in r for r in d["regressions"])
+
+
+# -- install points ----------------------------------------------------------
+
+
+def test_kernel_series_chunked_deterministic_and_zero_cost(tmp_path):
+    """The KernelTelemetry install: one sample per chunk at t = absolute
+    round index, wall-clock histogram excluded, seeded reruns produce a
+    byte-identical file — and running WITHOUT the series recorder leaves
+    the curves bit-identical (zero-cost pin)."""
+    cfg, topo, sched = models.merge_10k(n=32, rounds=24, samples=16)
+
+    def run_with_series(path):
+        reg = M.MetricsRegistry()
+        rec = S.MetricSeriesRecorder(path, source="kernel", mode="w",
+                                     clock=None)
+        tele = T.KernelTelemetry(engine="dense", registry=reg,
+                                 series=rec)
+        final, curves = simulate(
+            cfg, topo, sched, seed=5, max_chunk=8, telemetry=tele)
+        rec.close()
+        return curves
+
+    p1, p2 = str(tmp_path / "k1.jsonl"), str(tmp_path / "k2.jsonl")
+    curves = run_with_series(p1)
+    run_with_series(p2)
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+
+    rep = S.replay_series(p1)
+    assert [s["t"] for s in rep["samples"]] == [8.0, 16.0, 24.0]
+    names = S.series_names(rep["samples"], "histograms")
+    assert not any("chunk_seconds" in n for n in names)
+    # Convergence watermarks move through the series, not only at end.
+    ts, vals = S.series_values(
+        rep["samples"], 'corro_kernel_health_staleness_sum_last'
+        '{engine="dense"}', family="gauges")
+    assert len(ts) == 3
+
+    # Zero-cost pin: identical curves without any series recorder.
+    _, bare = simulate(cfg, topo, sched, seed=5, max_chunk=8,
+                       telemetry=T.KernelTelemetry(engine="dense"))
+    for k in curves:
+        assert np.array_equal(
+            np.asarray(curves[k]), np.asarray(bare[k])), k
+
+
+def test_agent_runtime_series_install(tmp_path):
+    """AgentConfig.metric_series_path wires the recorder into the
+    runtime-metrics loop: samples appear, carry the process gauges, and
+    the recorder closes with the agent."""
+    from corrosion_tpu.agent.testing import launch_test_agent, poll_until
+
+    path = str(tmp_path / "agent.series.jsonl")
+
+    async def main():
+        a = await launch_test_agent(
+            str(tmp_path / "a"),
+            metric_series_path=path,
+            runtime_metrics_interval=0.05,
+        )
+        try:
+            async def sampled():
+                try:
+                    rep = S.replay_series(path)
+                except OSError:
+                    return False
+                return len(rep["samples"]) >= 3
+            await poll_until(sampled, timeout=10.0)
+        finally:
+            await a.stop()
+
+    run(main())
+    rep = S.replay_series(path)
+    assert rep["headers"][0]["source"].startswith("agent:")
+    ts, vals = S.series_values(
+        rep["samples"], "corro_runtime_rss_bytes", family="gauges")
+    assert vals and all(v > 0 for v in vals)
+    # Counters registered-at-boot are zero-seeded so budget SLOs arm
+    # even on a clean soak.
+    _, removed = S.series_values(
+        rep["samples"], "corro_gossip_member_removed",
+        family="counters")
+    assert removed and removed[0] == 0.0
+    # Stop released the recorder: the path is attachable fresh.
+    import os
+    assert os.path.abspath(path) not in S.MetricSeriesRecorder._live
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_obs_soak_cli_report_and_diff(tmp_path, capsys):
+    from corrosion_tpu import cli
+
+    # A leaky series file -> exit 1 under a tight ceiling, 0 under a
+    # generous one.
+    path = str(tmp_path / "leaky.jsonl")
+    reg = M.MetricsRegistry()
+    fds = reg.gauge("corro_runtime_open_fds")
+    rec = S.MetricSeriesRecorder(path, clock=None, mode="w")
+    for i in range(20):
+        fds.set(40.0 + 5.0 * i)
+        rec.sample(reg, t=float(i))
+    rec.close()
+    assert cli.main([
+        "obs", "soak", "report", path,
+        "--leak-ceiling", "corro_runtime_open_fds=600",
+    ]) == 1
+    out = capsys.readouterr().out
+    assert "LEAK" in out
+    assert cli.main([
+        "obs", "soak", "report", path,
+        "--leak-ceiling", "corro_runtime_open_fds=50000",
+    ]) == 0
+
+    base_rep = E.build_report(_clean_samples(), label="n0")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_soak_report(base_rep)))
+    assert cli.main([
+        "obs", "soak", "diff", str(base), str(base)]) == 0
+
+    leaky = _clean_samples()
+    for i, s in enumerate(leaky):
+        s["gauges"]["corro_runtime_open_fds"] = 40.0 + 5.0 * i
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(
+        _soak_report(E.build_report(leaky, label="n0"))))
+    assert cli.main([
+        "obs", "soak", "diff", str(base), str(cand)]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_loadgen_soak_process_block_rides_the_recorder(tmp_path):
+    """`loadgen soak` emits its process block through the series
+    recorder (one sampling path): section-boundary samples in a
+    corro-metric-series/1 record, start/end derived from its first/last
+    samples."""
+    from corrosion_tpu.loadgen.scenarios import intake_policy
+
+    spath = str(tmp_path / "soak.series.jsonl")
+    r = intake_policy(nodes=8, rounds=12, seed=0, series_path=spath)
+    proc = r["process"]
+    assert proc["samples"] == 3
+    assert proc["series_path"] == spath
+    rep = S.replay_series(spath)
+    assert rep["headers"][0]["source"] == "loadgen-soak"
+    ts, vals = S.series_values(
+        rep["samples"], "corro_runtime_rss_bytes", family="gauges")
+    assert vals[0] == proc["start"]["rss_bytes"]
+    assert vals[-1] == proc["end"]["rss_bytes"]
+    assert proc["rss_growth_bytes"] == vals[-1] - vals[0]
