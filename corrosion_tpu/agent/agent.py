@@ -160,6 +160,13 @@ class AgentConfig:
     # Runtime-metrics/series sampling cadence; soak lanes compress it
     # into test time like every other interval knob.
     runtime_metrics_interval: float = 1.0
+    # Serving query-cost plane (docs/SERVING.md "Query-cost plane"): arm
+    # the per-subscription cost ledger (SubsManager.enable_costs) at
+    # startup. OFF by default — handles carry ``cost=None``, the matcher
+    # hot path takes single ``is None`` branches, and behavior is
+    # bit-identical (pinned), the same contract as trace_writes and
+    # metric_series_path.
+    sub_costs: bool = False
 
 
 @dataclass
@@ -441,6 +448,12 @@ class Agent:
                 # Fan-out spans ride the same tracer as the write path;
                 # left unwired (the default) match_changes costs nothing.
                 self.subs.tracer = self.tracer
+            if self.cfg.sub_costs:
+                # Arm the per-subscription cost ledger AFTER restore so
+                # durable handles re-adopt their persisted counters
+                # (kill/relaunch continues the ledger, like the series
+                # recorder's mode="a" reattach).
+                self.subs.enable_costs(self.metrics)
         # Rejoin via persisted member states (agent.rs:772-831): a restarted
         # node reaches its old cluster even when the bootstrap seeds are
         # gone. The failure detector prunes any that died while we were

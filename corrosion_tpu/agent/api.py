@@ -356,6 +356,22 @@ async def _dispatch(
         await _stream_sub(agent, reader, writer, handle, from_change=None,
                           skip_rows=query.get("skip_rows") == ["true"])
         return False
+    if method == "GET" and path == "/v1/subs/costs":
+        # Live cost-ledger snapshot (docs/SERVING.md "Query-cost plane"):
+        # top-K subscriptions by total eval seconds plus ledger-wide
+        # totals. Works with the plane disarmed too — plan records are
+        # always present; counters appear once enable_costs armed it.
+        if agent.subs is None:
+            raise HttpError(501, "subscriptions not enabled")
+        top_q = query.get("top")
+        try:
+            top = int(top_q[0]) if top_q else None
+        except ValueError as e:
+            raise HttpError(400, f"bad top= value: {top_q[0]!r}") from e
+        if top is not None and top < 0:
+            raise HttpError(400, "top= must be >= 0")
+        _json_resp(writer, 200, agent.subs.cost_snapshot(top=top))
+        return True
     if method == "GET" and path.startswith("/v1/subscriptions/"):
         if agent.subs is None:
             raise HttpError(501, "subscriptions not enabled")

@@ -92,6 +92,117 @@ def _membership_is_local(select_list: str, tail: str) -> bool:
     ) and not re.search(r"(?i)\bover\s*\(|\(\s*select\b", select_list)
 
 
+_PLAN_FEATURES = (
+    ("window", r"(?i)\bover\s*\("),
+    ("aggregate", r"(?i)\b(count|sum|avg|min|max|group_concat)\s*\("),
+    ("group_by", r"(?i)\bgroup\s+by\b"),
+    ("distinct", r"(?i)^\s*select\s+distinct\b"),
+    ("subquery", r"(?i)\(\s*select\b"),
+    ("limit", r"(?i)\blimit\b"),
+    ("outer_join", r"(?i)\b(left|right|full|cross|natural)\s+(outer\s+)?join\b"),
+    ("join", r"(?i)\bjoin\b"),
+)
+
+
+def classify_query(sql: str) -> tuple[str, list[str]]:
+    """Syntactic feature sweep for the query-plan classifier: returns
+    ``(class, features)`` where class is the dominant shape
+    (window > aggregate > join > simple). Whether the shape is actually
+    servable incrementally is decided by the PK injector — the handle's
+    ``plan`` record combines both so the classification can never
+    disagree with what the matcher really does."""
+    import re
+
+    feats = [name for name, pat in _PLAN_FEATURES if re.search(pat, sql)]
+    if "window" in feats:
+        cls = "window"
+    elif "aggregate" in feats or "group_by" in feats:
+        cls = "aggregate"
+    elif "join" in feats or "outer_join" in feats:
+        cls = "join"
+    else:
+        cls = "simple"
+    return cls, feats
+
+
+class SubCost:
+    """Per-subscription cost ledger (one per MatcherHandle, allocated only
+    when the cost plane is armed — ``MatcherHandle.cost`` stays ``None``
+    otherwise and every hot-path site guards on that single check, the
+    same zero-cost contract as ``prop_observe``).
+
+    Counters cover the whole serving cost surface: candidate vs fallback
+    evaluations, rows scanned, eval wall seconds, snapshot-diff rows,
+    fan-out events/bytes, listener-queue depth high-water, and
+    reconnect-replay rows. ``snapshot()`` is the ``corro-sub-cost/1``
+    record body; ``load()`` re-adopts counters persisted in the sub-db
+    so the ledger survives agent kill/relaunch like the endurance series
+    recorder does."""
+
+    COUNTERS = (
+        "candidate_evals", "fallback_evals", "rows_scanned",
+        "eval_seconds_candidate", "eval_seconds_fallback", "diff_rows",
+        "fanout_events", "fanout_bytes", "queue_depth_hwm",
+        "replays", "replay_rows",
+    )
+
+    __slots__ = COUNTERS + ("_label", "_hist", "_fb_counter")
+
+    def __init__(self, sub_id: str, hist=None, fb_counter=None) -> None:
+        for name in self.COUNTERS:
+            setattr(self, name, 0)
+        self.eval_seconds_candidate = 0.0
+        self.eval_seconds_fallback = 0.0
+        self._label = sub_id[:8]
+        self._hist = hist
+        self._fb_counter = fb_counter
+
+    def note_eval(self, kind: str, rows: int, seconds: float) -> None:
+        self.rows_scanned += rows
+        if kind == "fallback":
+            self.fallback_evals += 1
+            self.eval_seconds_fallback += seconds
+            if self._fb_counter is not None:
+                self._fb_counter.inc(sub=self._label)
+        else:
+            self.candidate_evals += 1
+            self.eval_seconds_candidate += seconds
+        if self._hist is not None:
+            self._hist.observe(seconds, kind=kind)
+
+    def note_diff(self, n_events: int) -> None:
+        self.diff_rows += n_events
+
+    def note_fanout(self, events: int, nbytes: int, depth: int) -> None:
+        self.fanout_events += events
+        self.fanout_bytes += nbytes
+        if depth > self.queue_depth_hwm:
+            self.queue_depth_hwm = depth
+
+    def note_replay(self, rows: int) -> None:
+        self.replays += 1
+        self.replay_rows += rows
+
+    def snapshot(self) -> dict:
+        out = {name: getattr(self, name) for name in self.COUNTERS}
+        out["eval_seconds_total"] = (
+            self.eval_seconds_candidate + self.eval_seconds_fallback
+        )
+        return out
+
+    def load(self, d: dict) -> None:
+        """Adopt persisted counters (additive: a restored handle resumes
+        the ledger where the killed process last persisted it)."""
+        for name in self.COUNTERS:
+            v = d.get(name)
+            if v is None:
+                continue
+            if name == "queue_depth_hwm":
+                self.queue_depth_hwm = max(self.queue_depth_hwm, v)
+            else:
+                setattr(self, name, getattr(self, name) + v)
+
+
 def normalize_sql(sql: str) -> str:
     """Canonical reuse key (pubsub.rs normalize_sql:2089, which parses and
     re-serializes via sqlparser). Token-level here: comments and
@@ -187,6 +298,15 @@ class MatcherHandle:
         self._local_membership = False
         self._exec_sql = sql
         self._maybe_inject_pks()
+        # EXPLAIN-style query-plan record (tentpole c): computed once at
+        # subscribe time from the classifier sweep + the PK injector's
+        # actual outcome, so "fallback_bound" is the matcher's ground
+        # truth, not a regex guess. Static metadata — not ledger state.
+        self.plan = self._classify_plan()
+        # Cost ledger slot (tentpole a): None unless the cost plane is
+        # armed via SubsManager.enable_costs — the pinned zero-cost
+        # disabled mode (no per-sub allocation, bit-identical behavior).
+        self.cost: SubCost | None = None
         self.columns: list[str] = []
         self.rows: dict[tuple, tuple] = {}  # identity key -> cells
         self.rowids: dict[tuple, int] = {}
@@ -339,6 +459,11 @@ class MatcherHandle:
             "DELETE FROM changes WHERE change_id <= ?",
             (self.change_id - MAX_DURABLE_HISTORY,),
         )
+        if self.cost is not None:
+            # Piggyback the ledger on the batch transaction: a SIGKILL
+            # loses at most the counters since the last published batch,
+            # and relaunch adopts the rest (enable_cost).
+            self._persist_cost(db)
         db.execute("COMMIT")
 
     def close(self) -> None:
@@ -361,10 +486,50 @@ class MatcherHandle:
                 pass
         if self._db is not None:
             try:
+                if self.cost is not None:
+                    self._persist_cost(self._db)
                 self._db.close()
             except Exception:
                 pass
             self._db = None
+
+    # -- cost ledger + plan record -------------------------------------------
+
+    def _classify_plan(self) -> dict:
+        cls, feats = classify_query(self.sql)
+        incremental = bool(self._pk_prefix and self._local_membership)
+        return {
+            "class": cls,
+            "features": feats,
+            "incremental": incremental,
+            "fallback_bound": not incremental,
+            "pk_identity": bool(self._pk_prefix),
+            "join_segments": len(self._pk_segments or ()),
+            "tables": sorted(self.tables),
+        }
+
+    def enable_cost(self, hist=None, fb_counter=None) -> None:
+        """Arm the cost ledger (idempotent). Durable handles re-adopt the
+        counters last persisted in their sub-db meta, so a killed and
+        relaunched agent resumes the ledger instead of zeroing it."""
+        if self.cost is not None:
+            return
+        self.cost = SubCost(self.id, hist=hist, fb_counter=fb_counter)
+        if self._db is not None:
+            row = self._db.execute(
+                "SELECT v FROM meta WHERE k = 'cost'"
+            ).fetchone()
+            if row is not None:
+                try:
+                    self.cost.load(json.loads(row[0]))
+                except (ValueError, TypeError):
+                    pass
+
+    def _persist_cost(self, db) -> None:
+        db.execute(
+            "INSERT OR REPLACE INTO meta VALUES ('cost', ?)",
+            (json.dumps(self.cost.snapshot(), separators=(",", ":")),),
+        )
 
     # -- query shape ---------------------------------------------------------
 
@@ -565,7 +730,7 @@ class MatcherHandle:
     FALLBACK_MIN_INTERVAL = 2.0
 
     def process(
-        self, changes: list[Change] | None = None
+        self, changes: list[Change] | None = None, stages: list | None = None
     ) -> list[QueryEventChange]:
         """Diff against the store and emit change events.
 
@@ -576,6 +741,12 @@ class MatcherHandle:
         back to full snapshot diffing, rate-limited once proven expensive
         (per-batch work stays bounded; events still arrive, one interval
         late at worst).
+
+        ``stages`` (stage profiler, sampled traces only) collects
+        ``(stage, t0_mono, t1_mono)`` tuples for candidate extraction /
+        SQL exec / diff / fan-out enqueue; SubsManager.match_changes
+        turns them into ``sub_match_stage`` spans. ``None`` — the
+        default — costs nothing.
         """
         self._touched: list[tuple] = []
         # An overdue deferred re-snapshot flushes on ANY process() call —
@@ -584,7 +755,14 @@ class MatcherHandle:
         overdue = self._dirty and (
             time.monotonic() - self._last_full >= self.FALLBACK_MIN_INTERVAL
         )
-        candidates = None if overdue else self._candidate_keys(changes)
+        if overdue:
+            candidates = None
+        elif stages is None:
+            candidates = self._candidate_keys(changes)
+        else:
+            t0 = time.monotonic()
+            candidates = self._candidate_keys(changes)
+            stages.append(("candidate_extract", t0, time.monotonic()))
         if candidates is None:
             if self._bg_task is not None:
                 # A background re-snapshot is already scanning: coalesce.
@@ -607,10 +785,15 @@ class MatcherHandle:
                 # match loop for its scan (pubsub.rs's candidate path
                 # never full-scans; this bounds ours per batch).
                 return []
-            events = self._full_pass()
+            events = self._full_pass(stages)
         else:
-            events = self._diff_candidates(candidates)
-        self._publish(events)
+            events = self._diff_candidates(candidates, stages)
+        if stages is None:
+            self._publish(events)
+        else:
+            t0 = time.monotonic()
+            self._publish(events)
+            stages.append(("fanout_enqueue", t0, time.monotonic()))
         return events
 
     def _publish(self, events: list[QueryEventChange]) -> None:
@@ -620,7 +803,15 @@ class MatcherHandle:
         self.history.extend(events)
         if self._db is not None:
             self._persist_events(events, self._touched)
-        for ev in events:
+        # Ledger-armed handles track enqueued event/byte mass and the
+        # listener-queue high-water mark; sizes stays None when the cost
+        # plane is off, so the disabled fan-out loop is untouched.
+        cost = self.cost
+        sizes = None
+        if cost is not None and events and self._listeners:
+            sizes = [len(_cells_to_json(ev.cells)) for ev in events]
+            sent = sent_bytes = 0
+        for i, ev in enumerate(events):
             for q in self._listeners:
                 if q in self._overflowed:
                     # Once lossy, ALWAYS lossy: enqueuing later events
@@ -634,6 +825,9 @@ class MatcherHandle:
                     continue
                 try:
                     q.put_nowait(ev)
+                    if sizes is not None:
+                        sent += 1
+                        sent_bytes += sizes[i]
                 except asyncio.QueueFull:
                     # A laggard that can't drain its queue must not
                     # silently miss events: mark the queue lossy so the
@@ -642,6 +836,11 @@ class MatcherHandle:
                     # replays exactly what was dropped.
                     self._overflowed.add(q)
                     self.dropped_events += 1
+        if sizes is not None:
+            cost.note_fanout(
+                sent, sent_bytes,
+                max(q.qsize() for q in self._listeners),
+            )
 
     def _start_bg_full(self) -> bool:
         """Launch the full re-evaluation on a worker thread with a fresh
@@ -697,6 +896,11 @@ class MatcherHandle:
                     len(new_rows) > self.MAX_FALLBACK_ROWS
                     or cost > self.FALLBACK_EVAL_BUDGET
                 )
+                if self.cost is not None:
+                    # The measured scan cost used to be consumed for flow
+                    # control then discarded; the ledger keeps it.
+                    self.cost.note_eval("fallback", len(new_rows), cost)
+                    self.cost.note_diff(len(events))
                 self._publish(events)
             except asyncio.CancelledError:
                 raise
@@ -720,18 +924,25 @@ class MatcherHandle:
         self._bg_task = loop.create_task(run())
         return True
 
-    def _full_pass(self) -> list[QueryEventChange]:
+    def _full_pass(self, stages: list | None = None) -> list[QueryEventChange]:
         """Full re-evaluation + snapshot diff, tracking its own cost."""
         t0 = time.monotonic()
         cols, new_rows = self._evaluate()
+        t_eval = time.monotonic()
         self.columns = cols
         events = self._diff_full(new_rows)
         now = time.monotonic()
+        if stages is not None:
+            stages.append(("sql_exec", t0, t_eval))
+            stages.append(("diff", t_eval, now))
         self._last_full = now
         self._full_expensive = (
             len(new_rows) > self.MAX_FALLBACK_ROWS
             or (now - t0) > self.FALLBACK_EVAL_BUDGET
         )
+        if self.cost is not None:
+            self.cost.note_eval("fallback", len(new_rows), now - t0)
+            self.cost.note_diff(len(events))
         self._dirty = False
         return events
 
@@ -795,14 +1006,16 @@ class MatcherHandle:
             return None
         return list(keys)
 
-    def _diff_candidates(self, keys) -> list[QueryEventChange]:
+    def _diff_candidates(self, keys, stages: list | None = None) -> list[QueryEventChange]:
         # Any candidate-path snapshot mutation invalidates an in-flight
         # background re-snapshot (its scan predates this change).
         self._mutation_gen += 1
         if isinstance(keys, tuple) and keys[0] == "join":
-            return self._diff_join(keys[1])
+            return self._diff_join(keys[1], stages)
         if not keys:
             return []
+        prof = self.cost is not None or stages is not None
+        t0 = time.monotonic() if prof else 0.0
         npk = self._pk_prefix
         row_vals = ", ".join(
             "(" + ", ".join("?" for _ in range(npk)) + ")" for _ in keys
@@ -820,6 +1033,7 @@ class MatcherHandle:
         fresh = {
             tuple(row[:npk]): tuple(row[npk:]) for row in cur.fetchall()
         }
+        t1 = time.monotonic() if prof else 0.0
         events: list[QueryEventChange] = []
         for key in keys:
             cells = fresh.get(key)
@@ -828,9 +1042,17 @@ class MatcherHandle:
                     self._delete_row(key, events)
             else:
                 self._upsert(key, cells, events)
+        if prof:
+            t2 = time.monotonic()
+            if stages is not None:
+                stages.append(("sql_exec", t0, t1))
+                stages.append(("diff", t1, t2))
+            if self.cost is not None:
+                self.cost.note_eval("candidate", len(keys), t1 - t0)
+                self.cost.note_diff(len(events))
         return events
 
-    def _diff_join(self, by_table: dict) -> list[QueryEventChange]:
+    def _diff_join(self, by_table: dict, stages: list | None = None) -> list[QueryEventChange]:
         """Candidate diff for join subscriptions (handle_candidates over
         multi-table PK temp tables, pubsub.rs:1303-1570): re-evaluate only
         result rows whose changed-table PK segment matches a candidate —
@@ -838,6 +1060,8 @@ class MatcherHandle:
         not the whole result set."""
         if not by_table:
             return []
+        prof = self.cost is not None or stages is not None
+        t0 = time.monotonic() if prof else 0.0
         conds: list[str] = []
         params: list = []
         for table, _alias, off, npk in self._pk_segments:
@@ -860,6 +1084,7 @@ class MatcherHandle:
             tuple(row[:npk_total]): tuple(row[npk_total:])
             for row in cur.fetchall()
         }
+        t1 = time.monotonic() if prof else 0.0
         # Affected existing rows via the per-segment index: O(candidates),
         # never a scan of the materialized result set.
         affected: set[tuple] = set()
@@ -871,6 +1096,15 @@ class MatcherHandle:
             self._upsert(key, cells, events)
         for key in [k for k in affected if k not in fresh and k in self.rows]:
             self._delete_row(key, events)
+        if prof:
+            t2 = time.monotonic()
+            if stages is not None:
+                stages.append(("sql_exec", t0, t1))
+                stages.append(("diff", t1, t2))
+            if self.cost is not None:
+                n_keys = sum(len(v) for v in by_table.values())
+                self.cost.note_eval("candidate", n_keys, t1 - t0)
+                self.cost.note_diff(len(events))
         return events
 
     def _diff_full(self, new_rows) -> list[QueryEventChange]:
@@ -975,6 +1209,8 @@ class MatcherHandle:
             # (doc/api/subscriptions.md resume semantics).
             events.append(QueryEventColumns(list(self.columns)))
             events.extend(replay)
+            if self.cost is not None:
+                self.cost.note_replay(len(replay))
         return [_WireEvent(e) if isinstance(e, dict) else e for e in events]
 
 
@@ -1012,7 +1248,103 @@ class SubsManager:
         # traced write (ambient span present); unwired — the default —
         # the fan-out path costs one attribute check and nothing else.
         self.tracer = None
+        # Cost plane (enable_costs): disarmed by default — handles carry
+        # cost=None and no metric handles exist.
+        self.costs_enabled = False
+        self._cost_hist = None
+        self._cost_fb = None
+        self._cost_gauge = None
         self._ensure_table()
+
+    def enable_costs(self, registry=None) -> None:
+        """Arm the per-subscription cost ledger on every current and
+        future handle. With a ``MetricsRegistry``, also publish the
+        serving-cost aggregates — the per-sub fallback counter rides the
+        registry's ``max_labelsets`` cap, so ephemeral-subscription
+        storms fold into the ``other`` bucket instead of exploding
+        /metrics cardinality."""
+        self.costs_enabled = True
+        if registry is not None:
+            self._cost_hist = registry.histogram(
+                "corro_subs_eval_seconds",
+                "Matcher evaluation wall seconds (kind=candidate|fallback)",
+            )
+            self._cost_fb = registry.counter(
+                "corro_subs_fallback_total",
+                "Full-snapshot fallback evaluations (per-sub label, "
+                "cardinality-capped)",
+            )
+            self._cost_gauge = registry.gauge(
+                "corro_subs_fallback_bound",
+                "Subscriptions the query-plan classifier marks "
+                "fallback-bound (cannot be served incrementally)",
+            )
+        for h in self._by_id.values():
+            h.enable_cost(self._cost_hist, self._cost_fb)
+        self._refresh_fallback_gauge()
+
+    def _refresh_fallback_gauge(self) -> None:
+        if self._cost_gauge is not None:
+            self._cost_gauge.set(
+                sum(
+                    1 for h in self._by_id.values()
+                    if h.plan["fallback_bound"]
+                )
+            )
+
+    def cost_snapshot(self, top: int | None = None) -> dict:
+        """Live ledger snapshot (the `/v1/subs/costs` body and the
+        ``corro-sub-cost/1`` artifact payload): one record per handle —
+        plan record always, counters when the cost plane is armed —
+        sorted by total eval seconds descending, plus ledger-wide
+        totals."""
+        subs = []
+        totals = {
+            "eval_seconds_total": 0.0, "eval_seconds_fallback": 0.0,
+            "fallback_evals": 0, "candidate_evals": 0,
+            "rows_scanned": 0, "fanout_events": 0, "fanout_bytes": 0,
+            "replay_rows": 0, "fallback_bound_subs": 0,
+        }
+        for h in self._by_id.values():
+            rec = {
+                "sub_id": h.id,
+                "sql": h.sql,
+                "plan": dict(h.plan),
+                "change_id": h.change_id,
+                "listeners": len(h._listeners),
+                "dropped_events": h.dropped_events,
+            }
+            if h.plan["fallback_bound"]:
+                totals["fallback_bound_subs"] += 1
+            if h.cost is not None:
+                c = h.cost.snapshot()
+                rec["cost"] = c
+                totals["eval_seconds_total"] += c["eval_seconds_total"]
+                totals["eval_seconds_fallback"] += c["eval_seconds_fallback"]
+                for k in (
+                    "fallback_evals", "candidate_evals", "rows_scanned",
+                    "fanout_events", "fanout_bytes", "replay_rows",
+                ):
+                    totals[k] += c[k]
+            subs.append(rec)
+        subs.sort(
+            key=lambda r: r.get("cost", {}).get("eval_seconds_total", 0.0),
+            reverse=True,
+        )
+        if top is not None:
+            subs = subs[:top]
+        totals["fallback_share"] = (
+            totals["eval_seconds_fallback"] / totals["eval_seconds_total"]
+            if totals["eval_seconds_total"] > 0 else 0.0
+        )
+        return {
+            "kind": "corro-sub-cost",
+            "version": 1,
+            "enabled": self.costs_enabled,
+            "subs_total": len(self._by_id),
+            "totals": totals,
+            "subs": subs,
+        }
 
     def _ensure_table(self) -> None:
         self.store.conn.execute(
@@ -1037,6 +1369,9 @@ class SubsManager:
     def _register(self, key: str, handle: MatcherHandle) -> None:
         self._by_sql[key] = handle
         self._by_id[handle.id] = handle
+        if self.costs_enabled:
+            handle.enable_cost(self._cost_hist, self._cost_fb)
+            self._refresh_fallback_gauge()
 
     def restore(self) -> list[str]:
         """Recreate persisted subscriptions; returns restored ids. A query
@@ -1082,6 +1417,7 @@ class SubsManager:
         writer when one exists, so the event loop never waits on the store
         write lock."""
         span = None
+        stages: list | None = None
         if self.tracer is not None:
             from corrosion_tpu.utils import tracing
 
@@ -1089,17 +1425,43 @@ class SubsManager:
             # match call must not mint a noise root trace.
             if tracing.current_span() is not None:
                 span = self.tracer.span("sub_fanout").__enter__()
+                # Stage profiler rides the same deterministic sampling:
+                # every handle appends (stage, t0, t1) tuples and the
+                # aggregate becomes one sub_match_stage span per stage,
+                # children of sub_fanout — joinable in obs timeline.
+                stages = []
         dirty = []
         try:
             for handle in self._by_id.values():
-                if handle.interested(changes) and handle.process(changes):
+                if handle.interested(changes) and handle.process(
+                    changes, stages
+                ):
                     dirty.append((handle.id, handle.change_id))
         finally:
             if span is not None:
+                if stages:
+                    self._emit_stage_spans(stages)
                 span.set_attr("subs_matched", len(dirty))
                 span.set_attr("subs_total", len(self._by_id))
                 span.__exit__(None, None, None)
         return dirty
+
+    def _emit_stage_spans(self, stages: list) -> None:
+        """Fold per-handle stage timings into one span per stage name
+        (candidate_extract / sql_exec / diff / fanout_enqueue). The span
+        carries the stage's total duration and call count; its start is
+        the first occurrence, converted from the monotonic clock to the
+        tracer's epoch-ns domain."""
+        base_ns = time.time_ns() - int(time.monotonic() * 1e9)
+        agg: dict[str, tuple[float, float, int]] = {}
+        for name, t0, t1 in stages:
+            first, total, n = agg.get(name, (t0, 0.0, 0))
+            agg[name] = (min(first, t0), total + (t1 - t0), n + 1)
+        for name, (first, total, n) in agg.items():
+            sp = self.tracer.span("sub_match_stage", stage=name, calls=n)
+            sp.start_ns = base_ns + int(first * 1e9)
+            sp.end_ns = sp.start_ns + int(total * 1e9)
+            self.tracer._record(sp)
 
     def persist_watermarks_sync(self, dirty: list[tuple[str, int]]) -> None:
         if not dirty:

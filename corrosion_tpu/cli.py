@@ -242,6 +242,48 @@ def _build_parser() -> argparse.ArgumentParser:
                      "new breaches are never tolerated")
     osd.add_argument("--json", action="store_true")
 
+    # Serving query-cost plane (corrosion_tpu/obs/serving.py,
+    # docs/SERVING.md "Query-cost plane"): join a cost-armed storm's
+    # per-subscription ledger with the fan-out oracle's delivery records
+    # into the lag-vs-cost heatmap, and the SERVING_COST_BASELINE gate.
+    osv = ob_sub.add_parser(
+        "serving", parents=[common],
+        help="serving query-cost analyzer: per-subscription lag-vs-cost "
+        "attribution from a cost-armed loadgen run, and the "
+        "SERVING_COST_BASELINE diff gate",
+    )
+    osv_sub = osv.add_subparsers(dest="serving_cmd", required=True)
+
+    osvr = osv_sub.add_parser(
+        "report", parents=[common],
+        help="build the corro-serving-cost/1 heatmap report from a "
+        "loadgen run emitted with --sub-costs (exit 1 when the ledger "
+        "fails to reconcile against the oracle)",
+    )
+    osvr.add_argument("--from-run", required=True,
+                      help="loadgen run report JSON produced with "
+                      "sub_costs armed (reads run.sub_costs)")
+    osvr.add_argument("--top", type=int, default=10,
+                      help="top-K slow subscriptions to list")
+    osvr.add_argument("--json", action="store_true")
+    osvr.add_argument("--out", default=None, help="report JSON path")
+
+    osvd = osv_sub.add_parser(
+        "diff", parents=[common],
+        help="flag serving-cost regressions between two "
+        "corro-serving-cost/1 reports — the SERVING_COST_BASELINE.json "
+        "CI gate",
+    )
+    osvd.add_argument("baseline", help="serving-cost report JSON")
+    osvd.add_argument("candidate", help="serving-cost report JSON")
+    osvd.add_argument("--tolerance", type=float, default=1.5,
+                      help="multiplier on baseline eval/lag figures "
+                      "(default 1.5)")
+    osvd.add_argument("--floor-ms", type=float, default=5.0,
+                      help="absolute floor under which deltas never "
+                      "regress (loopback noise guard)")
+    osvd.add_argument("--json", action="store_true")
+
     otm = ob_sub.add_parser(
         "timeline", parents=[common],
         help="correlate a traced loadgen run's spans + oracle delivery "
@@ -1161,11 +1203,11 @@ async def _fidelity(args) -> int:
 
 
 def _obs(args) -> int:
-    """`corrosion obs {report,tail,diff,record,epidemic,timeline,cost,
-    trajectory}` — delegates to the obs package
+    """`corrosion obs {report,tail,diff,record,epidemic,soak,serving,
+    timeline,cost,trajectory}` — delegates to the obs package
     (corrosion_tpu/obs/commands.py), which owns the convergence-plane
-    verdicts, the propagation/epidemic analyzer, and the causal-tracing
-    correlator."""
+    verdicts, the propagation/epidemic analyzer, the endurance and
+    serving query-cost analyzers, and the causal-tracing correlator."""
     from corrosion_tpu.obs import commands as obs_commands
 
     return obs_commands.run(args)

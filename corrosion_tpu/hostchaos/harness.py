@@ -174,6 +174,7 @@ async def run_scenario(
     series_dir: str | None = None,
     series_interval: float = 0.25,
     endurance_kw: dict | None = None,
+    sub_costs: bool = False,
 ) -> dict:
     """Run one scenario end to end; returns the report dict (``ok`` is
     the overall verdict — oracle, convergence, bookkeeping, machinery).
@@ -184,7 +185,15 @@ async def run_scenario(
     relaunched agent reopens its series ``mode="a"`` so the restart
     discontinuity lands in ONE record), and the report gains an
     ``endurance`` block with one corro-endurance/1 verdict per agent
-    (obs/endurance.py detectors, tuned via ``endurance_kw``)."""
+    (obs/endurance.py detectors, tuned via ``endurance_kw``).
+
+    ``sub_costs`` arms the serving query-cost plane on every agent
+    (``AgentConfig.sub_costs``): the report gains a ``sub_costs`` block
+    with the subs-hosting agent's ``corro-sub-cost/1`` ledger, and crash
+    scenarios additionally prove ledger ADOPTION — the relaunched agent
+    re-reads its persisted per-subscription counters from the sub dbs
+    (the same restart-survival contract as the series recorder), so a
+    kill cannot silently zero the cost attribution."""
 
     def note(msg: str) -> None:
         if progress is not None:
@@ -196,7 +205,7 @@ async def run_scenario(
     netem_on = not spec.plan.empty
     cluster_kw: dict = dict(spec.agent_cfg)
     cfg_for = None
-    if netem_on or series_dir is not None:
+    if netem_on or series_dir is not None or sub_costs:
         def cfg_for(i, _plan=plan_obj, _seed=seed):
             cfg: dict = {}
             if netem_on:
@@ -211,6 +220,8 @@ async def run_scenario(
                     ),
                     "runtime_metrics_interval": series_interval,
                 })
+            if sub_costs:
+                cfg["sub_costs"] = True
             return cfg
     note(f"launching {spec.n_agents} agents (netem={netem_on}, seed={seed})")
     agents = await launch_test_cluster(
@@ -283,6 +294,10 @@ async def run_scenario(
             live.discard(ks.agent)
             t0 = time.monotonic()
             pre_kill_snapshots.append(victim.agent.metrics.snapshot())
+            if sub_costs and victim.agent.subs is not None:
+                kill_report["cost_pre_kill"] = (
+                    victim.agent.subs.cost_snapshot()["totals"]
+                )
             await hard_kill(victim)
             await asyncio.sleep(
                 max(0.0, ks.t_restart_s - ks.t_kill_s
@@ -301,6 +316,14 @@ async def run_scenario(
             shim = agents[ks.agent].agent.netem
             if shim is not None:
                 shim.arm(at=t_arm)
+            if sub_costs and agents[ks.agent].agent.subs is not None:
+                # Snapshot BEFORE the agent rejoins the write rotation:
+                # nonzero counters here can only have come from the
+                # persisted ledger (modulo gossip catch-up), proving the
+                # relaunch adopted the previous life's attribution.
+                kill_report["cost_adopted"] = (
+                    agents[ks.agent].agent.subs.cost_snapshot()["totals"]
+                )
             live.add(ks.agent)
             kill_report.update({
                 "agent": ks.agent,
@@ -395,6 +418,31 @@ async def run_scenario(
                 f"(machinery={machinery})"
             )
 
+        sub_cost_block = None
+        if sub_costs:
+            mgr = agents[spec.subs_on].agent.subs
+            ledger = mgr.cost_snapshot() if mgr is not None else None
+            sub_cost_block = {"enabled": True, "ledger": ledger}
+            pre = kill_report.get("cost_pre_kill")
+            adopted = kill_report.get("cost_adopted")
+            if (
+                spec.kill is not None and spec.kill.agent == spec.subs_on
+                and pre is not None and pre.get("fanout_events", 0) > 0
+                and adopted is not None
+                and adopted.get("fanout_events", 0) == 0
+                and adopted.get("candidate_evals", 0)
+                + adopted.get("fallback_evals", 0) == 0
+            ):
+                # The previous life demonstrably published (and
+                # publishing persists the cost row in the same sub-db
+                # transaction as the events), yet the relaunched agent
+                # came back with an all-zero ledger: adoption broke.
+                failures.append(
+                    f"cost-ledger adoption failed: n{spec.kill.agent} "
+                    f"relaunched with an empty ledger despite "
+                    f"{pre['fanout_events']} pre-kill fan-out events"
+                )
+
         endurance_block = None
         if series_dir is not None:
             # Judge each agent's recorded series (flush-per-line: the
@@ -455,6 +503,7 @@ async def run_scenario(
             "machinery_required": list(spec.require_fired),
             "machinery_ok": machinery_ok,
             "endurance": endurance_block,
+            "sub_costs": sub_cost_block,
             "netem": netem_block,
             "ok": not failures,
             "failures": failures,

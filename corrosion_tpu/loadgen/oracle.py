@@ -229,12 +229,29 @@ class FanoutOracle:
                     "t_send_wall": c.t_send_wall,
                     "t_ack_wall": c.t_ack_wall,
                     "t_send_mono": c.t_send_mono,
-                    "t_ack_mono": c.t_ack if c.t_send_mono is not None
-                    else None,
+                    # Monotonic ack time is exported unconditionally: the
+                    # serving-cost join measures per-delivery lag against
+                    # it even on untraced runs (the correlator still
+                    # guards on t_send_mono for its reconciliation).
+                    "t_ack_mono": c.t_ack,
                 }
                 for c in self._commits.values()
             ],
             "deliveries": list(self.delivery_log),
+            # Per-stream identity + delivered mass: the serving-cost
+            # report reconciles each subscription handle's ledger against
+            # exactly these counts.
+            "streams": [
+                {
+                    "sid": st.sid,
+                    "group": st.group,
+                    "label": st.label,
+                    "delivered_changes": len(st.seen_change),
+                    "delivered_snapshot": len(st.seen_snapshot),
+                    "reconnects": st.reconnects,
+                }
+                for st in self._streams.values()
+            ],
         }
 
     # -- verdict -------------------------------------------------------------

@@ -70,6 +70,19 @@ def _payload(k: int) -> str:
     return f"loadgen-w{k}"
 
 
+def _window_sub_sql(fallback_groups: int, g: int) -> str:
+    """A deliberately fallback-bound subscription query: the window
+    function in the select list defeats PK injection (whole-row identity,
+    full-snapshot re-evaluation per batch — the VERDICT r5 #8 cliff), yet
+    stays oracle-compatible: ``min(id) OVER (PARTITION BY id)`` is the
+    row's own id, so the delivered payload ``(text, id)`` is deterministic
+    per key and never changes as other rows arrive."""
+    return (
+        "SELECT id, text, min(id) OVER (PARTITION BY id) AS w"
+        f" FROM tests WHERE id % {fallback_groups} = {g}"
+    )
+
+
 async def fanout_storm(
     data_dir: str,
     *,
@@ -84,6 +97,9 @@ async def fanout_storm(
     attach_batch: int = 64,
     trace_dir: str | None = None,
     trace_sample: float = 1.0,
+    sub_costs: bool = False,
+    fallback_subs: int = 0,
+    fallback_groups: int = 2,
     progress=None,
 ) -> dict:
     """Scenario (b): the subscription fan-out storm. Returns the ``run``
@@ -95,7 +111,17 @@ async def fanout_storm(
     traceparent, and the report gains a ``trace`` block (span files +
     oracle delivery records) — everything ``obs timeline`` needs to
     reconstruct each acked write's journey (docs/OBSERVABILITY.md
-    "Causal tracing")."""
+    "Causal tracing").
+
+    ``sub_costs`` arms the serving query-cost plane: agents launch with
+    ``AgentConfig.sub_costs`` on, the oracle keeps per-delivery records,
+    and the report gains a ``sub_costs`` block (the ``corro-sub-cost/1``
+    ledger snapshot + group->sub_id mapping + oracle records) — the
+    input of ``obs serving report``. ``fallback_subs`` additionally
+    attaches that many deliberately fallback-bound window-function
+    subscriptions spread over ``fallback_groups`` distinct queries, so a
+    storm exercises the fallback cliff on purpose (the machinery-fired
+    rule requires it)."""
 
     def note(msg):
         if progress is not None:
@@ -116,11 +142,13 @@ async def fanout_storm(
             trace_sample=trace_sample,
             cfg_for=lambda i: {"trace_export_path": span_files[i]},
         )
+    if sub_costs:
+        cluster_kw["sub_costs"] = True
     agents = await _launch_cluster(data_dir, n_agents, **cluster_kw)
     harness = LoadHarness()
     oracle = FanoutOracle(
         registry=harness.registry,
-        keep_deliveries=trace_dir is not None,
+        keep_deliveries=trace_dir is not None or sub_costs,
     )
     pumps: list[SubscriptionPump] = []
     pg_server = pg_client = None
@@ -143,6 +171,29 @@ async def fanout_storm(
                 pumps.append(pump)
                 batch.append(pump.start())
             await asyncio.gather(*batch)
+        if fallback_subs:
+            # Fallback-bound window streams ride their own oracle groups
+            # (sub_groups + wg): each write registers a second commit with
+            # the window payload, so exactly-once/no-loss obligations hold
+            # for the cliff population too.
+            note(
+                f"attaching {fallback_subs} fallback-bound window subs "
+                f"in {fallback_groups} groups"
+            )
+            for base in range(0, fallback_subs, attach_batch):
+                batch = []
+                for j in range(
+                    base, min(base + attach_batch, fallback_subs)
+                ):
+                    wg = j % fallback_groups
+                    pump = SubscriptionPump(
+                        agents[0].client,
+                        _window_sub_sql(fallback_groups, wg),
+                        oracle, group=sub_groups + wg, label=f"wsub{j}",
+                    )
+                    pumps.append(pump)
+                    batch.append(pump.start())
+                await asyncio.gather(*batch)
         note("subscriptions live; starting storm")
 
         loop = asyncio.get_running_loop()
@@ -172,14 +223,23 @@ async def fanout_storm(
                       [k, payload]]],
                     traceparent=tp,
                 )
+                t_ack = loop.time()
                 oracle.commit(
-                    k, (payload,), loop.time(), group=k % sub_groups,
+                    k, (payload,), t_ack, group=k % sub_groups,
                     trace_id=trace_id, t_send_wall=t_send,
                     t_ack_wall=(
                         time.time() if trace_dir is not None else None
                     ),
                     t_send_mono=t_send_mono,
                 )
+                if fallback_subs:
+                    # The same row reaches the window streams with the
+                    # window column appended: a distinct (key, payload)
+                    # commit on the window group, same ack time.
+                    oracle.commit(
+                        k, (payload, k), t_ack,
+                        group=sub_groups + (k % fallback_groups),
+                    )
 
             # Deadline scales with fan-out: every commit costs the
             # server O(subs) queue pushes + socket writes, and the
@@ -188,7 +248,7 @@ async def fanout_storm(
             # not the server.
             await harness.timed(
                 "transactions", a, go,
-                deadline_s=15.0 + subs / 100.0,
+                deadline_s=15.0 + (subs + fallback_subs) / 100.0,
             )
 
         async def fire_read(a: Arrival):
@@ -239,6 +299,8 @@ async def fanout_storm(
         out = {
             "subs": subs,
             "sub_groups": sub_groups,
+            "fallback_subs": fallback_subs,
+            "fallback_groups": fallback_groups if fallback_subs else 0,
             "agents": n_agents,
             "writes": writes,
             "write_rate_hz": write_rate,
@@ -253,6 +315,32 @@ async def fanout_storm(
                 "span_files": span_files,
                 "sample": trace_sample,
                 "oracle_records": oracle.delivery_records(),
+            }
+        if sub_costs:
+            # Query-cost plane export: the live ledger snapshot, the
+            # oracle group -> matcher sub_id mapping (each group is one
+            # distinct query, hence one MatcherHandle), and the oracle's
+            # delivery records — everything `obs serving report` joins.
+            mgr = agents[0].agent.subs
+            groups_map: dict[str, str] = {}
+            for g in range(sub_groups):
+                groups_map[str(g)] = mgr.subscribe(
+                    f"SELECT id, text FROM tests WHERE id % {sub_groups} "
+                    f"= {g}"
+                ).id
+            for wg in range(fallback_groups if fallback_subs else 0):
+                groups_map[str(sub_groups + wg)] = mgr.subscribe(
+                    _window_sub_sql(fallback_groups, wg)
+                ).id
+            out["sub_costs"] = {
+                "enabled": True,
+                "ledger": mgr.cost_snapshot(),
+                "groups": groups_map,
+                "oracle_records": (
+                    out["trace"]["oracle_records"]
+                    if trace_dir is not None
+                    else oracle.delivery_records()
+                ),
             }
         return out
     finally:

@@ -32,6 +32,8 @@ def run(args) -> int:
         return _epidemic(args)
     if args.obs_cmd == "soak":
         return _soak(args)
+    if args.obs_cmd == "serving":
+        return _serving(args)
 
     from corrosion_tpu.sim import health
 
@@ -262,6 +264,77 @@ def _soak(args) -> int:
             for r in diff["regressions"]:
                 print(f"REGRESSION: {r}", file=sys.stderr)
         return 1 if diff["regressions"] else 0
+    return 2
+
+
+def _serving(args) -> int:
+    """`obs serving {report,diff}` — the serving query-cost plane's
+    analyzer (obs/serving.py, docs/SERVING.md "Query-cost plane").
+    jax-free: joining a recorded ledger with oracle delivery records
+    must not pay the kernel import. Exit 0 = verdict ok, 1 =
+    reconciliation/regression failure, 2 = usage."""
+    from corrosion_tpu.obs import serving
+
+    if args.serving_cmd == "report":
+        try:
+            with open(args.from_run) as f:
+                report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"obs serving report: {e!r}", file=sys.stderr)
+            return 2
+        run = report.get("run", report)
+        try:
+            rep = serving.build_serving_report(run, top_k=args.top)
+        except ValueError as e:
+            print(f"obs serving report: {e}", file=sys.stderr)
+            return 2
+        _emit(
+            rep, args,
+            text=None if args.json else serving.render_serving_report(rep),
+        )
+        ok = rep["reconciliation"]["ok"] and rep["fallback"]["observed"]
+        if not rep["fallback"]["observed"]:
+            print(
+                "obs serving report: no fallback-bound subscription was "
+                "ever observed evaluating (machinery-fired rule)",
+                file=sys.stderr,
+            )
+        for m in rep["reconciliation"]["mismatches"]:
+            print(f"obs serving report: MISMATCH: {m}", file=sys.stderr)
+        return 0 if ok else 1
+
+    if args.serving_cmd == "diff":
+        try:
+            with open(args.baseline) as f:
+                base = json.load(f)
+            with open(args.candidate) as f:
+                cand = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"obs serving diff: {e!r}", file=sys.stderr)
+            return 2
+        # Accept either a bare corro-serving-cost/1 report or a smoke
+        # gate report that nests one under "serving".
+        base = base.get("serving", base)
+        cand = cand.get("serving", cand)
+        ok, rows = serving.diff_serving_reports(
+            base, cand, tolerance=args.tolerance, floor_ms=args.floor_ms,
+        )
+        if args.json:
+            print(json.dumps({"ok": ok, "rows": rows}))
+        else:
+            for row in rows:
+                mark = "ok" if row["ok"] else "REGRESSION"
+                print(
+                    f"{row['path']}: {row['base']} -> {row['cand']} "
+                    f"(limit {row['limit']}) [{mark}]"
+                )
+        for row in rows:
+            if not row["ok"]:
+                print(
+                    f"obs serving diff: REGRESSION: {row['path']} "
+                    f"{row['base']} -> {row['cand']}", file=sys.stderr,
+                )
+        return 0 if ok else 1
     return 2
 
 

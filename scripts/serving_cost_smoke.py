@@ -1,0 +1,229 @@
+"""CI serving-cost gate: the query-cost plane under a storm vs a budget.
+
+Runs ONE cost-armed subscription fan-out storm at CI-feasible scale —
+448 plain streams over 4 incremental-capable queries plus 64
+deliberately fallback-bound window-function streams over 2 queries (512
+streams total, past the 500-stream acceptance floor) — with the
+per-subscription cost ledger enabled, joins the ledger with the fan-out
+oracle's delivery records into the ``corro-serving-cost/1`` heatmap
+report (``obs.serving.build_serving_report``), emits it through the one
+self-describing path (``loadgen.report.emit_serving_report``), writes
+the report + the raw ``corro-sub-cost/1`` ledger JSONL as artifacts, and
+exits 1 when:
+
+- the ``serving_cost`` entry of bench_budget.json is breached — eval/lag
+  ceilings (tolerance-scaled), the fallback-share ceiling, any oracle
+  violation (never scaled), a ledger that fails to reconcile exactly
+  against oracle delivery counts, or the machinery-fired rule (a storm
+  where no fallback-bound subscription was ever observed evaluating is a
+  test-harness failure, not a pass);
+- the report regresses against the committed SERVING_COST_BASELINE.json
+  (``obs.serving.diff_serving_reports``).
+
+Usage:
+    python scripts/serving_cost_smoke.py [--out report.json]
+    python scripts/serving_cost_smoke.py --update   # refresh budget+baseline
+
+``--update`` rewrites ONLY the ``serving_cost`` entry of the budget file
+(x3 headroom, 500 ms latency floor — same policy as loadgen_smoke.py)
+AND SERVING_COST_BASELINE.json from the current measurement
+(docs/SERVING.md "Query-cost plane" documents the workflow).
+"""
+
+from __future__ import annotations
+
+import os as _os, sys as _sys
+_sys.path.insert(
+    0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+)
+
+import argparse
+import asyncio
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+# Reduced CI scale; the acceptance floor is >= 500 total streams with a
+# deliberately fallback-bound window population.
+SUBS = 448
+SUB_GROUPS = 4
+FALLBACK_SUBS = 64
+FALLBACK_GROUPS = 2
+WRITES = 60
+WRITE_RATE = 30.0
+SCENARIO = "serving_cost_smoke"
+UPDATE_HEADROOM = 3.0
+UPDATE_FLOOR_MS = 500.0
+# Fallback share is a ratio, not a latency: headroom is additive with a
+# hard sub-1.0 cap (1.0 would accept "all eval burn is fallback").
+SHARE_HEADROOM = 0.2
+SHARE_CAP = 0.97
+
+CEILING_PATHS = (
+    "serving.eval_ms.total",
+    "serving.eval_ms.fallback",
+    "serving.classes.window.lag_ms.p99",
+    "serving.classes.simple.lag_ms.p99",
+    "run.oracle.fanout_lag_ms.p99",
+)
+
+
+def measure() -> dict:
+    from corrosion_tpu.loadgen import scenarios
+    from corrosion_tpu.loadgen.report import (
+        emit_serving_report,
+        serving_context,
+    )
+    from corrosion_tpu.obs import serving
+
+    async def go():
+        with tempfile.TemporaryDirectory() as tmp:
+            return await scenarios.fanout_storm(
+                tmp, subs=SUBS, sub_groups=SUB_GROUPS,
+                writes=WRITES, write_rate=WRITE_RATE,
+                sub_costs=True, fallback_subs=FALLBACK_SUBS,
+                fallback_groups=FALLBACK_GROUPS, progress=sys.stderr,
+            )
+
+    run = asyncio.run(go())
+    rep = serving.build_serving_report(run)
+    return emit_serving_report({
+        **serving_context(
+            SCENARIO, 1, SUBS, SUB_GROUPS, FALLBACK_SUBS, FALLBACK_GROUPS,
+            WRITES,
+        ),
+        "streams": rep["streams"],
+        "run": run,
+        "serving": rep,
+    })
+
+
+def main(argv=None) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", default=str(repo / "bench_budget.json"))
+    ap.add_argument(
+        "--baseline", default=str(repo / "SERVING_COST_BASELINE.json")
+    )
+    ap.add_argument("--out", default="serving_cost_report.json")
+    ap.add_argument(
+        "--ledger-out", default="serving_cost_ledger.jsonl",
+        help="raw corro-sub-cost/1 ledger artifact path",
+    )
+    ap.add_argument(
+        "--update", action="store_true",
+        help="rewrite the budget's `serving_cost` entry "
+        f"(x{UPDATE_HEADROOM} headroom) AND the committed baseline from "
+        "this measurement instead of gating",
+    )
+    args = ap.parse_args(argv)
+
+    from corrosion_tpu.obs import serving
+    from corrosion_tpu.sim import benchlib
+
+    measured = measure()
+    # The raw ledger rides along as a self-describing artifact so a CI
+    # run's per-sub counters are inspectable without re-running.
+    serving.write_cost_ledger(
+        args.ledger_out,
+        measured["run"]["sub_costs"]["ledger"],
+        context={"scenario": SCENARIO, "platform": measured["platform"]},
+    )
+    budget_path = Path(args.budget)
+    full_budget = (
+        json.loads(budget_path.read_text()) if budget_path.exists() else {}
+    )
+    if args.update:
+        def ceiling(path: str) -> float:
+            cur = benchlib.get_path(measured, path)
+            if cur is None:
+                raise SystemExit(
+                    f"[serving-cost] --update: measurement is missing "
+                    f"{path!r} — cannot refresh the budget from it"
+                )
+            return round(
+                max(float(cur) * UPDATE_HEADROOM, UPDATE_FLOOR_MS), 1
+            )
+
+        share = measured["serving"]["fallback"]["share_of_eval_seconds"]
+        full_budget["serving_cost"] = {
+            "platform": measured["platform"],
+            "scenario": SCENARIO,
+            "streams": measured["streams"],
+            "tolerance": full_budget.get("serving_cost", {}).get(
+                "tolerance", benchlib.DEFAULT_TOLERANCE
+            ),
+            "ceilings_ms": {p: ceiling(p) for p in CEILING_PATHS},
+            "fallback_share_max": round(
+                min(SHARE_CAP, share + SHARE_HEADROOM), 3
+            ),
+            "oracle_violations_max": 0,
+            "require_fallback_observed": True,
+            "require_mass_reconciled": True,
+        }
+        budget_path.write_text(
+            json.dumps(full_budget, indent=2) + "\n"
+        )
+        Path(args.baseline).write_text(json.dumps({
+            "platform": measured["platform"],
+            "scenario": SCENARIO,
+            "streams": measured["streams"],
+            "serving": measured["serving"],
+        }, indent=2) + "\n")
+        print(
+            f"[serving-cost] budget + baseline refreshed: {budget_path}, "
+            f"{args.baseline}"
+        )
+        print(json.dumps(measured))
+        return 0
+
+    if "serving_cost" not in full_budget:
+        ok, breaches = False, [
+            "serving_cost: entry missing from budget — rerun with --update"
+        ]
+    else:
+        ok, breaches = serving.check_serving_cost_budget(
+            measured, full_budget["serving_cost"]
+        )
+    base_path = Path(args.baseline)
+    diff_rows: list = []
+    if not base_path.exists():
+        ok = False
+        breaches.append(
+            f"{base_path.name} missing — rerun with --update"
+        )
+    else:
+        base = json.loads(base_path.read_text())
+        diff_ok, diff_rows = serving.diff_serving_reports(
+            base.get("serving", base), measured["serving"],
+            tolerance=float(
+                full_budget.get("serving_cost", {}).get("tolerance", 1.5)
+            ),
+        )
+        if not diff_ok:
+            ok = False
+            breaches.extend(
+                f"baseline regression: {r['path']} {r['base']} -> "
+                f"{r['cand']} (limit {r['limit']})"
+                for r in diff_rows if not r["ok"]
+            )
+    report = {
+        **measured,
+        "budget": full_budget.get("serving_cost"),
+        "baseline_diff": diff_rows,
+        "ok": ok,
+        "breaches": breaches,
+    }
+    Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    print(serving.render_serving_report(measured["serving"]))
+    if not ok:
+        for b in breaches:
+            print(f"[serving-cost] BREACH {b}", file=sys.stderr)
+        return 1
+    print("[serving-cost] gate ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

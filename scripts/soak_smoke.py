@@ -190,10 +190,15 @@ async def run_host_lane(tmp: str, progress) -> dict:
     series_dir = _os.path.join(tmp, "host-series")
     _os.makedirs(series_dir, exist_ok=True)
     with tempfile.TemporaryDirectory() as d:
+        # sub_costs rides along so the standing soak lane also records
+        # the serving query-cost ledger (docs/SERVING.md "Query-cost
+        # plane") — the leak detectors stay the gate; the ledger is
+        # artifact visibility for slow cost drift across the soak.
         return await run_scenario(
             spec, d, seed=SEED, progress=progress,
             series_dir=series_dir, series_interval=0.2,
             endurance_kw=dict(HOST_ENDURANCE_KW),
+            sub_costs=True,
         )
 
 

@@ -1,0 +1,568 @@
+"""Serving query-cost plane (docs/SERVING.md "Query-cost plane").
+
+Pins the tentpole contracts: the pinned zero-cost disabled mode
+(bit-identical events, no per-sub state), the query-plan classifier
+(regex sweep + PK-injector ground truth), the per-sub fallback counter
+riding the registry's cardinality cap, the heatmap join + exact mass
+reconciliation (including the missing-ledger refusal and the
+machinery-fired rule), ledger survival across ``?from=`` replay and
+agent kill/relaunch, and the ``/v1/subs/costs`` endpoint.
+"""
+
+import asyncio
+import json
+import sqlite3
+
+import pytest
+
+from corrosion_tpu.agent.store import Store
+from corrosion_tpu.agent.subs import MatcherHandle, classify_query
+from corrosion_tpu.core.values import Statement
+
+SCHEMA = """
+CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '');
+CREATE TABLE tests2 (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '');
+"""
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def mk_store(tmp_path, n=0):
+    s = Store(str(tmp_path / f"node{n}.db"), bytes([n + 1] * 16))
+    s.apply_schema(SCHEMA)
+    return s
+
+
+def ins(s, i, text, table="tests"):
+    _, _, _, changes = s.execute_transaction(
+        [Statement(f"INSERT INTO {table} (id, text) VALUES (?, ?)",
+                   params=[i, text])]
+    )
+    return changes
+
+
+# -- classifier ---------------------------------------------------------------
+
+
+def test_classifier_unit_vectors():
+    """The regex sweep's class precedence (window > aggregate > join >
+    simple) and feature flags on representative shapes."""
+    cls, feats = classify_query(
+        "SELECT id, min(id) OVER (PARTITION BY id) FROM tests"
+    )
+    assert cls == "window" and "window" in feats
+    cls, feats = classify_query(
+        "SELECT text, count(*) FROM tests GROUP BY text"
+    )
+    assert cls == "aggregate"
+    assert "aggregate" in feats and "group_by" in feats
+    cls, feats = classify_query(
+        "SELECT t.id FROM tests t JOIN tests2 u ON t.id = u.id"
+    )
+    assert cls == "join" and "join" in feats
+    cls, feats = classify_query(
+        "SELECT t.id FROM tests t LEFT JOIN tests2 u ON t.id = u.id"
+    )
+    assert cls == "join" and "outer_join" in feats
+    cls, feats = classify_query("SELECT id, text FROM tests WHERE id % 2 = 0")
+    assert cls == "simple" and feats == []
+    cls, feats = classify_query("SELECT DISTINCT id FROM tests LIMIT 5")
+    assert cls == "simple"
+    assert "distinct" in feats and "limit" in feats
+
+
+def test_plan_record_uses_injector_ground_truth(tmp_path):
+    """``fallback_bound`` comes from the PK injector's actual outcome,
+    not the regex guess: a plain-predicate query is incremental, a
+    window query (PK injection refused) is fallback-bound."""
+    s = mk_store(tmp_path)
+    try:
+        h = MatcherHandle(s, "SELECT id, text FROM tests WHERE id % 2 = 0")
+        assert h.plan["class"] == "simple"
+        assert h.plan["incremental"] and not h.plan["fallback_bound"]
+        w = MatcherHandle(
+            s,
+            "SELECT id, text, min(id) OVER (PARTITION BY id) AS w"
+            " FROM tests",
+        )
+        assert w.plan["class"] == "window"
+        assert w.plan["fallback_bound"] and not w.plan["incremental"]
+        h.close()
+        w.close()
+    finally:
+        s.close()
+
+
+# -- zero-cost disabled pin ---------------------------------------------------
+
+
+def test_disabled_mode_zero_cost_pin(tmp_path):
+    """Disabled (the default) is pinned zero-cost: ``handle.cost`` stays
+    None, the sub-db never grows a cost row, and the emitted event
+    stream is bit-identical to an enabled handle's over the same
+    writes."""
+    s_off = mk_store(tmp_path, 0)
+    s_on = mk_store(tmp_path, 1)
+    try:
+        sql = "SELECT id, text FROM tests WHERE id % 2 = 0"
+        d_off = str(tmp_path / "subs_off")
+        d_on = str(tmp_path / "subs_on")
+        h_off = MatcherHandle(s_off, sql, db_dir=d_off)
+        h_on = MatcherHandle(s_on, sql, db_dir=d_on)
+        h_on.enable_cost()
+        assert h_off.cost is None and h_on.cost is not None
+        ev_off, ev_on = [], []
+        for i in range(6):
+            ev_off += h_off.process(ins(s_off, i, f"row{i}"))
+            ev_on += h_on.process(ins(s_on, i, f"row{i}"))
+        assert [e.to_json_obj() for e in ev_off] == \
+               [e.to_json_obj() for e in ev_on]
+        assert h_off.cost is None
+        assert h_on.cost.snapshot()["candidate_evals"] > 0
+        off_id, on_id = h_off.id, h_on.id
+        h_off.close()
+        h_on.close()
+        db = sqlite3.connect(f"{d_off}/{off_id}.sqlite")
+        assert db.execute(
+            "SELECT v FROM meta WHERE k = 'cost'"
+        ).fetchone() is None
+        db.close()
+        db = sqlite3.connect(f"{d_on}/{on_id}.sqlite")
+        row = db.execute("SELECT v FROM meta WHERE k = 'cost'").fetchone()
+        db.close()
+        assert row is not None
+        assert json.loads(row[0])["candidate_evals"] > 0
+    finally:
+        s_off.close()
+        s_on.close()
+
+
+def test_ledger_counts_fallback_and_candidate_kinds(tmp_path):
+    """A fallback-bound handle books fallback evals + scanned rows; an
+    incremental one books candidate evals — and the stage profiler
+    decomposes a processed batch into the four stages."""
+    s = mk_store(tmp_path)
+    try:
+        w = MatcherHandle(
+            s,
+            "SELECT id, text, min(id) OVER (PARTITION BY id) AS w"
+            " FROM tests",
+        )
+        w.enable_cost()
+        h = MatcherHandle(s, "SELECT id, text FROM tests")
+        h.enable_cost()
+        stages: list = []
+        for i in range(4):
+            changes = ins(s, i, f"r{i}")
+            w.process(changes)
+            h.process(changes, stages)
+        cw, ch = w.cost.snapshot(), h.cost.snapshot()
+        assert cw["fallback_evals"] >= 1 and cw["candidate_evals"] == 0
+        assert cw["eval_seconds_fallback"] > 0 and cw["rows_scanned"] > 0
+        assert ch["candidate_evals"] >= 1 and ch["fallback_evals"] == 0
+        names = {name for name, _, _ in stages}
+        assert names == {
+            "candidate_extract", "sql_exec", "diff", "fanout_enqueue",
+        }
+        w.close()
+        h.close()
+    finally:
+        s.close()
+
+
+# -- cardinality cap ----------------------------------------------------------
+
+
+def test_fallback_counter_cardinality_cap_under_ephemeral_subs():
+    """5k ephemeral subscriptions' fallback counters must not explode
+    /metrics: past ``max_labelsets`` the per-sub label folds into the
+    `other` bucket and the registry counts the folded samples."""
+    from corrosion_tpu.agent.subs import SubCost
+    from corrosion_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    fb = reg.counter("corro_subs_fallback_total")
+    for i in range(5000):
+        cost = SubCost(f"{i:08x}" * 4, fb_counter=fb)
+        cost.note_eval("fallback", rows=1, seconds=0.001)
+    assert len(fb._values) <= reg.max_labelsets + 1
+    assert fb.get(sub="other") == 5000 - reg.max_labelsets
+    assert reg._labelsets_dropped.get() == 5000 - reg.max_labelsets
+    total = sum(fb._values.values())
+    assert total == 5000  # folding loses cardinality, never mass
+
+
+# -- heatmap join -------------------------------------------------------------
+
+
+def _cost(**kw):
+    from corrosion_tpu.agent.subs import SubCost
+
+    base = {k: 0 for k in SubCost.COUNTERS}
+    base["eval_seconds_candidate"] = 0.0
+    base["eval_seconds_fallback"] = 0.0
+    base.update(kw)
+    base["eval_seconds_total"] = (
+        base["eval_seconds_candidate"] + base["eval_seconds_fallback"]
+    )
+    return base
+
+
+def _fake_run():
+    plain, window = "a" * 32, "b" * 32
+    return {
+        "oracle": {"violations": 0, "delivered_changes": 16},
+        "sub_costs": {
+            "enabled": True,
+            "ledger": {
+                "kind": "corro-sub-cost", "version": 1, "enabled": True,
+                "subs_total": 2,
+                "totals": {},
+                "subs": [
+                    {
+                        "sub_id": plain,
+                        "sql": "SELECT id, text FROM tests",
+                        "plan": {"class": "simple", "fallback_bound": False},
+                        "cost": _cost(
+                            candidate_evals=5, rows_scanned=10,
+                            eval_seconds_candidate=0.010, fanout_events=10,
+                        ),
+                    },
+                    {
+                        "sub_id": window,
+                        "sql": "SELECT min(id) OVER () FROM tests",
+                        "plan": {"class": "window", "fallback_bound": True},
+                        "cost": _cost(
+                            fallback_evals=3, rows_scanned=30,
+                            eval_seconds_fallback=0.030, fanout_events=6,
+                        ),
+                    },
+                ],
+            },
+            "groups": {"0": plain, "1": window},
+            "oracle_records": {
+                "streams": [
+                    {"sid": 0, "group": 0, "label": "s0",
+                     "delivered_changes": 10, "delivered_snapshot": 0,
+                     "reconnects": 0},
+                    {"sid": 1, "group": 1, "label": "w0",
+                     "delivered_changes": 6, "delivered_snapshot": 0,
+                     "reconnects": 0},
+                ],
+                "writes": [
+                    {"key": k, "group": g, "t_ack_mono": 100.0 + k}
+                    for g in (0, 1) for k in range(2)
+                ],
+                "deliveries": [
+                    {"kind": "change", "sid": g, "key": k,
+                     "t_mono": 100.0 + k + 0.005}
+                    for g in (0, 1) for k in range(2)
+                ],
+            },
+        },
+    }
+
+
+def test_heatmap_join_attribution_and_reconciliation():
+    from corrosion_tpu.obs import serving
+
+    rep = serving.build_serving_report(_fake_run())
+    assert rep["kind"] == "corro-serving-cost" and rep["streams"] == 2
+    # Fallback share: 30ms of 40ms total eval burn.
+    assert rep["fallback"]["share_of_eval_seconds"] == 0.75
+    assert rep["fallback"]["bound_subs"] == 1
+    assert rep["fallback"]["observed"] is True
+    # Top-K orders by eval cost: the window sub burned 3x the plain one.
+    assert rep["top"][0]["sub_id"] == "b" * 32
+    assert rep["top"][0]["eval_ms"] == 30.0
+    # Per-class lag percentiles from the (key, group) delivery join.
+    assert rep["classes"]["window"]["lag_ms"]["p50"] == pytest.approx(
+        5.0, abs=0.5
+    )
+    # Exact mass reconciliation: ledger fan-out == oracle delivered.
+    assert rep["reconciliation"]["ok"]
+    assert rep["reconciliation"]["checked"] == 2
+
+
+def test_heatmap_join_flags_mass_mismatch():
+    from corrosion_tpu.obs import serving
+
+    run = _fake_run()
+    run["sub_costs"]["oracle_records"]["streams"][1][
+        "delivered_changes"
+    ] = 7
+    rep = serving.build_serving_report(run)
+    assert not rep["reconciliation"]["ok"]
+    assert "7" in rep["reconciliation"]["mismatches"][0]
+
+
+def test_heatmap_refuses_run_without_ledger():
+    """A heatmap without a ledger would silently attribute nothing —
+    the builder refuses instead."""
+    from corrosion_tpu.obs import serving
+
+    with pytest.raises(ValueError, match="sub_costs ledger"):
+        serving.build_serving_report({"oracle": {"violations": 0}})
+    run = _fake_run()
+    run["sub_costs"]["oracle_records"]["streams"] = []
+    with pytest.raises(ValueError, match="stream records"):
+        serving.build_serving_report(run)
+
+
+def test_ledger_jsonl_roundtrip(tmp_path):
+    from corrosion_tpu.obs import serving
+
+    snap = _fake_run()["sub_costs"]["ledger"]
+    path = str(tmp_path / "ledger.jsonl")
+    serving.write_cost_ledger(path, snap, context={"scenario": "t"})
+    back = serving.read_cost_ledger(path)
+    assert back["kind"] == "corro-sub-cost" and back["version"] == 1
+    assert [r["sub_id"] for r in back["subs"]] == \
+           [r["sub_id"] for r in snap["subs"]]
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write(json.dumps({"kind": "corro-metric-series"}) + "\n")
+    with pytest.raises(ValueError, match="kind"):
+        serving.read_cost_ledger(bad)
+
+
+# -- budget gate --------------------------------------------------------------
+
+
+def _measured():
+    from corrosion_tpu.obs import serving
+
+    run = _fake_run()
+    return {
+        "platform": "cpu",
+        "scenario": "t",
+        "streams": 2,
+        "run": run,
+        "serving": serving.build_serving_report(run),
+    }
+
+
+def _budget(**over):
+    return {
+        "platform": "cpu", "scenario": "t", "streams": 2,
+        "tolerance": 1.5,
+        "ceilings_ms": {"serving.eval_ms.total": 100.0},
+        "fallback_share_max": 0.9,
+        "oracle_violations_max": 0,
+        "require_fallback_observed": True,
+        "require_mass_reconciled": True,
+        **over,
+    }
+
+
+def test_budget_gate_green_on_clean_measurement():
+    from corrosion_tpu.obs import serving
+
+    ok, breaches = serving.check_serving_cost_budget(_measured(), _budget())
+    assert ok and breaches == []
+
+
+def test_budget_gate_machinery_fired_rule():
+    """A storm where no fallback-bound subscription was ever observed
+    evaluating is a HARNESS failure, not a pass."""
+    from corrosion_tpu.obs import serving
+
+    m = _measured()
+    m["serving"]["fallback"]["observed"] = False
+    ok, breaches = serving.check_serving_cost_budget(m, _budget())
+    assert not ok
+    assert any("test-harness failure" in b for b in breaches)
+
+
+def test_budget_gate_absolute_rules():
+    from corrosion_tpu.obs import serving
+
+    m = _measured()
+    m["serving"]["reconciliation"]["ok"] = False
+    m["serving"]["reconciliation"]["mismatches"] = ["sub x: 5 != 6"]
+    ok, breaches = serving.check_serving_cost_budget(m, _budget())
+    assert not ok and any("reconciliation" in b for b in breaches)
+
+    m = _measured()
+    m["run"]["oracle"]["violations"] = 2
+    ok, breaches = serving.check_serving_cost_budget(m, _budget())
+    assert not ok and any("oracle violations" in b for b in breaches)
+
+    m = _measured()
+    ok, breaches = serving.check_serving_cost_budget(
+        m, _budget(fallback_share_max=0.5)
+    )
+    assert not ok and any("fallback share" in b for b in breaches)
+
+    m = _measured()
+    ok, breaches = serving.check_serving_cost_budget(
+        m, _budget(streams=512)
+    )
+    assert not ok and any("streams" in b for b in breaches)
+
+
+def test_baseline_diff_regression():
+    from corrosion_tpu.obs import serving
+
+    base = _measured()["serving"]
+    cand = json.loads(json.dumps(base))
+    ok, rows = serving.diff_serving_reports(base, cand)
+    assert ok
+    cand["eval_ms"]["total"] = base["eval_ms"]["total"] * 10 + 100.0
+    ok, rows = serving.diff_serving_reports(base, cand)
+    assert not ok
+    bad = [r for r in rows if not r["ok"]]
+    assert bad and bad[0]["path"] == "eval_ms.total"
+
+
+# -- live agent: endpoint, replay, kill/relaunch ------------------------------
+
+
+def test_subs_costs_endpoint(tmp_path):
+    """`GET /v1/subs/costs` serves the live corro-sub-cost/1 snapshot;
+    bad top= values are a 400, not a 500."""
+    from corrosion_tpu.agent.testing import launch_test_agent, poll_until
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"), sub_costs=True)
+        try:
+            assert a.agent.subs.costs_enabled
+            h = a.agent.subs.subscribe(
+                "SELECT id, text FROM tests WHERE id % 2 = 0"
+            )
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (2, 'x')"]]
+            )
+
+            async def seen():
+                return a.agent.subs.get(h.id).change_id >= 1
+
+            await poll_until(seen, timeout=10)
+            resp = await a.client._request("GET", "/v1/subs/costs?top=5")
+            body = await resp.body()
+            resp.close()
+            assert resp.status == 200
+            snap = json.loads(body)
+            assert snap["kind"] == "corro-sub-cost" and snap["enabled"]
+            rec = next(r for r in snap["subs"] if r["sub_id"] == h.id)
+            assert rec["plan"]["class"] == "simple"
+            assert rec["cost"]["candidate_evals"] >= 1
+            resp = await a.client._request("GET", "/v1/subs/costs?top=zap")
+            await resp.body()
+            resp.close()
+            assert resp.status == 400
+            # The aggregates ride /metrics with the kind label.
+            text = a.agent.metrics.render()
+            assert "corro_subs_eval_seconds" in text
+            assert 'kind="candidate"' in text
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_ledger_survives_reconnect_replay(tmp_path):
+    """A ``?from=`` resume books its replayed rows into the ledger
+    (replay mass is part of the reconciliation identity) and the
+    counters accumulated before the reconnect survive it."""
+    from corrosion_tpu.agent.testing import launch_test_agent, poll_until
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"), sub_costs=True)
+        stream = None
+        try:
+            stream = await a.client.subscribe("SELECT id, text FROM tests")
+            async for ev in stream:
+                if "eoq" in ev:
+                    break
+            await a.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'one')"]]
+            )
+            ev = await stream.__anext__()
+            assert "change" in ev
+            h = a.agent.subs.get(stream.sub_id)
+            pre = h.cost.snapshot()
+            assert pre["fanout_events"] >= 1
+            # Force a full replay: pretend we saw nothing.
+            stream.last_change_id = 0
+            await stream.reconnect()
+            # The resume re-emits columns first, then the replayed change.
+            async for ev in stream:
+                if "change" in ev:
+                    break
+            else:
+                raise AssertionError("no change replayed after reconnect")
+
+            async def replayed():
+                return h.cost.replay_rows >= 1
+
+            await poll_until(replayed, timeout=10)
+            post = h.cost.snapshot()
+            assert post["replays"] >= 1
+            assert post["fanout_events"] >= pre["fanout_events"]
+            assert post["candidate_evals"] >= pre["candidate_evals"]
+        finally:
+            if stream is not None:
+                stream.close()
+            await a.stop()
+
+    run(main())
+
+
+def test_ledger_survives_kill_relaunch(tmp_path):
+    """SIGKILL + relaunch adopts the persisted ledger: counters resume
+    from what the previous life last persisted instead of zeroing (the
+    hostchaos kill_restart scenario proves the same contract under
+    storm traffic)."""
+    from corrosion_tpu.agent.testing import (
+        hard_kill,
+        launch_test_agent,
+        poll_until,
+        relaunch_test_agent,
+    )
+
+    async def main():
+        a = await launch_test_agent(str(tmp_path / "a"), sub_costs=True)
+        b = None
+        try:
+            h = a.agent.subs.subscribe("SELECT id, text FROM tests")
+            sub_id = h.id
+            for i in range(3):
+                await a.client.execute(
+                    [[f"INSERT INTO tests (id, text) VALUES ({i}, 'r{i}')"]]
+                )
+
+            async def seen():
+                return a.agent.subs.get(sub_id).change_id >= 3
+
+            await poll_until(seen, timeout=10)
+            pre = a.agent.subs.get(sub_id).cost.snapshot()
+            assert pre["candidate_evals"] >= 1
+            await hard_kill(a)
+            b = await relaunch_test_agent(a)
+            restored = b.agent.subs.get(sub_id)
+            assert restored is not None and restored.cost is not None
+            post = restored.cost.snapshot()
+            # The relaunch re-adopts (>=: restore itself may process a
+            # catch-up diff on top of the adopted counters).
+            for k in ("candidate_evals", "rows_scanned", "diff_rows"):
+                assert post[k] >= pre[k], (k, pre[k], post[k])
+            # And keeps accumulating in the new life.
+            await b.client.execute(
+                [["INSERT INTO tests (id, text) VALUES (99, 'new')"]]
+            )
+
+            async def advanced():
+                c = b.agent.subs.get(sub_id).cost
+                return c.candidate_evals > post["candidate_evals"]
+
+            await poll_until(advanced, timeout=10)
+        finally:
+            if b is not None:
+                await b.stop()
+            elif a is not None:
+                await a.stop()
+
+    run(main())
