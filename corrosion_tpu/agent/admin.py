@@ -37,8 +37,10 @@ async def start_admin(agent: "Agent", uds_path: str) -> asyncio.AbstractServer:
                     raise
                 except Exception as e:  # command failed: report, stay up
                     await session.send({"error": str(e), "done": True})
-        except (ConnectionError, asyncio.CancelledError):
+        except ConnectionError:
             pass
+        except asyncio.CancelledError:
+            raise  # server shutdown: cleanup runs, cancellation flows
         finally:
             session.close()
 

@@ -432,7 +432,7 @@ class Agent:
         # rejoin): the own-incarnation row persisted at shutdown seeds the
         # next life one higher, so ALIVE@n+1 beats any durable DOWN@n a
         # graceful leave taught the cluster.
-        row = self.store.conn.execute(
+        row = self.store.conn.execute(  # corro-lint: disable=CT042 reason=boot path; the loop serves no sessions until start() returns
             "SELECT incarnation FROM __corro_members WHERE actor_id = ?",
             (self.actor_id,),
         ).fetchone()
@@ -459,6 +459,10 @@ class Agent:
         # gone. The failure detector prunes any that died while we were
         # down.
         self._members_persisted: dict[str, tuple] = {}
+        # Serializes diff-persist passes: stop()'s final pass can run
+        # concurrently with the loop's, and an interleaved snapshot swap
+        # would regress _members_persisted behind rows already written.
+        self._members_persist_lock = asyncio.Lock()
         restored_members = self._load_members()
         for m in restored_members[:10]:
             await self.swim.announce(m.addr)
@@ -777,7 +781,7 @@ class Agent:
         # snapshot makes all rows "changed"), or member persistence would
         # die silently until the next full restart.
         with self.store._wlock("members_reinit"):
-            self.store.conn.execute(
+            self.store.conn.execute(  # corro-lint: disable=CT042 reason=rare admin-driven restore; one DDL statement under the writer lock
                 "CREATE TABLE IF NOT EXISTS __corro_members ("
                 " actor_id TEXT PRIMARY KEY, addr TEXT NOT NULL,"
                 " state TEXT NOT NULL, incarnation INTEGER NOT NULL,"
@@ -1192,7 +1196,7 @@ class Agent:
         # SELECT over the buffer tables), not from the full cleared
         # history: steady-state cost scales with outstanding orphans —
         # normally zero rows — not with how much was ever compacted.
-        present = self.store.conn.execute(
+        present = self.store.conn.execute(  # corro-lint: disable=CT042 reason=indexed read over normally-zero orphan rows; an executor round-trip costs more than the scan
             "SELECT actor_id, version FROM __corro_seq_bookkeeping"
             " UNION SELECT DISTINCT actor_id, version"
             " FROM __corro_buffered_changes"
@@ -1421,50 +1425,53 @@ class Agent:
         """One diff-persist pass: only rows whose (addr, state,
         incarnation) moved are written; members GC'd from the in-memory
         table are deleted."""
-        current = {
-            aid: (f"{m.addr[0]}:{m.addr[1]}", m.state, m.incarnation)
-            for aid, m in self.members.states.items()
-        }
-        if self.swim is not None and self.gossip_addr is not None:
-            # Own-incarnation row: seeds identity freshness at the next
-            # boot (see start()); state ALIVE so the load-time DOWN purge
-            # never eats it.
-            from corrosion_tpu.agent.membership import ALIVE
+        async with self._members_persist_lock:
+            current = {
+                aid: (f"{m.addr[0]}:{m.addr[1]}", m.state, m.incarnation)
+                for aid, m in self.members.states.items()
+            }
+            if self.swim is not None and self.gossip_addr is not None:
+                # Own-incarnation row: seeds identity freshness at the
+                # next boot (see start()); state ALIVE so the load-time
+                # DOWN purge never eats it.
+                from corrosion_tpu.agent.membership import ALIVE
 
-            current[self.actor_id] = (
-                f"{self.gossip_addr[0]}:{self.gossip_addr[1]}",
-                ALIVE,
-                self.swim.incarnation,
-            )
-        changed = [
-            (aid, v) for aid, v in current.items()
-            if self._members_persisted.get(aid) != v
-        ]
-        gone = [aid for aid in self._members_persisted if aid not in current]
-        if not changed and not gone:
-            return
-        now = time.time()
-
-        def db_work() -> None:
-            with self.store._wlock("members_persist"):
-                self.store.conn.executemany(
-                    "INSERT OR REPLACE INTO __corro_members"
-                    " VALUES (?, ?, ?, ?, ?)",
-                    [
-                        (aid, addr, state, inc, now)
-                        for aid, (addr, state, inc) in changed
-                    ],
+                current[self.actor_id] = (
+                    f"{self.gossip_addr[0]}:{self.gossip_addr[1]}",
+                    ALIVE,
+                    self.swim.incarnation,
                 )
-                self.store.conn.executemany(
-                    "DELETE FROM __corro_members WHERE actor_id = ?",
-                    [(aid,) for aid in gone],
-                )
+            changed = [
+                (aid, v) for aid, v in current.items()
+                if self._members_persisted.get(aid) != v
+            ]
+            gone = [
+                aid for aid in self._members_persisted if aid not in current
+            ]
+            if not changed and not gone:
+                return
+            now = time.time()
 
-        if self.pool is not None:
-            await self.pool.write_low(db_work)
-        else:
-            db_work()
-        self._members_persisted = current
+            def db_work() -> None:
+                with self.store._wlock("members_persist"):
+                    self.store.conn.executemany(
+                        "INSERT OR REPLACE INTO __corro_members"
+                        " VALUES (?, ?, ?, ?, ?)",
+                        [
+                            (aid, addr, state, inc, now)
+                            for aid, (addr, state, inc) in changed
+                        ],
+                    )
+                    self.store.conn.executemany(
+                        "DELETE FROM __corro_members WHERE actor_id = ?",
+                        [(aid,) for aid in gone],
+                    )
+
+            if self.pool is not None:
+                await self.pool.write_low(db_work)
+            else:
+                db_work()
+            self._members_persisted = current
 
     async def _members_persist_loop(self) -> None:
         """Persist member-state diffs on a cadence (diff_member_states,
@@ -1925,7 +1932,7 @@ class Agent:
                 # thread may hold an open BEGIN IMMEDIATE on store.conn,
                 # and this read runs on the event loop — same discipline
                 # as changes_for.
-                rows = self.store.read_conn.execute(
+                rows = self.store.read_conn.execute(  # corro-lint: disable=CT042 reason=WAL read connection off the writer; bounded rows per need frame (changes_for discipline)
                     "SELECT tbl, pk, cid, val, col_version, db_version,"
                     " seq, site_id, cl FROM __corro_buffered_changes"
                     " WHERE actor_id = ? AND version = ? ORDER BY seq",

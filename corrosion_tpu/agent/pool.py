@@ -162,6 +162,7 @@ class SplitPool:
     async def write_low(self, fn: Callable[[], Any]) -> Any:
         return await self.write(fn, LOW)
 
+    # corro-lint: disable=CT040 reason=single writer-loop task owns _current; close() only reads it to fail the in-flight future
     async def _writer_loop(self) -> None:
         while not self._closed:
             job = self._pop()

@@ -339,6 +339,10 @@ class Transport:
         breaker_max_s: float = BREAKER_MAX_S,
     ) -> None:
         self._pool: dict[tuple[str, int], tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
+        # Per-addr dial serialization: two tasks missing the pool at
+        # once must not both dial — the loser's socket would be
+        # overwritten in the pool and leak (never closed by _drop).
+        self._dial_locks: dict[tuple[str, int], asyncio.Lock] = {}
         self._locks: dict[tuple[str, int], asyncio.Lock] = {}
         self._breakers: dict[tuple[str, int], Breaker] = {}
         # ACCEPTED connections, tracked so close() kills them too. An
@@ -599,13 +603,15 @@ class Transport:
     async def _conn(self, addr, fresh=False):
         if fresh:
             self._drop(addr)
-        if addr not in self._pool:
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(*addr, ssl=self._ssl_client),
-                self.connect_timeout,
-            )
-            self._pool[addr] = (reader, writer)
-        return self._pool[addr]
+        lock = self._dial_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            if addr not in self._pool:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(*addr, ssl=self._ssl_client),
+                    self.connect_timeout,
+                )
+                self._pool[addr] = (reader, writer)
+            return self._pool[addr]
 
     def _drop(self, addr) -> None:
         pair = self._pool.pop(addr, None)
@@ -646,8 +652,10 @@ class Transport:
                         break
                     self._count("frames_recv")
                     await handler(session, msg)
-            except (ConnectionError, asyncio.CancelledError):
+            except ConnectionError:
                 pass
+            except asyncio.CancelledError:
+                raise  # server shutdown: cleanup runs, cancellation flows
             except ValueError:
                 pass  # malformed frame: drop the connection cleanly
             finally:
@@ -681,7 +689,7 @@ class Transport:
                 # recv-only gossip socket behind (or leak it past close()).
                 if self._udp is not None:
                     self._udp.close()
-                self._udp = None
+                self._udp = None  # corro-lint: disable=CT040 reason=serve() runs once at startup; the OSError unwind must null the shared handle it just closed
                 for t in self._client_udp:
                     t.close()
                 self._client_udp = []

@@ -3,7 +3,7 @@ lints (`corrosion lint`), plus the runtime retrace/dtype sanitizer.
 
 The telemetry plane (sim/telemetry.py) and the convergence-health plane
 (sim/health.py) observe what the kernels *do*; this package guards the
-code that produces those numbers. Three pillars, each a module:
+code that produces those numbers. Six pillars, each a module:
 
 - ``purity``: AST lints over the kernel modules (``ops/`` and the
   ``sim/*engine*.py`` scan bodies) for host-trip and dtype-promotion
@@ -13,8 +13,18 @@ code that produces those numbers. Three pillars, each a module:
   turning the runtime parity test into a compile-time check.
 - ``concurrency``: blocking calls under held locks and lock-acquisition-
   order cycles in the host agent plane.
+- ``asynclint``: asyncio race & lifecycle lints over the agent plane
+  (CT040-CT043) — await-straddled state writes, fire-and-forget tasks,
+  blocking calls on the event loop, swallowed CancelledError.
+- ``clonemap``: the engine-clone drift gate (CT050-CT052) — the
+  committed ``SEAM_MAP.json`` declares which function pairs across the
+  four sim engines are intentional clones and where they legitimately
+  differ; drift outside declared seams fails the lint.
+- ``determinism``: determinism-taint lints (CT060-CT062) — wall clock/
+  RNG/hash-order sources in traced code, the netem/fault schedule
+  planes, and ``corro-*/N`` artifact emit sites.
 
-``runner.lint_paths`` orchestrates the three over a file tree;
+``runner.lint_paths`` orchestrates all of them over a file tree;
 ``sanitize.sanitize_engines`` is the runtime companion (strict dtype
 promotion + debug_nans + a one-trace-per-engine retrace tripwire). Rule
 ids, rationale, and the ``# corro-lint: disable=CT0xx reason=...``

@@ -19,6 +19,12 @@ RULES: dict[str, tuple[str, str]] = {
         "corro-lint suppression without a reason= string or naming an "
         "unknown rule id",
     ),
+    "CT009": (
+        "stale-suppression",
+        "a corro-lint suppression whose rule no longer fires on its "
+        "line/scope — delete it so the suppression inventory can't rot "
+        "(non-gating; listed under --show-suppressed)",
+    ),
     "CT001": (
         "numpy-in-traced-code",
         "numpy (np.*) usage inside a traced kernel function — a host "
@@ -59,6 +65,71 @@ RULES: dict[str, tuple[str, str]] = {
     "CT021": (
         "lock-order-cycle",
         "cycle in the lock-acquisition-order graph — a latent deadlock",
+    ),
+    "CT040": (
+        "await-straddled-state-write",
+        "an async method reads a shared `self` attribute, suspends at an "
+        "await, then writes it back without holding the guarding lock — "
+        "a concurrent task can interleave at the await and the write "
+        "clobbers its update (the PR-14 wedge-bug shape)",
+    ),
+    "CT041": (
+        "fire-and-forget-task",
+        "create_task/ensure_future whose result is neither stored, "
+        "awaited, nor given add_done_callback — the task can die "
+        "silently (exceptions vanish) or be garbage-collected mid-run",
+    ),
+    "CT042": (
+        "blocking-call-in-async",
+        "blocking call (sleep/subprocess/socket dial/sync sqlite/file "
+        "open) lexically inside an `async def` — stalls the event loop "
+        "for the call's wall time; every session on the loop waits",
+    ),
+    "CT043": (
+        "cancellederror-swallowed",
+        "an except handler in an `async def` catches "
+        "asyncio.CancelledError (directly, bare, or via BaseException) "
+        "without re-raising — cancellation is absorbed and "
+        "shutdown/timeouts wedge",
+    ),
+    "CT050": (
+        "engine-clone-drift",
+        "an intentional engine-clone pair declared in SEAM_MAP.json "
+        "diverges outside its declared seams — the four-copy round "
+        "stanza drifted (the bug class CT010/parity runtime tests exist "
+        "to catch after the fact)",
+    ),
+    "CT051": (
+        "seam-map-function-missing",
+        "a function mapped in SEAM_MAP.json no longer exists — update "
+        "the map (deleting entries is the ROADMAP item-4 progress "
+        "metric, but it must be deliberate)",
+    ),
+    "CT052": (
+        "partial-plane-coverage",
+        "a canonical round-curve key is emitted by some but not all "
+        "four engines and carries no seam-map waiver — a new per-round "
+        "plane was threaded through fewer than four copies",
+    ),
+    "CT060": (
+        "nondeterminism-in-traced-code",
+        "wall clock/random/uuid/os.urandom or set-order iteration "
+        "inside a traced kernel function — the value is baked at trace "
+        "time and differs per process, breaking replay and retrace "
+        "stability",
+    ),
+    "CT061": (
+        "nondeterminism-in-schedule-module",
+        "nondeterministic source in a deterministic-schedule module "
+        "(agent/netem.py, sim/faults.py) — impairment and fault "
+        "schedules must be pure functions of seed+coordinates or exact "
+        "replay breaks",
+    ),
+    "CT062": (
+        "nondeterminism-at-artifact-emit",
+        "nondeterministic source in a function that emits a "
+        "`corro-*/N` artifact — committed artifacts must be "
+        "byte-deterministic for baseline diff gates to mean anything",
     ),
     "CT030": (
         "retrace-tripwire",
@@ -109,6 +180,7 @@ class LintResult:
 
     findings: list[Finding] = field(default_factory=list)
     suppressed: list[Finding] = field(default_factory=list)
+    stale: list[Finding] = field(default_factory=list)  # CT009, non-gating
     files: int = 0
     engines: dict[str, list[str]] = field(default_factory=dict)
     canonical_keys: tuple[str, ...] = ()
@@ -124,6 +196,7 @@ class LintResult:
             "files": self.files,
             "findings": [asdict(f) for f in self.findings],
             "suppressed": [asdict(f) for f in self.suppressed],
+            "stale_suppressions": [asdict(f) for f in self.stale],
             "engines": self.engines,
             "canonical_keys": list(self.canonical_keys),
             "rules": {k: {"title": t, "why": w} for k, (t, w) in RULES.items()},
@@ -139,8 +212,11 @@ class LintResult:
                 lines.append(
                     f"{f.render()}  (suppressed: {f.suppress_reason})"
                 )
+            for f in self.stale:
+                lines.append(f"{f.render()}  (non-gating)")
         lines.append(
             f"{len(self.findings)} finding(s), "
-            f"{len(self.suppressed)} suppressed, {self.files} file(s)"
+            f"{len(self.suppressed)} suppressed, "
+            f"{len(self.stale)} stale suppression(s), {self.files} file(s)"
         )
         return "\n".join(lines)

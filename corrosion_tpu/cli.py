@@ -484,8 +484,19 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="skip the static rules (with --sanitize: "
                     "sanitizer only)")
     ln.add_argument("--show-suppressed", action="store_true",
-                    help="list reason-suppressed findings in text output "
-                    "(JSON always carries them)")
+                    help="list reason-suppressed findings and stale "
+                    "(CT009) suppressions in text output (JSON always "
+                    "carries them)")
+    ln.add_argument("--changed", nargs="?", const="HEAD~1", default=None,
+                    metavar="REF",
+                    help="lint only files changed vs a git ref "
+                    "(default HEAD~1) — fast local/pre-push runs; exit "
+                    "codes unchanged")
+    ln.add_argument("--update-seams", action="store_true",
+                    help="regenerate analysis/SEAM_MAP.json seam "
+                    "fragments from the live engine diff (keeps whys of "
+                    "seams that still match; fill in the TODO whys "
+                    "before committing)")
     ln.add_argument("--list-rules", action="store_true")
 
     # Serving-plane load subsystem (corrosion_tpu/loadgen, docs/SERVING.md):
@@ -830,11 +841,37 @@ def _lint(args) -> int:
         if unknown:
             print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
             return 2
+    if args.update_seams:
+        from corrosion_tpu.analysis import clonemap
+        from corrosion_tpu.analysis.runner import default_seam_root
+
+        map_path = clonemap.default_seam_map_path()
+        try:
+            smap = clonemap.load_seam_map(map_path)
+        except (OSError, ValueError) as e:
+            print(f"seam map: {e}", file=sys.stderr)
+            return 2
+        refreshed, fresh = clonemap.refresh_seams(smap, default_seam_root())
+        clonemap.save_seam_map(refreshed, map_path)
+        print(f"{map_path}: seams regenerated, {fresh} new seam(s) need "
+              "a why filled in" if fresh else
+              f"{map_path}: seams regenerated, all declared whys kept")
+        return 0
     paths = args.paths or [os.path.dirname(os.path.abspath(__file__))]
+    only = None
+    if args.changed is not None:
+        from corrosion_tpu.analysis.runner import changed_files
+
+        try:
+            only = changed_files(args.changed, cwd=paths[0]
+                                 if os.path.isdir(paths[0]) else None)
+        except RuntimeError as e:
+            print(f"--changed: {e}", file=sys.stderr)
+            return 2
     if args.no_static:
         result = LintResult()
     else:
-        result = lint_paths(paths, rules=rules)
+        result = lint_paths(paths, rules=rules, only=only)
     if args.sanitize:
         from corrosion_tpu.analysis.sanitize import ENGINES, sanitize_engines
 

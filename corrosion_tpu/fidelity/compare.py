@@ -145,11 +145,15 @@ class VisibilityWatcher:
     async def stop(self) -> None:
         if self.stream is not None:
             self.stream.close()
-        if self._task is not None:
+        task, self._task = self._task, None
+        if task is not None:
             try:
-                await asyncio.wait_for(self._task, 5.0)
-            except (asyncio.TimeoutError, asyncio.CancelledError):
-                pass
+                await asyncio.wait_for(task, 5.0)
+            except asyncio.TimeoutError:
+                pass  # wait_for already cancelled the watcher task
+            except asyncio.CancelledError:
+                task.cancel()
+                raise  # we were cancelled: propagate, don't absorb
 
 
 async def run_live_workload(

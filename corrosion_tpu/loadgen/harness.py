@@ -282,7 +282,7 @@ class SubscriptionPump:
                 self.dead_reason = repr(e)
                 await asyncio.sleep(self.reconnect_delay_s)
                 continue
-            self.dead_reason = None
+            self.dead_reason = None  # corro-lint: disable=CT040 reason=single pump task owns dead_reason; it is report status, not control state
             self.oracle.reconnected(self.sid)
             return True
         return False
@@ -299,12 +299,19 @@ class SubscriptionPump:
 
     async def stop(self) -> None:
         self.request_stop()
-        if self._task is not None:
+        # Capture-and-swap before awaiting: a concurrent stop() (final
+        # teardown racing a scenario's own stop) must not null _task
+        # under the first caller's await — `self._task.cancel()` would
+        # then be `None.cancel()`.
+        task, self._task = self._task, None
+        if task is not None:
             try:
-                await asyncio.wait_for(self._task, 5.0)
-            except (asyncio.TimeoutError, asyncio.CancelledError):
-                self._task.cancel()
-        self._task = None
+                await asyncio.wait_for(task, 5.0)
+            except asyncio.TimeoutError:
+                task.cancel()
+            except asyncio.CancelledError:
+                task.cancel()
+                raise  # we were cancelled: propagate, don't absorb
 
 
 async def stop_pumps(pumps: list["SubscriptionPump"]) -> None:
