@@ -464,6 +464,41 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     hcp.add_argument("report", help="hostchaos run report JSON path")
 
+    # Elastic survival plane (corrosion_tpu/elastic, docs/SCALING.md
+    # "Elastic ops"): live mesh resharding + device-shard preemption,
+    # convergence pinned bit-identical.
+    el = add("elastic", help="elastic survival plane: live mesh reshard "
+             "+ device-shard preemption, pinned bit-identical")
+    el_sub = el.add_subparsers(dest="elastic_cmd", required=True)
+
+    ell = el_sub.add_parser(
+        "list", parents=[common], help="list the standing elastic drills"
+    )
+    ell.add_argument("--json", action="store_true")
+
+    elr = el_sub.add_parser(
+        "run", parents=[common],
+        help="run one elastic drill (elastic list); exit 1 on any "
+        "divergence, oracle violation, or idle recovery machinery",
+    )
+    elr.add_argument("scenario", help="drill name (elastic list)")
+    elr.add_argument("--seed", type=int, default=0)
+    elr.add_argument("--checkpoint-dir", default=None,
+                     help="round-trip checkpoints through disk here "
+                     "(default: in-memory only)")
+    elr.add_argument("--out", default=None, help="report JSON path")
+    elr.add_argument("--json", action="store_true")
+
+    elm = el_sub.add_parser(
+        "matrix", parents=[common],
+        help="run the full dense reshard matrix "
+        "(4→8, 8→4, 8→2, 1→8) plus one drill per "
+        "other engine",
+    )
+    elm.add_argument("--seed", type=int, default=0)
+    elm.add_argument("--out", default=None, help="report JSON path")
+    elm.add_argument("--json", action="store_true")
+
     # Static-analysis plane (corrosion_tpu/analysis, docs/ANALYSIS.md):
     # kernel-purity + schema-parity + concurrency lints, and the
     # strict-dtype/debug-nans/retrace sanitizer.
@@ -659,6 +694,8 @@ async def _dispatch(args, cfg: Config) -> int:
         return _chaos(args)
     if args.command == "hostchaos":
         return await _hostchaos(args)
+    if args.command == "elastic":
+        return _elastic(args)
     if args.command == "loadgen":
         return await _loadgen(args)
     if args.command == "fidelity":
@@ -819,6 +856,90 @@ async def _hostchaos(args) -> int:
         for p in problems:
             print(f"  {p}", file=sys.stderr)
         return 1
+    return 2
+
+
+def _elastic(args) -> int:
+    """`corrosion elastic {list,run,matrix}` — the elastic survival
+    plane (docs/SCALING.md "Elastic ops"). Exit 0 = every drill pinned
+    bit-identical with its oracles green, 1 = divergence / oracle
+    violation / idle recovery machinery, 2 = usage."""
+    from corrosion_tpu.elastic import scenarios as el_scenarios
+
+    def _summary(rep: dict) -> str:
+        extra = ""
+        if rep.get("machinery") is not None:
+            m = rep["machinery"]
+            extra = (
+                f", machinery fired={m['fired']} "
+                f"(replayed {m['gap_rounds_replayed']} rounds)"
+            )
+        return (
+            f"{rep['scenario']}: {'OK' if rep['ok'] else 'FAILED'} — "
+            f"bit_identical={rep['bit_identical']}, "
+            f"reconcile={'ok' if (rep.get('reconcile') or {}).get('ok') else 'FAILED'}, "
+            f"violations={len(rep.get('violations') or [])}{extra}"
+        )
+
+    if args.elastic_cmd == "list":
+        names = el_scenarios.scenario_names()
+        if args.json:
+            print(json.dumps(names, indent=1))
+        else:
+            for n in names:
+                print(n)
+        return 0
+
+    if args.elastic_cmd == "run":
+        try:
+            rep = el_scenarios.run_scenario(
+                args.scenario, seed=args.seed,
+                checkpoint_dir=args.checkpoint_dir,
+            )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(rep, f, indent=1, default=str)
+            print(f"wrote {args.out}", file=sys.stderr)
+        if args.json:
+            print(json.dumps(rep, indent=1, default=str))
+        else:
+            print(_summary(rep))
+            for m in rep.get("mismatches") or []:
+                print(f"  DIVERGED: {m}")
+            for v in rep.get("violations") or []:
+                print(f"  FAIL: {v}")
+        return 0 if rep["ok"] else 1
+
+    if args.elastic_cmd == "matrix":
+        reps = []
+        for a, b in el_scenarios.RESHARD_MATRIX:
+            reps.append(el_scenarios.run_reshard_scenario(
+                "dense", a, b, seed=args.seed
+            ))
+        for eng in el_scenarios.RESHARD_ENGINES:
+            if eng != "dense":
+                reps.append(el_scenarios.run_reshard_scenario(
+                    eng, 4, 8, seed=args.seed
+                ))
+        out = {
+            "schema": el_scenarios.ELASTIC_SCHEMA,
+            "kind": "matrix",
+            "scenarios": reps,
+            "ok": all(r["ok"] for r in reps),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1, default=str)
+            print(f"wrote {args.out}", file=sys.stderr)
+        if args.json:
+            print(json.dumps(out, indent=1, default=str))
+        else:
+            for r in reps:
+                print(_summary(r))
+        return 0 if out["ok"] else 1
     return 2
 
 

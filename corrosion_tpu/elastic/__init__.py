@@ -1,0 +1,74 @@
+"""Elastic survival plane: live mesh resharding and device-shard
+preemption, pinned convergence-equivalent.
+
+- :mod:`corrosion_tpu.elastic.reshard` — checkpoint → re-place on a
+  different virtual mesh → resume, with byte-exact
+  ``predicted_per_device_bytes`` reconcile before every resume.
+- :mod:`corrosion_tpu.elastic.preempt` — hard device-shard kill
+  (``Agent.abort`` semantics) + checkpoint/replay recovery with the
+  machinery-fired rule.
+- :mod:`corrosion_tpu.elastic.scenarios` — the named drill catalog
+  (reshard matrix, preempt_dense_churn, soak_preempt).
+- :mod:`corrosion_tpu.elastic.report` — bit-exact diff helpers and the
+  bench_budget.json ``elastic`` gate.
+
+Everything runs on the virtual CPU mesh (``JAX_PLATFORMS=cpu`` with
+``--xla_force_host_platform_device_count=8``); the convergence contract
+is bit-identity, never tolerance. See docs/SCALING.md "Elastic ops".
+"""
+
+from corrosion_tpu.elastic.preempt import (
+    PreemptRun,
+    RecoveryCounters,
+    poison_lost_shard,
+    run_dense_preempted,
+)
+from corrosion_tpu.elastic.report import (
+    ELASTIC_SCHEMA,
+    check_elastic_budget,
+    diff_curves,
+    diff_trees,
+)
+from corrosion_tpu.elastic.reshard import (
+    ReshardRun,
+    place_reconciled,
+    run_chunks_resharded,
+    run_dense_resharded,
+    run_mixed_resharded,
+    run_sparse_resharded,
+    schedule_slice,
+    virtual_mesh,
+)
+from corrosion_tpu.elastic.scenarios import (
+    RESHARD_MATRIX,
+    run_preempt_scenario,
+    run_reshard_scenario,
+    run_scenario,
+    run_soak_preempt_scenario,
+    scenario_names,
+)
+
+__all__ = [
+    "ELASTIC_SCHEMA",
+    "PreemptRun",
+    "RecoveryCounters",
+    "ReshardRun",
+    "RESHARD_MATRIX",
+    "check_elastic_budget",
+    "diff_curves",
+    "diff_trees",
+    "place_reconciled",
+    "poison_lost_shard",
+    "run_chunks_resharded",
+    "run_dense_preempted",
+    "run_dense_resharded",
+    "run_mixed_resharded",
+    "run_preempt_scenario",
+    "run_reshard_scenario",
+    "run_scenario",
+    "run_soak_preempt_scenario",
+    "run_sparse_resharded",
+    "schedule_slice",
+    "scenario_names",
+    "virtual_mesh",
+]

@@ -1,0 +1,295 @@
+"""Device-shard preemption: kill a shard's state mid-run, recover from
+the last checkpoint, prove nothing was lost.
+
+The fault model mirrors ``Agent.abort`` crash semantics (agent.rs): the
+preempted device gets NO graceful drain — its block of every sharded
+leaf is destroyed at the event round, full stop. Recovery is the only
+path back: re-materialize the lost shard from the most recent
+checkpoint and replay the gap rounds. The harness makes the kill real
+(the poisoned state is materialized and diffed against the live one —
+a "preemption" that changes no bytes is a harness bug) and the recovery
+honest (the replayed gap's round curves must be bit-identical to the
+originals; deterministic replay is the whole basis of the scheme).
+
+Preempt events live on the fault plane (sim/faults.py ``preempt`` kind)
+but execute HERE, host-side: ``FaultPlan.compile`` skips them (nothing
+about the kernel changes when a host dies), ``FaultPlan.kernel_plan()``
+strips them from what the engines see, and ``preempt_events()`` is this
+driver's worklist. Scenario-level oracles — CRDT serial-merge
+agreement, bookkeeping contiguity, incarnation monotonicity, and final
+bit-identity against the uninterrupted same-seed run — live in
+elastic/scenarios.py; the machinery-fired rule (a passing scenario with
+idle recovery counters is a harness failure) keys off RecoveryCounters.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from corrosion_tpu.elastic.reshard import (
+    _ckpt_path,
+    mesh_dims,
+    place_reconciled,
+    schedule_slice,
+)
+from corrosion_tpu.parallel import mesh as mesh_mod
+from corrosion_tpu.parallel import shard_driver
+from corrosion_tpu.sim import checkpoint as checkpoint_mod
+
+
+@dataclass
+class RecoveryCounters:
+    """Did the recovery machinery actually run? A preemption scenario
+    that passes with these at zero proves nothing — the machinery-fired
+    rule (obs/endurance.py precedent) turns that into a failure."""
+
+    preempts_fired: int = 0
+    checkpoint_loads: int = 0
+    shards_rematerialized: int = 0
+    gap_rounds_replayed: int = 0
+
+    def fired(self) -> bool:
+        return (
+            self.preempts_fired > 0
+            and self.checkpoint_loads > 0
+            and self.shards_rematerialized > 0
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "preempts_fired": self.preempts_fired,
+            "checkpoint_loads": self.checkpoint_loads,
+            "shards_rematerialized": self.shards_rematerialized,
+            "gap_rounds_replayed": self.gap_rounds_replayed,
+            "fired": self.fired(),
+        }
+
+
+def _garbage(dtype):
+    dt = np.dtype(dtype)
+    if dt.kind == "b":
+        return True
+    if dt.kind in "iu":
+        return np.iinfo(dt).max
+    return np.nan
+
+
+def poison_lost_shard(host_tree, specs, mesh, device_index: int):
+    """Destroy device ``device_index``'s block of every sharded leaf in
+    a host copy of the state — dtype-extreme garbage (True / int max /
+    NaN), no drain. Replicated leaves survive (the other replicas still
+    hold them — exactly why writer heads and slot metadata replicate).
+    Returns ``(poisoned_tree, n_leaves_poisoned)``.
+
+    The block↔device mapping relies on the repo-wide invariant that
+    every sharded leaf splits ONE dim by the full device count (the
+    node-major row blocks of mesh.py's spec builders), so block ``i``
+    in C-order is device ``i`` in ``mesh.devices``. Anything fancier is
+    refused rather than silently mis-poisoned."""
+    d = int(mesh.devices.size)
+    if not 0 <= device_index < d:
+        raise ValueError(f"device {device_index} outside mesh of {d}")
+    leaves, treedef = jax.tree.flatten(host_tree)
+    spec_leaves = jax.tree.flatten(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    out, poisoned = [], 0
+    for arr, spec in zip(leaves, spec_leaves):
+        arr = np.array(arr)
+        sharded = []
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                continue
+            f = mesh_mod.spec_shard_factor(P(entry), mesh)
+            if f > 1:
+                sharded.append((dim, f))
+        if not sharded:
+            out.append(arr)
+            continue
+        if len(sharded) != 1 or sharded[0][1] != d:
+            raise NotImplementedError(
+                f"poison_lost_shard only handles one dim split {d} ways; "
+                f"got {spec} on {mesh_dims(mesh)}"
+            )
+        dim, f = sharded[0]
+        block = arr.shape[dim] // f
+        sl = [slice(None)] * arr.ndim
+        sl[dim] = slice(device_index * block, (device_index + 1) * block)
+        arr[tuple(sl)] = _garbage(arr.dtype)
+        poisoned += 1
+        out.append(arr)
+    return jax.tree.unflatten(treedef, out), poisoned
+
+
+@dataclass
+class PreemptRun:
+    """One preempted-and-recovered dense run: the final state, stitched
+    curves (replay segments verified bit-identical to the originals
+    before stitching), and the recovery evidence."""
+
+    rounds: int
+    events: list  # [(round, device)]
+    checkpoint_every: int
+    final: object
+    curves: dict
+    counters: RecoveryCounters
+    facts: dict = field(default_factory=dict)
+    wall_s: dict = field(default_factory=dict)
+
+
+def run_dense_preempted(
+    cfg,
+    topo,
+    sched,
+    mesh,
+    events,
+    checkpoint_every: int,
+    seed: int = 0,
+    checkpoint_dir: str | None = None,
+    fingerprint: str = "",
+    telemetry=None,
+) -> PreemptRun:
+    """Dense run under device-shard preemption: advance in
+    ``checkpoint_every``-aligned segments, snapshot at each boundary,
+    and at each ``(round, device)`` event kill that device's shard,
+    reload the latest checkpoint, replay the gap (pinning the replayed
+    curves bit-identical to the first pass), and continue.
+
+    ``events`` is a ``FaultPlan.preempt_events()`` worklist (or any
+    sorted ``[(round, device)]``); kernel-plane faults in the same plan
+    go to the engine separately via ``FaultPlan.kernel_plan()``."""
+    from corrosion_tpu.sim import engine
+
+    ce = int(checkpoint_every)
+    if ce <= 0:
+        raise ValueError("checkpoint_every must be positive")
+    events = sorted((int(r), int(d)) for r, d in events)
+    rounds = sched.rounds
+    for p_round, _dev in events:
+        if not 0 <= p_round < rounds:
+            raise ValueError(f"preempt round {p_round} outside run")
+
+    counters = RecoveryCounters()
+    wall = {"advance": 0.0, "checkpoint": 0.0, "recover": 0.0}
+    segs: dict = {}  # start round -> curves (np) for bit-identity replay
+    replay_mismatches: list = []
+    checkpoints_taken: list = []
+    reconciles: list = []
+    n_samples = len(sched.sample_writer)
+
+    state = mesh_mod.shard_cluster_state(
+        engine.init_cluster(cfg, n_samples), mesh
+    )
+    ckpt_round, ckpt_host = 0, jax.device_get(state)
+
+    def specs_for(host):
+        return mesh_mod.cluster_state_specs(host, mesh)
+
+    def take_checkpoint(state, r):
+        nonlocal ckpt_round, ckpt_host
+        t = time.perf_counter()
+        host = jax.device_get(state)
+        path = _ckpt_path(checkpoint_dir, f"preempt_r{r}.npz")
+        if path is not None:
+            checkpoint_mod.save_state(
+                path, host, fingerprint=fingerprint,
+                mesh_shape=mesh_dims(mesh),
+            )
+            host = checkpoint_mod.load_state(
+                path, cfg, n_samples, expect_fingerprint=fingerprint
+            )
+        ckpt_round, ckpt_host = r, host
+        checkpoints_taken.append(r)
+        wall["checkpoint"] += time.perf_counter() - t
+
+    def advance(state, r_from, r_to, replay: bool):
+        """Segment-wise advance hitting every grid boundary, so the
+        replay path recompiles nothing and checkpoints land exactly
+        where the first pass took them."""
+        kind = "recover" if replay else "advance"
+        r = r_from
+        while r < r_to:
+            t = time.perf_counter()
+            nxt = min(r_to, (r // ce + 1) * ce)
+            state, curves = shard_driver.simulate_sharded(
+                cfg, topo, schedule_slice(sched, r, nxt), mesh,
+                seed=seed, state=state, telemetry=telemetry,
+            )
+            curves = {k: np.asarray(v) for k, v in curves.items()}
+            if replay and r in segs:
+                bad = [
+                    k for k in segs[r]
+                    if not np.array_equal(segs[r][k], curves[k])
+                ]
+                if bad:
+                    replay_mismatches.append({"round": r, "keys": bad})
+            segs[r] = curves
+            wall[kind] += time.perf_counter() - t
+            r = nxt
+            if not replay and r % ce == 0 and r < r_to:
+                take_checkpoint(state, r)
+        return state
+
+    poison_changed = True
+    r = 0
+    for p_round, device in events:
+        state = advance(state, r, p_round, replay=False)
+        if p_round % ce == 0 and p_round > r:
+            # advance() skips the boundary that coincides with its end;
+            # the event interrupts the run exactly there, so the
+            # snapshot the recovery needs is this one.
+            take_checkpoint(state, p_round)
+
+        # The kill: materialize what the cluster would hold with this
+        # device's shard destroyed, and prove the destruction is real.
+        counters.preempts_fired += 1
+        live_host = jax.device_get(state)
+        poisoned, n_leaves = poison_lost_shard(
+            live_host, specs_for(live_host), mesh, device
+        )
+        changed = any(
+            not np.array_equal(a, b, equal_nan=False)
+            for a, b in zip(
+                jax.tree.leaves(live_host), jax.tree.leaves(poisoned)
+            )
+        )
+        poison_changed = poison_changed and changed and n_leaves > 0
+        del state, poisoned  # the live state died with the device
+
+        # Recovery: latest checkpoint + deterministic gap replay. The
+        # poisoned state is never read — there is nothing to drain.
+        t = time.perf_counter()
+        counters.checkpoint_loads += 1
+        state, rec = place_reconciled(
+            ckpt_host, specs_for(ckpt_host), mesh
+        )
+        reconciles.append({**rec, "round": ckpt_round})
+        counters.shards_rematerialized += 1
+        wall["recover"] += time.perf_counter() - t
+        counters.gap_rounds_replayed += p_round - ckpt_round
+        state = advance(state, ckpt_round, p_round, replay=True)
+        r = p_round
+
+    state = advance(state, r, rounds, replay=False)
+    starts = sorted(segs)
+    curves = {
+        k: np.concatenate([segs[s][k] for s in starts])
+        for k in segs[starts[0]]
+    } if starts else {}
+    return PreemptRun(
+        rounds=rounds, events=events, checkpoint_every=ce, final=state,
+        curves=curves, counters=counters,
+        facts={
+            "poison_changed": bool(poison_changed),
+            "replay_identical": not replay_mismatches,
+            "replay_mismatches": replay_mismatches,
+            "checkpoints": checkpoints_taken,
+            "reconciles": reconciles,
+        },
+        wall_s=wall,
+    )

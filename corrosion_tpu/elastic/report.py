@@ -1,0 +1,151 @@
+"""Elastic-plane artifacts and the budget gate.
+
+Scenario reports (elastic/scenarios.py) carry ``corro-elastic/1``; the
+smoke lane (scripts/elastic_smoke.py) wraps a batch of them and gates
+against the ``elastic`` entry of bench_budget.json in the standing
+soak/hostchaos style: wall ceilings scale with the budget's tolerance,
+the survival invariants NEVER scale — bit-identity, byte-exact
+reconcile, zero oracle violations, and the machinery-fired rule are
+pass/fail at any tolerance.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+# Per-round wire-volume keys legitimately differ across meshes (the
+# queue exchange crosses different boundaries on a different device
+# grid); every cross-mesh curve compare skips them, same-mesh compares
+# keep them.
+from corrosion_tpu.sim.telemetry import XSHARD_CURVE_KEYS  # noqa: F401
+
+ELASTIC_SCHEMA = "corro-elastic/1"
+
+
+def _path_str(path) -> str:
+    return jax.tree_util.keystr(path) or "<root>"
+
+
+def diff_trees(a, b, label: str = "") -> list:
+    """Leaf-by-leaf bit-exact comparison of two state pytrees (host or
+    device; NaN != NaN, matching the convergence contract — final CRDT
+    state is all-integer). Returns human-readable mismatch strings,
+    empty = identical."""
+    fa = jax.tree_util.tree_flatten_with_path(a)[0]
+    fb = jax.tree_util.tree_flatten_with_path(b)[0]
+    if len(fa) != len(fb):
+        return [f"{label}: structure differs ({len(fa)} vs {len(fb)} leaves)"]
+    out = []
+    for (pa, la), (_pb, lb) in zip(fa, fb):
+        xa, xb = np.asarray(la), np.asarray(lb)
+        if xa.shape != xb.shape or xa.dtype != xb.dtype:
+            out.append(
+                f"{label}{_path_str(pa)}: {xa.dtype}{xa.shape} vs "
+                f"{xb.dtype}{xb.shape}"
+            )
+        elif not np.array_equal(xa, xb):
+            bad = int(np.sum(xa != xb))
+            out.append(
+                f"{label}{_path_str(pa)}: {bad}/{xa.size} elements differ"
+            )
+    return out
+
+
+def slice_curves(curves: dict, start: int, stop: int | None = None) -> dict:
+    """Round-window view of a per-round curve dict."""
+    return {k: np.asarray(v)[start:stop] for k, v in curves.items()}
+
+
+def diff_curves(a: dict, b: dict, skip: tuple = ()) -> list:
+    """Bit-exact comparison of two per-round curve dicts; ``skip``
+    names keys excused from the compare (pass ``XSHARD_CURVE_KEYS``
+    when the two sides ran on different meshes)."""
+    out = []
+    keys = sorted(set(a) | set(b))
+    for k in keys:
+        if k in skip:
+            continue
+        if k not in a or k not in b:
+            out.append(f"curve {k}: present on one side only")
+            continue
+        xa, xb = np.asarray(a[k]), np.asarray(b[k])
+        if xa.shape != xb.shape:
+            out.append(f"curve {k}: shape {xa.shape} vs {xb.shape}")
+        elif not np.array_equal(xa, xb):
+            first = int(np.flatnonzero(
+                np.any((xa != xb).reshape(xa.shape[0], -1), axis=1)
+            )[0])
+            out.append(f"curve {k}: diverges at round {first}")
+    return out
+
+
+def wall_total(scenario: dict) -> float:
+    return float(sum((scenario.get("wall_s") or {}).values()))
+
+
+def check_elastic_budget(report: dict, budget: dict) -> dict:
+    """Gate a smoke-lane report against the ``elastic`` budget entry.
+
+    Scaled by ``tolerance``: per-scenario wall ceilings (noisy CI
+    hosts). NEVER scaled: ``require_bit_identical``,
+    ``require_reconcile``, ``require_machinery_fired``,
+    ``oracle_violations_max`` — a slow reshard is a warning, a
+    divergent one is a broken survival plane. A scenario the budget
+    names but the report lacks is a breach (a lane that silently stops
+    running a scenario must fail loudly — the machinery-fired
+    principle applied to the harness itself)."""
+    tol = float(budget.get("tolerance", 1.0))
+    breaches: list = []
+    checks: list = []
+    by_name = {
+        s.get("scenario"): s for s in report.get("scenarios", [])
+    }
+    for name, sb in (budget.get("scenarios") or {}).items():
+        s = by_name.get(name)
+        if s is None:
+            breaches.append(f"{name}: scenario missing from report")
+            continue
+        if budget.get("require_bit_identical", 1) and not s.get(
+            "bit_identical", False
+        ):
+            breaches.append(
+                f"{name}: NOT bit-identical to the uninterrupted run "
+                f"({len(s.get('mismatches', []))} mismatches)"
+            )
+        if budget.get("require_reconcile", 1) and not (
+            (s.get("reconcile") or {}).get("ok", False)
+        ):
+            breaches.append(
+                f"{name}: predicted_per_device_bytes did not reconcile"
+            )
+        viol = len(s.get("violations") or [])
+        if viol > int(budget.get("oracle_violations_max", 0)):
+            breaches.append(f"{name}: {viol} oracle violation(s)")
+        mach = s.get("machinery")
+        if mach is not None and budget.get("require_machinery_fired", 1):
+            if not mach.get("fired", False):
+                breaches.append(
+                    f"{name}: passed with recovery machinery idle — "
+                    f"harness failure ({mach})"
+                )
+        ceiling = sb.get("wall_ceiling_s")
+        if ceiling is not None:
+            wall = wall_total(s)
+            checks.append({
+                "scenario": name, "wall_s": wall,
+                "wall_ceiling_s": ceiling * tol,
+            })
+            if wall > ceiling * tol:
+                breaches.append(
+                    f"{name}: wall {wall:.1f}s > ceiling "
+                    f"{ceiling * tol:.1f}s (tolerance {tol}x)"
+                )
+        if not s.get("ok", False):
+            breaches.append(f"{name}: scenario reported not ok")
+    return {
+        "ok": not breaches,
+        "breaches": breaches,
+        "checks": checks,
+        "tolerance": tol,
+    }

@@ -1,0 +1,433 @@
+"""Live mesh resharding: checkpoint → re-place → resume, pinned
+convergence-equivalent.
+
+Production fleets autoscale: a run that started on D devices must be
+able to continue on D′ ≠ D. All the ingredients already exist — the
+shard_driver samples RNG at full shape and row-slices (bit-identity
+across device counts by construction), every engine's scan driver
+resumes from a carried state at an absolute round, and
+``parallel/mesh.py`` keeps ONE spec source for placement and byte
+prediction. This module composes them into the reshard flow:
+
+1. run the prefix ``[0, split)`` sharded on ``mesh_from``;
+2. gather the carried state to host at the chunk boundary (optionally
+   round-tripping through the self-describing ``corro-checkpoint/1``
+   disk format, sim/checkpoint.py);
+3. re-place under the SAME ``*_specs`` builders on ``mesh_to`` and
+   reconcile ``predicted_per_device_bytes`` against the live shards
+   byte-exact BEFORE resuming (a placement that doesn't match its
+   prediction is refused, not resumed);
+4. resume the scan driver over the tail ``[split, rounds)``.
+
+The contract is bit-identity, not tolerance: the resharded run's
+remaining round curves (xshard byte keys excepted — the wire volume
+legitimately depends on the mesh) and final CRDT state must equal the
+uninterrupted same-seed run exactly. Any divergence is a bug
+(elastic/report.py diffs them leaf-by-leaf; tests/test_elastic.py and
+scripts/elastic_smoke.py pin it for (D→D′) ∈ {4→8, 8→4, 8→2, 1→8}).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from corrosion_tpu.parallel import mesh as mesh_mod
+from corrosion_tpu.parallel import shard_driver
+from corrosion_tpu.sim import checkpoint as checkpoint_mod
+
+
+def mesh_dims(mesh) -> tuple:
+    """The mesh's axis sizes (dcn outer first) — checkpoint-header form."""
+    return tuple(int(mesh.shape[a]) for a in mesh.axis_names)
+
+
+def virtual_mesh(d: int):
+    """The standard virtual mesh at device count ``d`` — the 2-D WAN
+    (dcn, ici) mesh for d >= 4, 1-D below (sim.benchlib.multichip_mesh),
+    so reshard pairs like 4→8 exercise the multi-axis placement."""
+    from corrosion_tpu.sim import benchlib
+
+    return benchlib.multichip_mesh(d)
+
+
+def schedule_slice(sched, start: int, stop: int):
+    """The ``[start, stop)`` window of a Schedule — writes and every
+    fault axis sliced, sample triplets kept absolute (the engines track
+    visibility in absolute rounds). Mirrors sim.engine.simulate's own
+    chunk slicing, so a prefix+tail pair replays the uninterrupted run
+    exactly."""
+    from corrosion_tpu.sim.engine import Schedule
+
+    def cut(v):
+        return None if v is None else v[start:stop]
+
+    return Schedule(
+        writes=sched.writes[start:stop],
+        kill=cut(sched.kill),
+        revive=cut(sched.revive),
+        partition=cut(sched.partition),
+        sample_writer=sched.sample_writer,
+        sample_ver=sched.sample_ver,
+        sample_round=sched.sample_round,
+        loss=cut(sched.loss),
+        probe_loss=cut(sched.probe_loss),
+        wipe=cut(sched.wipe),
+    )
+
+
+def place_reconciled(host_tree, specs, mesh):
+    """Place a host state pytree on ``mesh`` under ``specs`` and
+    reconcile the byte arithmetic: ``predicted_per_device_bytes`` (from
+    the spec tree) must equal every device's live
+    ``per_device_state_bytes`` EXACTLY. Raises on any mismatch — a
+    placement whose prediction is off must never be resumed into.
+    Returns ``(placed_tree, reconcile_dict)``."""
+    predicted = mesh_mod.predicted_per_device_bytes(host_tree, specs, mesh)
+    placed = mesh_mod._put_specs(host_tree, specs, mesh)
+    measured = shard_driver.per_device_state_bytes(placed)
+    bad = {
+        str(dev): int(b) for dev, b in measured.items() if b != predicted
+    }
+    if len(measured) != mesh.devices.size or bad:
+        raise ValueError(
+            f"reshard byte reconcile failed on {mesh_dims(mesh)}: "
+            f"predicted {predicted} B/device, live mismatches {bad}, "
+            f"{len(measured)}/{mesh.devices.size} devices reporting"
+        )
+    return placed, {
+        "predicted_per_device_bytes": int(predicted),
+        "devices": int(mesh.devices.size),
+        "mesh": list(mesh_dims(mesh)),
+        "ok": True,
+    }
+
+
+@dataclass
+class ReshardRun:
+    """One checkpoint→reshard→resume execution (engine-specific
+    ``final``; the scenario layer compares it against the uninterrupted
+    reference)."""
+
+    engine: str
+    mesh_from: tuple
+    mesh_to: tuple
+    split: int  # rounds before the reshard (epochs * e_len for sparse)
+    final: object
+    prefix_curves: dict
+    tail_curves: dict
+    reconcile: dict
+    checkpoint: dict | None  # corro-checkpoint/1 header of the round-trip
+    wall_s: dict = field(default_factory=dict)
+    extra: dict = field(default_factory=dict)
+
+
+def _ckpt_path(checkpoint_dir: str | None, name: str) -> str | None:
+    if checkpoint_dir is None:
+        return None
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    return os.path.join(checkpoint_dir, name)
+
+
+def run_dense_resharded(
+    cfg,
+    topo,
+    sched,
+    mesh_from,
+    mesh_to,
+    split_round: int,
+    seed: int = 0,
+    checkpoint_dir: str | None = None,
+    fingerprint: str = "",
+    telemetry=None,
+) -> ReshardRun:
+    """Dense engine: run ``[0, split_round)`` on ``mesh_from``,
+    checkpoint/reshard, resume ``[split_round, rounds)`` on ``mesh_to``."""
+    from corrosion_tpu.sim import engine
+
+    if not (0 < split_round < sched.rounds):
+        raise ValueError(
+            f"split_round must be inside (0, {sched.rounds}), got "
+            f"{split_round}"
+        )
+    wall: dict = {}
+    t = time.perf_counter()
+    state = mesh_mod.shard_cluster_state(
+        engine.init_cluster(cfg, len(sched.sample_writer)), mesh_from
+    )
+    state, prefix_curves = shard_driver.simulate_sharded(
+        cfg, topo, schedule_slice(sched, 0, split_round), mesh_from,
+        seed=seed, state=state, telemetry=telemetry,
+    )
+    wall["prefix"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    host = jax.device_get(state)
+    header = None
+    path = _ckpt_path(checkpoint_dir, "dense_reshard.npz")
+    if path is not None:
+        checkpoint_mod.save_state(
+            path, host, fingerprint=fingerprint,
+            mesh_shape=mesh_dims(mesh_from),
+        )
+        host = checkpoint_mod.load_state(
+            path, cfg, len(sched.sample_writer),
+            expect_fingerprint=fingerprint,
+        )
+        header = checkpoint_mod.read_header(path)
+    wall["checkpoint"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    placed, reconcile = place_reconciled(
+        host, mesh_mod.cluster_state_specs(host, mesh_to), mesh_to
+    )
+    wall["reshard"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    final, tail_curves = shard_driver.simulate_sharded(
+        cfg, topo, schedule_slice(sched, split_round, sched.rounds),
+        mesh_to, seed=seed, state=placed, telemetry=telemetry,
+    )
+    wall["tail"] = time.perf_counter() - t
+    return ReshardRun(
+        engine="dense", mesh_from=mesh_dims(mesh_from),
+        mesh_to=mesh_dims(mesh_to), split=split_round, final=final,
+        prefix_curves=prefix_curves, tail_curves=tail_curves,
+        reconcile=reconcile, checkpoint=header, wall_s=wall,
+    )
+
+
+def run_sparse_resharded(
+    cfg,
+    topo,
+    sched,
+    mesh_from,
+    mesh_to,
+    split_epoch: int,
+    seed: int = 0,
+    checkpoint_dir: str | None = None,
+    fingerprint: str = "",
+    telemetry=None,
+) -> ReshardRun:
+    """Sparse (any-node-writes) engine: epochs are its chunk boundaries.
+    Run ``split_epoch`` epochs on ``mesh_from``, persist the resume
+    point WITH the schedule's fault axes (the resume-asymmetry fix in
+    sim/checkpoint.py), reshard, and resume the remaining epochs on
+    ``mesh_to`` against the full original schedule."""
+    wall: dict = {}
+    t = time.perf_counter()
+    *_pre, prefix_curves, info = shard_driver.simulate_sparse_sharded(
+        cfg, topo, sched, mesh_from, seed=seed,
+        stop_after_epoch=split_epoch - 1, telemetry=telemetry,
+    )
+    resume = info["resume"]
+    wall["prefix"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    host = {
+        "sstate": jax.device_get(resume["sstate"]),
+        "swim": jax.device_get(resume["swim"]),
+        "vis_round": jax.device_get(resume["vis_round"]),
+        "planner": resume["planner"],
+        "next_epoch": int(resume["next_epoch"]),
+    }
+    header = None
+    path = _ckpt_path(checkpoint_dir, "sparse_reshard.npz")
+    if path is not None:
+        checkpoint_mod.save_sparse_resume(
+            path, host, schedule=sched, fingerprint=fingerprint,
+            mesh_shape=mesh_dims(mesh_from),
+        )
+        host = checkpoint_mod.load_sparse_resume(
+            path, cfg, len(sched.sample_writer),
+            expect_fingerprint=fingerprint,
+        )
+        # The persisted fault axes must agree with (or restore) the
+        # schedule the resumed run replays — the asymmetry this fixes.
+        sched = checkpoint_mod.attach_resume_faults(sched, host)
+        header = checkpoint_mod.read_header(path)
+    wall["checkpoint"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    node = shard_driver.node_spec_entry(mesh_to)
+    tree = (host["sstate"], host["swim"], host["vis_round"])
+    specs = (
+        mesh_mod.sparse_state_specs(host["sstate"], mesh_to),
+        mesh_mod.node_major_specs(host["swim"], mesh_to),
+        P(None, node),
+    )
+    placed, reconcile = place_reconciled(tree, specs, mesh_to)
+    resume2 = {
+        "sstate": placed[0],
+        "swim": placed[1],
+        "vis_round": placed[2],
+        "planner": host["planner"],
+        "next_epoch": int(host["next_epoch"]),
+    }
+    wall["reshard"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    sstate, swim_state, vis_round, tail_curves, info2 = (
+        shard_driver.simulate_sparse_sharded(
+            cfg, topo, sched, mesh_to, seed=seed, resume=resume2,
+            telemetry=telemetry,
+        )
+    )
+    wall["tail"] = time.perf_counter() - t
+    e_len = getattr(cfg, "epoch_rounds", None) or getattr(
+        cfg.sparse, "epoch_rounds"
+    )
+    return ReshardRun(
+        engine="sparse", mesh_from=mesh_dims(mesh_from),
+        mesh_to=mesh_dims(mesh_to), split=split_epoch * int(e_len),
+        final=(sstate, swim_state, vis_round),
+        prefix_curves=prefix_curves, tail_curves=tail_curves,
+        reconcile=reconcile, checkpoint=header, wall_s=wall,
+        extra={"split_epoch": split_epoch, "epochs": info2["epochs"]},
+    )
+
+
+def run_chunks_resharded(
+    ccfg,
+    origin,
+    last_seq,
+    rounds: int,
+    mesh_from,
+    mesh_to,
+    split_round: int,
+    seed: int = 0,
+    checkpoint_dir: str | None = None,
+    fingerprint: str = "",
+    telemetry=None,
+) -> ReshardRun:
+    """Seq-chunk engine: coverage state + the visibility latch carry
+    across the reshard; the resumed call folds ``start_round`` into its
+    per-round RNG keys (the sim/chunk_engine.py resume seam)."""
+    from corrosion_tpu.ops import chunks as chunk_ops
+
+    wall: dict = {}
+    t = time.perf_counter()
+    state, m1 = shard_driver.simulate_chunks_sharded(
+        ccfg, origin, last_seq, split_round, mesh_from, seed=seed,
+        telemetry=telemetry,
+    )
+    wall["prefix"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    host = jax.device_get((state, m1["vis"]))
+    header = None
+    path = _ckpt_path(checkpoint_dir, "chunk_reshard.npz")
+    if path is not None:
+        checkpoint_mod.save_tree(
+            path, host, fingerprint=fingerprint,
+            mesh_shape=mesh_dims(mesh_from), round_index=split_round,
+        )
+        template = jax.device_get((
+            chunk_ops.init_chunks(
+                ccfg, np.asarray(origin, np.int32),
+                np.asarray(last_seq, np.int32),
+            ),
+            np.full((ccfg.n_nodes, ccfg.n_streams), -1, np.int32),
+        ))
+        host = checkpoint_mod.load_tree(
+            path, template, expect_fingerprint=fingerprint
+        )
+        header = checkpoint_mod.read_header(path)
+    wall["checkpoint"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    node = shard_driver.node_spec_entry(mesh_to)
+    specs = (
+        mesh_mod.node_major_specs(host[0], mesh_to),
+        P(node, None),
+    )
+    placed, reconcile = place_reconciled(host, specs, mesh_to)
+    wall["reshard"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    final, m2 = shard_driver.simulate_chunks_sharded(
+        ccfg, origin, last_seq, rounds - split_round, mesh_to, seed=seed,
+        state=placed[0], vis=placed[1], start_round=split_round,
+        telemetry=telemetry,
+    )
+    wall["tail"] = time.perf_counter() - t
+    return ReshardRun(
+        engine="chunk", mesh_from=mesh_dims(mesh_from),
+        mesh_to=mesh_dims(mesh_to), split=split_round,
+        final=(final, m2["vis"]),
+        prefix_curves=m1["curves"], tail_curves=m2["curves"],
+        reconcile=reconcile, checkpoint=header, wall_s=wall,
+        extra={"metrics": {
+            k: v for k, v in m2.items() if k not in ("curves", "vis")
+        }},
+    )
+
+
+def run_mixed_resharded(
+    cfg,
+    ccfg,
+    topo,
+    sched,
+    streams,
+    mesh_from,
+    mesh_to,
+    split_round: int,
+    seed: int = 0,
+    checkpoint_dir: str | None = None,
+    fingerprint: str = "",
+    telemetry=None,
+) -> ReshardRun:
+    """Mixed chunk+version engine: the carried MixedState's ``round``
+    anchors the tail in absolute rounds (the sim/mixed_engine.py resume
+    seam — RNG keys and the stream commit matrix both offset by it)."""
+    from corrosion_tpu.sim import mixed_engine
+
+    wall: dict = {}
+    t = time.perf_counter()
+    state, prefix_curves = shard_driver.simulate_mixed_sharded(
+        cfg, ccfg, topo, schedule_slice(sched, 0, split_round), streams,
+        mesh_from, seed=seed, telemetry=telemetry,
+    )
+    wall["prefix"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    host = jax.device_get(state)
+    header = None
+    path = _ckpt_path(checkpoint_dir, "mixed_reshard.npz")
+    if path is not None:
+        checkpoint_mod.save_tree(
+            path, host, fingerprint=fingerprint,
+            mesh_shape=mesh_dims(mesh_from), round_index=split_round,
+        )
+        template = jax.device_get(mixed_engine.init_mixed_state(
+            cfg, ccfg, topo, sched, streams
+        ))
+        host = checkpoint_mod.load_tree(
+            path, template, expect_fingerprint=fingerprint
+        )
+        header = checkpoint_mod.read_header(path)
+    wall["checkpoint"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    placed, reconcile = place_reconciled(
+        host, mesh_mod.mixed_state_specs(host, mesh_to), mesh_to
+    )
+    wall["reshard"] = time.perf_counter() - t
+
+    t = time.perf_counter()
+    final, tail_curves = shard_driver.simulate_mixed_sharded(
+        cfg, ccfg, topo, schedule_slice(sched, split_round, sched.rounds),
+        streams, mesh_to, seed=seed, state=placed, telemetry=telemetry,
+    )
+    wall["tail"] = time.perf_counter() - t
+    return ReshardRun(
+        engine="mixed", mesh_from=mesh_dims(mesh_from),
+        mesh_to=mesh_dims(mesh_to), split=split_round, final=final,
+        prefix_curves=prefix_curves, tail_curves=tail_curves,
+        reconcile=reconcile, checkpoint=header, wall_s=wall,
+    )

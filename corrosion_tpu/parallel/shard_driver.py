@@ -333,6 +333,7 @@ def simulate_sparse_sharded(
     seed: int = 0,
     telemetry=None,
     resume: dict | None = None,
+    stop_after_epoch: int | None = None,
 ):
     """Sparse-engine (any-node-writes) run under the shard_map driver:
     slot-plane broadcast through the explicit exchange (queue entries
@@ -363,6 +364,7 @@ def simulate_sparse_sharded(
         )
     return sparse_engine.simulate_sparse(
         cfg, replicate(topo, mesh), sched, seed=seed, resume=resume,
+        stop_after_epoch=stop_after_epoch,
         telemetry=telemetry, bcast_fn=make_sharded_broadcast(mesh),
     )
 
@@ -376,12 +378,21 @@ def simulate_chunks_sharded(
     seed: int = 0,
     max_chunk: int | None = None,
     telemetry=None,
+    faults=None,
+    state=None,
+    vis=None,
+    start_round: int = 0,
 ):
     """Chunk-plane (seq-chunk) run with coverage node-sharded over
     ``mesh``. The chunk round's gossip is row-local gathers over the
     bounded coverage tables, so GSPMD placement alone partitions it —
     there is no version-plane broadcast queue to exchange explicitly,
-    and the xshard curve keys stay zero by design."""
+    and the xshard curve keys stay zero by design.
+
+    ``state``/``vis``/``start_round`` are the elastic resume seam:
+    pass a carried (re-placed) coverage state and visibility latch with
+    the absolute resume round to continue a checkpointed run
+    bit-identically (sim/chunk_engine.simulate_chunks)."""
     import jax.numpy as jnp
 
     from corrosion_tpu.parallel import mesh as mesh_mod
@@ -391,16 +402,19 @@ def simulate_chunks_sharded(
     node = node_spec_entry(mesh)
     origin = jnp.asarray(origin, jnp.int32)
     last_seq = jnp.asarray(last_seq, jnp.int32)
-    state = mesh_mod.shard_chunk_state(
-        chunk_ops.init_chunks(ccfg, origin, last_seq), mesh
-    )
-    vis = jax.device_put(
-        jnp.full((ccfg.n_nodes, ccfg.n_streams), -1, jnp.int32),
-        NamedSharding(mesh, P(node, None)),
-    )
+    if state is None:
+        state = mesh_mod.shard_chunk_state(
+            chunk_ops.init_chunks(ccfg, origin, last_seq), mesh
+        )
+    if vis is None:
+        vis = jax.device_put(
+            jnp.full((ccfg.n_nodes, ccfg.n_streams), -1, jnp.int32),
+            NamedSharding(mesh, P(node, None)),
+        )
     return chunk_engine.simulate_chunks(
         ccfg, origin, replicate(last_seq, mesh), rounds, seed=seed,
-        max_chunk=max_chunk, telemetry=telemetry, state=state, vis=vis,
+        max_chunk=max_chunk, telemetry=telemetry, faults=faults,
+        state=state, vis=vis, start_round=start_round,
     )
 
 
@@ -414,19 +428,25 @@ def simulate_mixed_sharded(
     seed: int = 0,
     max_chunk: int | None = None,
     telemetry=None,
+    state=None,
 ):
     """Mixed chunk+version run under the shard_map broadcast driver:
     the version plane's delivery chain runs through the explicit queue
     exchange (same ShardCtx path as the dense engine), the chunk plane
     and big-version admission stay GSPMD-placed over the node-sharded
-    MixedState."""
+    MixedState.
+
+    ``state`` is the elastic resume seam: a re-placed MixedState whose
+    carried ``round`` anchors the tail schedule in absolute rounds
+    (sim/mixed_engine.simulate_mixed)."""
     from corrosion_tpu.parallel import mesh as mesh_mod
     from corrosion_tpu.sim import mixed_engine
 
-    state = mesh_mod.shard_mixed_state(
-        mixed_engine.init_mixed_state(cfg, ccfg, topo, sched, streams),
-        mesh,
-    )
+    if state is None:
+        state = mesh_mod.shard_mixed_state(
+            mixed_engine.init_mixed_state(cfg, ccfg, topo, sched, streams),
+            mesh,
+        )
     return mixed_engine.simulate_mixed(
         cfg, ccfg, replicate(topo, mesh), sched, streams, seed=seed,
         max_chunk=max_chunk, telemetry=telemetry, state=state,

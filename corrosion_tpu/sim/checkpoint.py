@@ -10,6 +10,15 @@ is bit-identical to an uninterrupted run (asserted in tests).
 Format: one .npz per snapshot — flat leaves keyed by pytree path, plus the
 structure fingerprint so loading against a mismatched config fails loudly
 instead of mis-zipping arrays.
+
+Checkpoints are self-describing (``corro-checkpoint/1``): every save
+embeds a JSON header with the schema version, checkpoint kind, config
+fingerprint (``sim.benchlib.config_fingerprint``), device-mesh dims at
+save time, and the absolute round index. Loaders refuse a mismatched
+fingerprint up front instead of failing deep in an engine; the mesh dims
+are advisory (gathered host state reshards onto any mesh — that is the
+elastic plane's whole point) but let tooling report where a checkpoint
+came from.
 """
 
 from __future__ import annotations
@@ -21,13 +30,84 @@ import numpy as np
 
 from corrosion_tpu.sim.engine import ClusterState, Schedule, init_cluster
 
+CHECKPOINT_SCHEMA = "corro-checkpoint/1"
+
+# Fault axes save_schedule persists and sparse resume points now carry
+# (the resume asymmetry fix): a resumed run must replay its fault plan.
+FAULT_AXES = ("kill", "revive", "partition", "loss", "probe_loss", "wipe")
+
 
 def _paths(tree) -> list[str]:
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     return [jax.tree_util.keystr(path) for path, _ in flat]
 
 
-def save_state(path: str, state: ClusterState) -> None:
+def _header_array(kind: str, fingerprint: str, mesh_shape, round_index):
+    header = {
+        "schema": CHECKPOINT_SCHEMA,
+        "kind": kind,
+        "config_fingerprint": str(fingerprint),
+        "mesh": [int(d) for d in tuple(mesh_shape or ())],
+        "round": int(round_index),
+    }
+    return np.array(json.dumps(header, sort_keys=True).encode())
+
+
+def read_header(path: str) -> dict | None:
+    """The ``corro-checkpoint/1`` header of a snapshot, or ``None`` for
+    pre-header (v0) checkpoints."""
+    with np.load(path) as data:
+        if "__header__" not in data.files:
+            return None
+        return json.loads(bytes(data["__header__"].item()).decode())
+
+
+def _check_header(
+    path: str, data, kind: str, expect_fingerprint: str | None
+) -> None:
+    """Refuse a load whose header disagrees with what the caller expects.
+    ``expect_fingerprint=None`` skips the fingerprint check (legacy
+    callers); a checkpoint without any header passes only when no
+    fingerprint is demanded."""
+    if "__header__" not in data.files:
+        if expect_fingerprint is not None:
+            raise ValueError(
+                f"{path}: checkpoint has no {CHECKPOINT_SCHEMA} header, "
+                "cannot verify the config fingerprint "
+                f"{expect_fingerprint!r}; re-save it or pass "
+                "expect_fingerprint=None"
+            )
+        return
+    header = json.loads(bytes(data["__header__"].item()).decode())
+    if header.get("schema") != CHECKPOINT_SCHEMA:
+        raise ValueError(
+            f"{path}: unknown checkpoint schema {header.get('schema')!r} "
+            f"(this build reads {CHECKPOINT_SCHEMA})"
+        )
+    if header.get("kind") != kind:
+        raise ValueError(
+            f"{path}: checkpoint kind {header.get('kind')!r} is not "
+            f"{kind!r} — wrong loader for this file"
+        )
+    if (
+        expect_fingerprint is not None
+        and header.get("config_fingerprint") != expect_fingerprint
+    ):
+        raise ValueError(
+            f"{path}: checkpoint config fingerprint "
+            f"{header.get('config_fingerprint')!r} does not match the "
+            f"running config {expect_fingerprint!r}; refusing to load "
+            "state from a different configuration"
+        )
+
+
+def save_state(
+    path: str,
+    state: ClusterState,
+    *,
+    fingerprint: str = "",
+    mesh_shape=(),
+) -> None:
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
     arrays = {
         f"leaf{idx}": np.asarray(leaf)
@@ -36,13 +116,21 @@ def save_state(path: str, state: ClusterState) -> None:
     arrays["__paths__"] = np.array(
         json.dumps(_paths(state)).encode()
     )
+    arrays["__header__"] = _header_array(
+        "state", fingerprint, mesh_shape, int(np.asarray(state.round))
+    )
     np.savez_compressed(path, **arrays)
 
 
-def load_state(path: str, cfg, n_samples: int) -> ClusterState:
+def load_state(
+    path: str, cfg, n_samples: int, *, expect_fingerprint: str | None = None
+) -> ClusterState:
     """Load a snapshot written by ``save_state``; ``cfg``/``n_samples``
-    must describe the same cluster (shape + kernel selection)."""
+    must describe the same cluster (shape + kernel selection). Pass the
+    config's ``benchlib.config_fingerprint`` as ``expect_fingerprint``
+    to refuse checkpoints from a different configuration up front."""
     with np.load(path) as data:
+        _check_header(path, data, "state", expect_fingerprint)
         saved_paths = json.loads(bytes(data["__paths__"].item()).decode())
         template = init_cluster(cfg, n_samples)
         tmpl_paths = _paths(template)
@@ -73,23 +161,30 @@ def load_state(path: str, cfg, n_samples: int) -> ClusterState:
         return jax.tree.unflatten(treedef, leaves)
 
 
-def save_schedule(path: str, schedule: Schedule) -> None:
+def save_schedule(
+    path: str, schedule: Schedule, *, fingerprint: str = ""
+) -> None:
     arrays = {"writes": schedule.writes}
     # Chaos axes (loss/probe_loss/wipe, sim/faults.py) persist alongside
     # the churn/partition masks: a resumed run replays its fault plan.
-    for name in ("kill", "revive", "partition", "loss", "probe_loss",
-                 "wipe"):
+    for name in FAULT_AXES:
         v = getattr(schedule, name)
         if v is not None:
             arrays[name] = v
     arrays["sample_writer"] = schedule.sample_writer
     arrays["sample_ver"] = schedule.sample_ver
     arrays["sample_round"] = schedule.sample_round
+    arrays["__header__"] = _header_array(
+        "schedule", fingerprint, (), schedule.rounds
+    )
     np.savez_compressed(path, **arrays)
 
 
-def load_schedule(path: str) -> Schedule:
+def load_schedule(
+    path: str, *, expect_fingerprint: str | None = None
+) -> Schedule:
     with np.load(path) as data:
+        _check_header(path, data, "schedule", expect_fingerprint)
         return Schedule(
             writes=data["writes"],
             kill=data["kill"] if "kill" in data else None,
@@ -106,12 +201,87 @@ def load_schedule(path: str) -> Schedule:
         )
 
 
+# -- generic pytree snapshots -------------------------------------------------
+
+
+def save_tree(
+    path: str,
+    tree,
+    *,
+    fingerprint: str = "",
+    mesh_shape=(),
+    round_index: int = 0,
+) -> None:
+    """Persist an arbitrary state pytree (chunk coverage, MixedState, …)
+    with the self-describing header — the elastic plane's checkpoint
+    form for engines without a dedicated snapshot format."""
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    arrays = {
+        f"leaf{idx}": np.asarray(leaf)
+        for idx, (_, leaf) in enumerate(leaves_with_paths)
+    }
+    arrays["__paths__"] = np.array(json.dumps(_paths(tree)).encode())
+    arrays["__header__"] = _header_array(
+        "tree", fingerprint, mesh_shape, round_index
+    )
+    np.savez_compressed(path, **arrays)
+
+
+def load_tree(path: str, template, *, expect_fingerprint: str | None = None):
+    """Load a ``save_tree`` snapshot against a structure/shape/dtype
+    template pytree (typically a freshly-initialized state)."""
+    with np.load(path) as data:
+        _check_header(path, data, "tree", expect_fingerprint)
+        saved_paths = json.loads(bytes(data["__paths__"].item()).decode())
+        tmpl_paths = _paths(template)
+        if saved_paths != tmpl_paths:
+            raise ValueError(
+                "tree checkpoint structure does not match the template "
+                f"(saved {len(saved_paths)} leaves, template implies "
+                f"{len(tmpl_paths)})"
+            )
+        leaves = []
+        for idx, (tmpl_leaf, p) in enumerate(
+            zip(jax.tree.leaves(template), tmpl_paths)
+        ):
+            arr = data[f"leaf{idx}"]
+            tmpl_np = np.asarray(tmpl_leaf)
+            if arr.shape != tmpl_np.shape:
+                raise ValueError(
+                    f"tree checkpoint leaf {p} has shape {arr.shape}, "
+                    f"template implies {tmpl_np.shape}"
+                )
+            if arr.dtype != tmpl_np.dtype:
+                raise ValueError(
+                    f"tree checkpoint leaf {p} has dtype {arr.dtype}, "
+                    f"template implies {tmpl_np.dtype}"
+                )
+            leaves.append(arr)
+        return jax.tree.unflatten(jax.tree.structure(template), leaves)
+
+
 # -- sparse-engine resume snapshots -------------------------------------------
 
 
-def save_sparse_resume(path: str, resume: dict) -> None:
+def save_sparse_resume(
+    path: str,
+    resume: dict,
+    schedule: Schedule | None = None,
+    *,
+    fingerprint: str = "",
+    mesh_shape=(),
+) -> None:
     """Persist a sim.sparse_engine resume point (device trees + host
-    planner) — the sparse plane's checkpoint/resume analogue."""
+    planner) — the sparse plane's checkpoint/resume analogue.
+
+    Pass the run's ``schedule`` to also persist its fault axes
+    (kill/revive/partition/loss/probe_loss — everything ``save_schedule``
+    keeps). The sparse resume protocol replays the FULL original
+    schedule from ``next_epoch`` onward, so a resume point that drops
+    the fault plan silently resumes fault-free; ``load_sparse_resume``
+    returns the axes under ``"faults"`` and
+    :func:`attach_resume_faults` re-attaches them to the rebuilt
+    schedule."""
     tree = (resume["sstate"], resume["swim"], resume["vis_round"])
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
     arrays = {
@@ -122,12 +292,25 @@ def save_sparse_resume(path: str, resume: dict) -> None:
     for k, v in resume["planner"].items():
         arrays[f"planner_{k}"] = np.asarray(v)
     arrays["next_epoch"] = np.asarray(int(resume["next_epoch"]))
+    if schedule is not None:
+        for name in FAULT_AXES:
+            v = getattr(schedule, name)
+            if v is not None:
+                arrays[f"fault_{name}"] = v
+    arrays["__header__"] = _header_array(
+        "sparse-resume", fingerprint, mesh_shape,
+        int(resume["next_epoch"]),
+    )
     np.savez_compressed(path, **arrays)
 
 
-def load_sparse_resume(path: str, cfg, n_samples: int) -> dict:
+def load_sparse_resume(
+    path: str, cfg, n_samples: int, *, expect_fingerprint: str | None = None
+) -> dict:
     """Load a resume point for the given SparseClusterConfig; structure
-    and shapes are checked against the config like load_state."""
+    and shapes are checked against the config like load_state. The
+    returned dict carries any persisted fault axes under ``"faults"``
+    (empty dict when the run was fault-free)."""
     from corrosion_tpu.ops import sparse_writers as sw_ops
     from corrosion_tpu.ops import swim as swim_ops
 
@@ -137,6 +320,7 @@ def load_sparse_resume(path: str, cfg, n_samples: int) -> dict:
         np.zeros((n_samples, cfg.n_nodes), np.int32),
     )
     with np.load(path) as data:
+        _check_header(path, data, "sparse-resume", expect_fingerprint)
         saved_paths = json.loads(bytes(data["__paths__"].item()).decode())
         tmpl_paths = _paths(template)
         if saved_paths != tmpl_paths:
@@ -162,10 +346,44 @@ def load_sparse_resume(path: str, cfg, n_samples: int) -> dict:
             k[len("planner_"):]: data[k]
             for k in data.files if k.startswith("planner_")
         }
+        faults = {
+            k[len("fault_"):]: data[k]
+            for k in data.files if k.startswith("fault_")
+        }
         return {
             "sstate": sstate,
             "swim": swim_state,
             "vis_round": vis_round,
             "planner": planner,
             "next_epoch": int(data["next_epoch"]),
+            "faults": faults,
         }
+
+
+def attach_resume_faults(schedule: Schedule, resume: dict) -> Schedule:
+    """Re-attach the fault axes persisted by ``save_sparse_resume`` to a
+    schedule rebuilt at resume time, so the resumed run replays the same
+    plan the original was under. Refuses to silently override: the
+    rebuilt schedule must not already carry a conflicting axis."""
+    import dataclasses
+
+    faults = resume.get("faults", {})
+    if not faults:
+        return schedule
+    updates = {}
+    for name, arr in faults.items():
+        if name not in FAULT_AXES:
+            raise ValueError(f"unknown persisted fault axis {name!r}")
+        existing = getattr(schedule, name)
+        if existing is not None and not np.array_equal(existing, arr):
+            raise ValueError(
+                f"schedule already carries a different {name!r} axis; "
+                "refusing to overwrite it with the checkpoint's"
+            )
+        if arr.shape[0] != schedule.rounds:
+            raise ValueError(
+                f"persisted {name!r} axis covers {arr.shape[0]} rounds, "
+                f"schedule has {schedule.rounds}"
+            )
+        updates[name] = arr
+    return dataclasses.replace(schedule, **updates)

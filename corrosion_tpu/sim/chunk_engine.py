@@ -126,6 +126,7 @@ def simulate_chunks(
     faults=None,
     state=None,
     vis=None,
+    start_round: int = 0,
 ):
     """Run ``rounds`` chunk-plane rounds; returns (state, metrics dict).
 
@@ -154,6 +155,15 @@ def simulate_chunks(
     everything else about the run is unchanged (GSPMD partitions the
     row-local chunk round, so curves stay bit-identical to the
     unsharded run — pinned in tests/test_shard_driver.py).
+
+    ``start_round`` is the resume seam (the elastic plane's
+    checkpoint-reshard driver): per-round RNG keys and the visibility
+    latch fold ``start_round + r``, so running ``[0, k)`` then resuming
+    ``[k, R)`` with the carried ``state``/``vis`` (returned under
+    ``metrics["vis"]``) is bit-identical to the uninterrupted run. A
+    resumed call takes the TAIL slice of any fault arrays (the plan is
+    authored in absolute rounds; slice before compiling or pass
+    pre-sliced CompiledFaults).
     """
     origin = jnp.asarray(origin, jnp.int32)
     last_seq = jnp.asarray(last_seq, jnp.int32)
@@ -200,7 +210,9 @@ def simulate_chunks(
         nr = min(step, rounds - r0)
         sl = slice(r0, r0 + nr)
         xs = (
-            jnp.arange(r0, r0 + nr, dtype=jnp.int32),
+            jnp.arange(
+                start_round + r0, start_round + r0 + nr, dtype=jnp.int32
+            ),
             None if alive_np is None else jnp.asarray(alive_np[sl]),
             None if loss_np is None else jnp.asarray(
                 loss_np[sl], jnp.float32
@@ -221,7 +233,9 @@ def simulate_chunks(
                 )
                 return (st, vi), curves
 
-            (state, vis), curves = telemetry.run_chunk(r0, _run)
+            (state, vis), curves = telemetry.run_chunk(
+                start_round + r0, _run
+            )
         owned = True
         curve_parts.append({k: np.asarray(v) for k, v in curves.items()})
     merged = {
@@ -242,5 +256,9 @@ def simulate_chunks(
         "seqs_granted": int(merged["applied_sync"].sum()),
         "chunks_sent": int(merged["msgs"].sum()),
         "curves": merged,
+        # The visibility latch is part of the resume carry (elastic
+        # checkpoint/reshard): pass it back in as ``vis`` with
+        # ``start_round`` advanced to continue bit-identically.
+        "vis": vis,
     }
     return state, metrics

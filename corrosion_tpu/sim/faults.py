@@ -31,6 +31,15 @@ Component kinds (all windows are ``[start, stop)`` in rounds):
   report facts.
 - ``probe_loss``: drops SWIM probe/ack exchanges only (``prob``),
   leaving the data plane untouched — membership stress in isolation.
+- ``preempt``: hard-kills one DEVICE shard of the kernel state at round
+  ``start`` (no graceful drain, mirroring ``Agent.abort`` crash
+  semantics). This is a host/elastic-plane axis: :meth:`FaultPlan.compile`
+  does NOT lower it to kernel arrays — the elastic survival driver
+  (``corrosion_tpu/elastic``) consumes it via
+  :meth:`FaultPlan.preempt_events` and must recover the lost shard from
+  the last checkpoint + gap replay. A preempt plan run without the
+  elastic driver is a harness bug, which the machinery-fired rule
+  (recovery counters staying at zero) catches.
 
 Everything here is host-side numpy; the arrays become device inputs
 inside the engines. JSON round-trip (``to_json``/``from_json``) is the
@@ -46,7 +55,10 @@ import numpy as np
 
 PLAN_SCHEMA = "corro-fault-plan/1"
 
-KINDS = ("loss", "partition", "flap", "churn", "probe_loss")
+# Kernel kinds lower to per-round schedule arrays; "preempt" is the
+# elastic plane's device-shard axis and never reaches the scan bodies.
+KERNEL_KINDS = ("loss", "partition", "flap", "churn", "probe_loss")
+KINDS = KERNEL_KINDS + ("preempt",)
 
 
 @dataclass(frozen=True)
@@ -66,6 +78,7 @@ class Fault:
     nodes: tuple = ()  # churn victims
     revive_at: int | None = None  # churn (None = never revived)
     wipe: bool = False  # churn: crash-with-state-wipe
+    device: int = -1  # preempt: device shard index to hard-kill
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -91,6 +104,16 @@ class Fault:
                 )
         if self.wipe and self.kind != "churn":
             raise ValueError("wipe is a churn-only flag")
+        if self.kind == "preempt":
+            if self.device < 0:
+                raise ValueError("preempt: needs a device shard index >= 0")
+            if self.stop != self.start + 1:
+                raise ValueError(
+                    "preempt is instantaneous: stop must be start + 1, "
+                    f"got [{self.start}, {self.stop})"
+                )
+        elif self.device >= 0:
+            raise ValueError("device is a preempt-only field")
 
     @property
     def clears_at(self) -> int | None:
@@ -118,6 +141,8 @@ class Fault:
             d["revive_at"] = self.revive_at
             if self.wipe:
                 d["wipe"] = True
+        if self.kind == "preempt":
+            d["device"] = self.device
         return d
 
     @classmethod
@@ -134,6 +159,7 @@ class Fault:
                 None if d.get("revive_at") is None else int(d["revive_at"])
             ),
             wipe=bool(d.get("wipe", False)),
+            device=int(d.get("device", -1)),
         )
 
 
@@ -244,6 +270,23 @@ class FaultPlan:
                 out.update(f.nodes)
         return tuple(sorted(out))
 
+    def preempt_events(self) -> tuple:
+        """Device-shard preemptions as sorted ``(round, device)`` pairs —
+        the elastic driver's worklist. The kernel compile skips these;
+        if this is non-empty the run MUST go through
+        ``corrosion_tpu.elastic`` so recovery machinery fires."""
+        return tuple(sorted(
+            (f.start, f.device) for f in self.faults if f.kind == "preempt"
+        ))
+
+    def kernel_plan(self) -> "FaultPlan":
+        """The plan with elastic-plane (preempt) components stripped —
+        what actually lowers onto the scan bodies."""
+        kernel = tuple(f for f in self.faults if f.kind != "preempt")
+        if len(kernel) == len(self.faults):
+            return self
+        return FaultPlan(self.rounds, kernel, self.name)
+
     # -- lowering -----------------------------------------------------------
 
     def compile(
@@ -257,6 +300,10 @@ class FaultPlan:
         )
         for f in self.faults:
             stop = min(f.stop, self.rounds)
+            if f.kind == "preempt":
+                # Elastic-plane axis: consumed by the survival driver via
+                # preempt_events(), never lowered to kernel arrays.
+                continue
             if f.kind == "loss":
                 if c.loss is None:
                     c.loss = np.zeros((self.rounds, n_regions), np.float32)
@@ -363,6 +410,8 @@ class FaultPlan:
                     f"{f.kind} {list(f.a)}{arrow}{b}{extra} "
                     f"[{f.start},{f.stop})"
                 )
+            elif f.kind == "preempt":
+                parts.append(f"preempt device {f.device} @{f.start}")
             else:
                 w = "wipe" if f.wipe else "pause"
                 rv = "never" if f.revive_at is None else f.revive_at
@@ -453,7 +502,9 @@ def random_plan(
     eligible = [n for n in range(n_nodes) if n not in set(protect)]
     faults: list[Fault] = []
     n_faults = int(rng.integers(1, max_faults + 1))
-    kinds = list(KINDS)
+    # Fuzz over kernel kinds only: preempt needs the elastic driver's
+    # recovery path and would be a silent no-op under plain simulate().
+    kinds = list(KERNEL_KINDS)
     for _ in range(n_faults):
         kind = kinds[int(rng.integers(0, len(kinds)))]
         start = int(rng.integers(2, max(heal_by // 2, 3)))

@@ -472,6 +472,14 @@ def simulate_mixed(
     driver, the multi-chip path being
     ``parallel.shard_driver.make_sharded_broadcast(mesh)`` (use
     ``parallel.simulate_mixed_sharded`` for the packaged form).
+
+    Resume seam (elastic checkpoint-reshard): a ``state`` whose carried
+    ``round`` is ``k > 0`` resumes at absolute round ``k`` — pass the
+    TAIL slice of the schedule/fault axes (``schedule.rounds`` = the
+    remaining rounds); per-round RNG keys and the stream commit matrix
+    are indexed by ``k + r``, so the resumed run is bit-identical to the
+    uninterrupted one. ``streams.commit_round`` stays absolute; streams
+    that committed before ``k`` already live in the carried coverage.
     """
     n = cfg.n_nodes
     s_writer = jnp.asarray(streams.writer, jnp.int32)
@@ -479,12 +487,16 @@ def simulate_mixed(
     s_last = jnp.asarray(streams.last_seq, jnp.int32)
     if state is None:
         state = init_mixed_state(cfg, ccfg, topo, schedule, streams)
+    # The carried round index anchors the resumed run in absolute
+    # rounds; fresh states carry 0, keeping the uninterrupted path
+    # bit-for-bit unchanged.
+    offset = int(np.asarray(state.round))
     rounds = schedule.rounds
     writes = jnp.asarray(schedule.writes, jnp.uint32)
     commit = np.zeros((rounds, len(streams.writer)), bool)
     for s, r in enumerate(streams.commit_round):
-        if 0 <= r < rounds:
-            commit[r, s] = True
+        if offset <= r < offset + rounds:
+            commit[r - offset, s] = True
     commit = jnp.asarray(commit)
     s_w = jnp.asarray(schedule.sample_writer)
     s_v = jnp.asarray(schedule.sample_ver)
@@ -535,7 +547,7 @@ def simulate_mixed(
         xs = (
             writes[r0:r1], commit[r0:r1], partition[r0:r1],
             kill[r0:r1], revive[r0:r1],
-            jnp.arange(r0, r1, dtype=jnp.int32),
+            jnp.arange(offset + r0, offset + r1, dtype=jnp.int32),
             None if loss is None else loss[r0:r1],
             None if probe_loss is None else probe_loss[r0:r1],
             None if wipe is None else wipe[r0:r1],
@@ -556,7 +568,7 @@ def simulate_mixed(
                     bcast_fn=bcast_fn,
                 )
 
-            state, curves = telemetry.run_chunk(r0, _run)
+            state, curves = telemetry.run_chunk(offset + r0, _run)
         owned = True
         curve_parts.append({k: np.asarray(v) for k, v in curves.items()})
     merged = {

@@ -1,0 +1,582 @@
+"""Elastic survival plane: reshard + preemption pins (docs/SCALING.md
+"Elastic ops").
+
+Fast lanes (tier-1): the self-describing checkpoint header contract
+(round-trip + refusals), the sparse resume fault-axis fix, the
+schedule-window slicer, preempt faults on the fault plane, the
+mesh-spec reshard contract (gather → re-place is a bijection with
+byte-exact ``predicted_per_device_bytes`` on every (D, D′) pair), the
+shard poisoner, the budget gate, and the endurance restart classifier
+across an in-process re-``attach()``.
+
+Slow lanes (multichip CI job, unfiltered): the full dense reshard
+matrix {4→8, 8→4, 8→2, 1→8} plus sparse/chunk/mixed 4→8 — each pinned
+BIT-identical to the uninterrupted same-seed run — and the preemption
+scenarios with the machinery-fired rule.
+"""
+
+import dataclasses
+import itertools
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from corrosion_tpu import models
+from corrosion_tpu.elastic import report as el_report
+from corrosion_tpu.elastic import reshard, scenarios
+from corrosion_tpu.elastic.preempt import poison_lost_shard
+from corrosion_tpu.parallel import mesh as mesh_mod
+from corrosion_tpu.parallel import shard_driver
+from corrosion_tpu.sim import checkpoint
+from corrosion_tpu.sim.engine import init_cluster
+from corrosion_tpu.sim.faults import Fault, FaultPlan
+
+needs8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def _tiny_dense(n=16, rounds=8):
+    cfg, topo, sched = models.wan_100k(
+        n=n, n_regions=2, n_writers=4, rounds=rounds, samples=4
+    )
+    sched.writes[:2, :] = 1
+    sched = sched.make_samples(4)
+    return cfg, topo, sched
+
+
+# -- checkpoint self-description (corro-checkpoint/1) -------------------------
+
+
+def test_checkpoint_header_roundtrip(tmp_path):
+    cfg, _topo, sched = _tiny_dense()
+    state = init_cluster(cfg, len(sched.sample_writer))
+    path = str(tmp_path / "c.npz")
+    checkpoint.save_state(path, state, fingerprint="fp-1", mesh_shape=(2, 4))
+    header = checkpoint.read_header(path)
+    assert header == {
+        "schema": "corro-checkpoint/1",
+        "kind": "state",
+        "config_fingerprint": "fp-1",
+        "mesh": [2, 4],
+        "round": 0,
+    }
+    restored = checkpoint.load_state(
+        path, cfg, len(sched.sample_writer), expect_fingerprint="fp-1"
+    )
+    assert el_report.diff_trees(state, restored) == []
+
+
+def test_checkpoint_refuses_mismatched_fingerprint(tmp_path):
+    cfg, _topo, sched = _tiny_dense()
+    state = init_cluster(cfg, len(sched.sample_writer))
+    path = str(tmp_path / "c.npz")
+    checkpoint.save_state(path, state, fingerprint="fp-1")
+    with pytest.raises(ValueError, match="fingerprint"):
+        checkpoint.load_state(
+            path, cfg, len(sched.sample_writer), expect_fingerprint="other"
+        )
+
+
+def test_checkpoint_refuses_wrong_kind(tmp_path):
+    """A state snapshot must not load through the generic tree loader —
+    the header's kind field binds each file to its loader."""
+    cfg, _topo, sched = _tiny_dense()
+    state = init_cluster(cfg, len(sched.sample_writer))
+    path = str(tmp_path / "c.npz")
+    checkpoint.save_state(path, state, fingerprint="fp-1")
+    with pytest.raises(ValueError, match="kind"):
+        checkpoint.load_tree(path, state, expect_fingerprint="fp-1")
+
+
+def test_headerless_checkpoint_needs_no_fingerprint(tmp_path):
+    """Pre-header (v0) snapshots still load — but only when the caller
+    does not demand fingerprint verification."""
+    cfg, _topo, sched = _tiny_dense()
+    state = init_cluster(cfg, len(sched.sample_writer))
+    path = str(tmp_path / "c.npz")
+    checkpoint.save_state(path, state)
+    with np.load(path) as data:
+        arrays = {k: data[k] for k in data.files if k != "__header__"}
+    legacy = str(tmp_path / "legacy.npz")
+    np.savez_compressed(legacy, **arrays)
+    assert checkpoint.read_header(legacy) is None
+    restored = checkpoint.load_state(legacy, cfg, len(sched.sample_writer))
+    assert el_report.diff_trees(state, restored) == []
+    with pytest.raises(ValueError, match="header"):
+        checkpoint.load_state(
+            legacy, cfg, len(sched.sample_writer), expect_fingerprint="fp"
+        )
+
+
+def test_checkpoint_refuses_shape_mismatch(tmp_path):
+    cfg, _topo, sched = _tiny_dense(n=16)
+    state = init_cluster(cfg, len(sched.sample_writer))
+    path = str(tmp_path / "c.npz")
+    checkpoint.save_state(path, state)
+    cfg32, _t, sched32 = _tiny_dense(n=32)
+    with pytest.raises(ValueError):
+        checkpoint.load_state(path, cfg32, len(sched32.sample_writer))
+
+
+# -- sparse resume fault-axis persistence (the asymmetry fix) -----------------
+
+
+def _strip_fault_axes(sched):
+    return dataclasses.replace(
+        sched, **{name: None for name in checkpoint.FAULT_AXES}
+    )
+
+
+def test_attach_resume_faults_restores_and_refuses():
+    _cfg, _topo, sched = _tiny_dense(rounds=8)
+    loss = np.zeros((8, 2), np.float32)
+    loss[3, :] = 0.5
+    sched = dataclasses.replace(sched, loss=loss)
+    bare = _strip_fault_axes(sched)
+    assert bare.loss is None
+
+    restored = checkpoint.attach_resume_faults(bare, {"faults": {"loss": loss}})
+    np.testing.assert_array_equal(restored.loss, loss)
+    # Re-attaching over an identical axis is a no-op, not a conflict.
+    again = checkpoint.attach_resume_faults(sched, {"faults": {"loss": loss}})
+    np.testing.assert_array_equal(again.loss, loss)
+
+    other = loss.copy()
+    other[5, :] = 0.9
+    with pytest.raises(ValueError, match="different"):
+        checkpoint.attach_resume_faults(sched, {"faults": {"loss": other}})
+    with pytest.raises(ValueError, match="unknown"):
+        checkpoint.attach_resume_faults(bare, {"faults": {"writes": loss}})
+    with pytest.raises(ValueError, match="rounds"):
+        checkpoint.attach_resume_faults(bare, {"faults": {"loss": loss[:4]}})
+
+
+@pytest.mark.slow  # tier-1 budget; the multichip CI job runs this file unfiltered
+def test_sparse_resume_bit_identical_under_active_plan(tmp_path):
+    """Satellite pin for the resume asymmetry: a sparse run under an
+    active fault plan, persisted mid-run WITH its fault axes and resumed
+    against a schedule rebuilt WITHOUT them, must end bit-identical to
+    the uninterrupted run. Before the fix the resume point silently
+    dropped the plan and diverged."""
+    from corrosion_tpu.models.baselines import anywrite_sparse
+
+    cfg, topo, sched = anywrite_sparse(
+        n=32, w_hot=8, rounds=16, n_regions=4, epoch_rounds=8, cohort=4,
+        burst_writes=2, samples=16, k_dev=8, partition=True, seed=3,
+    )
+    assert sched.partition is not None  # the plan must actually be active
+    mesh = reshard.virtual_mesh(1)
+    n_samples = len(sched.sample_writer)
+
+    *ref_state, ref_curves, _info = shard_driver.simulate_sparse_sharded(
+        cfg, topo, sched, mesh, seed=0
+    )
+
+    *_pre, prefix_curves, info = shard_driver.simulate_sparse_sharded(
+        cfg, topo, sched, mesh, seed=0, stop_after_epoch=0
+    )
+    resume = info["resume"]
+    path = str(tmp_path / "sparse.npz")
+    checkpoint.save_sparse_resume(
+        path,
+        {
+            "sstate": jax.device_get(resume["sstate"]),
+            "swim": jax.device_get(resume["swim"]),
+            "vis_round": jax.device_get(resume["vis_round"]),
+            "planner": resume["planner"],
+            "next_epoch": int(resume["next_epoch"]),
+        },
+        schedule=sched, fingerprint="sp-1",
+    )
+    loaded = checkpoint.load_sparse_resume(
+        path, cfg, n_samples, expect_fingerprint="sp-1"
+    )
+    assert "partition" in loaded["faults"]
+
+    bare = _strip_fault_axes(sched)
+    restored_sched = checkpoint.attach_resume_faults(bare, loaded)
+    np.testing.assert_array_equal(restored_sched.partition, sched.partition)
+
+    node = shard_driver.node_spec_entry(mesh)
+    tree = (loaded["sstate"], loaded["swim"], loaded["vis_round"])
+    specs = (
+        mesh_mod.sparse_state_specs(loaded["sstate"], mesh),
+        mesh_mod.node_major_specs(loaded["swim"], mesh),
+        P(None, node),
+    )
+    placed, _rec = reshard.place_reconciled(tree, specs, mesh)
+    *fin_state, tail_curves, _info2 = shard_driver.simulate_sparse_sharded(
+        cfg, topo, restored_sched, mesh, seed=0,
+        resume={
+            "sstate": placed[0], "swim": placed[1], "vis_round": placed[2],
+            "planner": loaded["planner"],
+            "next_epoch": loaded["next_epoch"],
+        },
+    )
+    assert el_report.diff_trees(tuple(fin_state), tuple(ref_state)) == []
+    split = 8  # one epoch
+    assert el_report.diff_curves(
+        prefix_curves, el_report.slice_curves(ref_curves, 0, split)
+    ) == []
+    assert el_report.diff_curves(
+        tail_curves, el_report.slice_curves(ref_curves, split)
+    ) == []
+
+
+# -- schedule windowing -------------------------------------------------------
+
+
+def test_schedule_slice_windows_faults_keeps_samples_absolute():
+    _cfg, _topo, sched = _tiny_dense(rounds=8)
+    loss = np.linspace(0, 1, 8 * 2, dtype=np.float32).reshape(8, 2)
+    sched = dataclasses.replace(sched, loss=loss)
+    sl = reshard.schedule_slice(sched, 2, 6)
+    assert sl.rounds == 4
+    np.testing.assert_array_equal(sl.writes, sched.writes[2:6])
+    np.testing.assert_array_equal(sl.loss, loss[2:6])
+    assert sl.kill is None  # None-safe: absent axes stay absent
+    # Visibility samples are tracked in ABSOLUTE rounds by the engines.
+    np.testing.assert_array_equal(sl.sample_round, sched.sample_round)
+    np.testing.assert_array_equal(sl.sample_writer, sched.sample_writer)
+
+
+# -- preempt on the fault plane -----------------------------------------------
+
+
+def test_preempt_fault_validation_and_plan_split():
+    f = Fault("preempt", 3, 4, device=2)
+    assert f.clears_at == 4
+    assert Fault.from_dict(f.to_dict()) == f
+    with pytest.raises(ValueError, match="device"):
+        Fault("preempt", 3, 4)
+    with pytest.raises(ValueError, match="instantaneous"):
+        Fault("preempt", 3, 9, device=2)
+    with pytest.raises(ValueError, match="preempt-only"):
+        Fault("churn", 3, 4, nodes=(1,), device=2)
+
+    plan = FaultPlan(
+        rounds=12,
+        faults=(
+            Fault("preempt", 7, 8, device=1),
+            Fault("loss", 2, 5, prob=0.5),
+            Fault("preempt", 3, 4, device=6),
+        ),
+    )
+    assert plan.preempt_events() == ((3, 6), (7, 1))  # sorted worklist
+    assert all(f.kind != "preempt" for f in plan.kernel_plan().faults)
+    # compile() lowers only kernel faults — a preempt is host-side — but
+    # the heal horizon still covers it.
+    compiled = plan.compile(16, 2)
+    assert compiled.loss is not None and compiled.kill is None
+    assert plan.heal_round >= 8
+    assert FaultPlan.from_json(plan.to_json()).faults == plan.faults
+
+
+@needs8
+def test_poison_lost_shard_destroys_exactly_one_block():
+    cfg, _topo, sched = _tiny_dense(n=16)
+    host = jax.device_get(init_cluster(cfg, len(sched.sample_writer)))
+    mesh = reshard.virtual_mesh(8)
+    specs = mesh_mod.cluster_state_specs(host, mesh)
+    poisoned, n_leaves = poison_lost_shard(host, specs, mesh, 3)
+    assert n_leaves > 0
+    # Node-major leaves: rows [6, 8) belong to device 3 on a 16-node
+    # 8-device mesh; every other row must be untouched.
+    a, b = np.asarray(host.data.contig), np.asarray(poisoned.data.contig)
+    assert not np.array_equal(a[6:8], b[6:8])
+    np.testing.assert_array_equal(np.delete(a, [6, 7], axis=0),
+                                  np.delete(b, [6, 7], axis=0))
+    # Replicated leaves (the writer heads) survive the kill intact.
+    np.testing.assert_array_equal(
+        np.asarray(host.data.head), np.asarray(poisoned.data.head)
+    )
+    with pytest.raises(ValueError, match="outside"):
+        poison_lost_shard(host, specs, mesh, 8)
+
+
+# -- the mesh-spec reshard contract (satellite: property over builders) -------
+
+
+def _contract_states():
+    """(engine, host_tree, specs_fn) for every engine state family. All
+    node counts divide every device count in the matrix."""
+    from corrosion_tpu.models.baselines import anywrite_sparse
+    from corrosion_tpu.ops import sparse_writers as sw_ops
+    from corrosion_tpu.ops import swim as swim_ops
+    from corrosion_tpu.ops.chunks import ChunkConfig, init_chunks
+    from corrosion_tpu.sim import invariants as inv
+    from corrosion_tpu.sim import mixed_engine
+
+    out = []
+    cfg, _topo, sched = _tiny_dense(n=16)
+    dense = jax.device_get(init_cluster(cfg, len(sched.sample_writer)))
+    out.append(("dense", dense, mesh_mod.cluster_state_specs))
+
+    scfg, _st, ssched = anywrite_sparse(
+        n=32, w_hot=8, rounds=16, n_regions=4, epoch_rounds=8, cohort=4,
+        burst_writes=2, samples=16, k_dev=8, seed=3,
+    )
+    sparse = jax.device_get((
+        sw_ops.init_sparse(scfg.gossip, scfg.sparse),
+        swim_ops.impl(scfg.swim).init_state(scfg.swim),
+        np.zeros((len(ssched.sample_writer), scfg.n_nodes), np.int32),
+    ))
+
+    def sparse_specs(tree, mesh):
+        return (
+            mesh_mod.sparse_state_specs(tree[0], mesh),
+            mesh_mod.node_major_specs(tree[1], mesh),
+            P(None, shard_driver.node_spec_entry(mesh)),
+        )
+
+    out.append(("sparse", sparse, sparse_specs))
+
+    ccfg = ChunkConfig(
+        n_nodes=16, n_streams=2, cap=8, chunk_len=64, fanout=2, k_in=4,
+        sync_interval=2, gap_requests=2, sync_seq_budget=256,
+    )
+    chunk = jax.device_get((
+        init_chunks(
+            ccfg, np.asarray([0, 7], np.int32),
+            np.asarray([255, 255], np.int32),
+        ),
+        np.full((ccfg.n_nodes, ccfg.n_streams), -1, np.int32),
+    ))
+
+    def chunk_specs(tree, mesh):
+        return (
+            mesh_mod.node_major_specs(tree[0], mesh),
+            P(shard_driver.node_spec_entry(mesh), None),
+        )
+
+    out.append(("chunk", chunk, chunk_specs))
+
+    mcfg, mccfg, mtopo, msched, mstreams = inv._mixed_scenario(
+        FaultPlan(rounds=24, name="contract"), 0
+    )
+    mixed = jax.device_get(mixed_engine.init_mixed_state(
+        mcfg, mccfg, mtopo, msched, mstreams
+    ))
+    out.append(("mixed", mixed, mesh_mod.mixed_state_specs))
+    return out
+
+
+@needs8
+def test_mesh_specs_are_a_reshard_bijection():
+    """The reshard contract on the ONE spec source: for every engine
+    state and every (D, D′) ∈ {1,2,4,8}², place → gather → re-place
+    loses nothing (bit-exact round trip, no silent truncation or
+    padding) and ``predicted_per_device_bytes`` matches the live shards
+    byte-exact on BOTH meshes (place_reconciled raises otherwise)."""
+    meshes = {d: reshard.virtual_mesh(d) for d in (1, 2, 4, 8)}
+    for engine_name, host, specs_fn in _contract_states():
+        for d_a, d_b in itertools.product((1, 2, 4, 8), repeat=2):
+            placed_a, rec_a = reshard.place_reconciled(
+                host, specs_fn(host, meshes[d_a]), meshes[d_a]
+            )
+            host_a = jax.device_get(placed_a)
+            assert el_report.diff_trees(
+                host, host_a, f"{engine_name} D={d_a}: "
+            ) == []
+            placed_b, rec_b = reshard.place_reconciled(
+                host_a, specs_fn(host_a, meshes[d_b]), meshes[d_b]
+            )
+            assert el_report.diff_trees(
+                host, jax.device_get(placed_b),
+                f"{engine_name} {d_a}->{d_b}: ",
+            ) == []
+            assert rec_a["ok"] and rec_b["ok"]
+            assert rec_a["devices"] == d_a and rec_b["devices"] == d_b
+
+
+# -- chunk-engine resume seam (single device, tier-1 sized) -------------------
+
+
+def test_chunk_resume_bit_identical_single_device():
+    from corrosion_tpu.ops.chunks import ChunkConfig
+
+    ccfg = ChunkConfig(
+        n_nodes=16, n_streams=2, cap=8, chunk_len=64, fanout=2, k_in=4,
+        sync_interval=2, gap_requests=2, sync_seq_budget=256,
+    )
+    origin = np.asarray([0, 7], np.int32)
+    last_seq = np.asarray([255, 255], np.int32)
+    mesh = reshard.virtual_mesh(1)
+
+    ref_state, ref_m = shard_driver.simulate_chunks_sharded(
+        ccfg, origin, last_seq, 8, mesh, seed=0
+    )
+    state, m1 = shard_driver.simulate_chunks_sharded(
+        ccfg, origin, last_seq, 4, mesh, seed=0
+    )
+    final, m2 = shard_driver.simulate_chunks_sharded(
+        ccfg, origin, last_seq, 4, mesh, seed=0,
+        state=state, vis=m1["vis"], start_round=4,
+    )
+    assert el_report.diff_trees(
+        jax.device_get((final, m2["vis"])),
+        jax.device_get((ref_state, ref_m["vis"])),
+    ) == []
+    stitched = {
+        k: np.concatenate([np.asarray(m1["curves"][k]), np.asarray(v)])
+        for k, v in m2["curves"].items()
+    }
+    assert el_report.diff_curves(stitched, ref_m["curves"]) == []
+
+
+# -- budget gate --------------------------------------------------------------
+
+
+def _gate_scenario(**over):
+    s = {
+        "scenario": "drill", "bit_identical": True, "mismatches": [],
+        "reconcile": {"ok": True}, "violations": [],
+        "machinery": {"fired": True}, "wall_s": {"run": 1.0}, "ok": True,
+    }
+    s.update(over)
+    return s
+
+
+def _gate_budget(**over):
+    b = {
+        "tolerance": 2.0, "require_bit_identical": 1, "require_reconcile": 1,
+        "require_machinery_fired": 1, "oracle_violations_max": 0,
+        "scenarios": {"drill": {"wall_ceiling_s": 1.0}},
+    }
+    b.update(over)
+    return b
+
+
+def test_elastic_budget_gate_scales_only_wall():
+    report = {"scenarios": [_gate_scenario()]}
+    gate = el_report.check_elastic_budget(report, _gate_budget())
+    assert gate["ok"] and gate["breaches"] == []
+    # wall 1.0 passes only because the 2x tolerance scales the 1.0
+    # ceiling; the same wall breaches at tolerance 0.5.
+    gate = el_report.check_elastic_budget(
+        report, _gate_budget(tolerance=0.5)
+    )
+    assert not gate["ok"] and "wall" in gate["breaches"][0]
+
+
+@pytest.mark.parametrize(
+    "over, needle",
+    [
+        ({"bit_identical": False}, "bit-identical"),
+        ({"reconcile": {"ok": False}}, "reconcile"),
+        ({"violations": ["x"], "ok": False}, "violation"),
+        ({"machinery": {"fired": False}}, "machinery"),
+        ({"scenario": "other"}, "missing"),
+    ],
+)
+def test_elastic_budget_gate_never_scales_survival(over, needle):
+    """The survival invariants breach at ANY tolerance."""
+    report = {"scenarios": [_gate_scenario(**over)]}
+    gate = el_report.check_elastic_budget(
+        report, _gate_budget(tolerance=1e9)
+    )
+    assert not gate["ok"]
+    assert any(needle in b for b in gate["breaches"])
+
+
+# -- endurance tie-in: restart classification across re-attach ----------------
+
+
+def test_series_attach_adopts_and_classifies_restart(tmp_path):
+    """An in-process reshard/preemption re-attaches the recorder (same
+    path) and restarts its counters from zero; the replayed series must
+    show ONE header and the reset classified `restart` — not a wedge or
+    leak fake (the soak_preempt scenario pins the same end to end)."""
+    from corrosion_tpu.obs import endurance
+    from corrosion_tpu.obs import series as series_mod
+    from corrosion_tpu.utils.metrics import MetricsRegistry
+
+    path = str(tmp_path / "series.jsonl")
+    rec = series_mod.MetricSeriesRecorder.attach(
+        path, clock=None, source="t", mode="w"
+    )
+    try:
+        reg = MetricsRegistry()
+        for t in range(20):
+            if t == 10:  # the preempted process relaunches
+                reg = MetricsRegistry()
+                rec2 = series_mod.MetricSeriesRecorder.attach(path)
+                assert rec2 is rec  # adopted, not reopened
+            reg.counter("corro_changes_committed").inc(10.0)
+            reg.counter("corro_changes_applied").inc(10.0)
+            reg.gauge("corro_sync_needs").set(0.0)
+            rec.sample(reg, t=float(t))
+    finally:
+        rec.close()
+        rec.close()  # one close per attach (refcounted)
+
+    data = series_mod.replay_series(path)
+    assert len(data["headers"]) == 1
+    rep = endurance.build_report(
+        data["samples"], t_scale_s=1.0, label="attach-pin"
+    )
+    resets = rep["resets"]["corro_changes_committed"]
+    assert resets["events"] == 1 and set(resets["kinds"]) == {"restart"}
+    assert not any(w["wedged"] for w in rep["wedges"].values())
+    assert rep["ok"], rep["breaches"]
+
+
+# -- the standing drills (multichip CI job) -----------------------------------
+
+
+@needs8
+@pytest.mark.slow  # tier-1 budget; the multichip CI job runs this file unfiltered
+@pytest.mark.parametrize("d_from, d_to", scenarios.RESHARD_MATRIX)
+def test_reshard_dense_matrix_bit_identical(tmp_path, d_from, d_to):
+    rep = scenarios.run_reshard_scenario(
+        "dense", d_from, d_to, checkpoint_dir=str(tmp_path)
+    )
+    assert rep["bit_identical"], rep["mismatches"]
+    assert rep["reconcile"]["ok"]
+    assert rep["checkpoint"]["schema"] == "corro-checkpoint/1"
+    assert rep["checkpoint"]["mesh"] == list(
+        reshard.mesh_dims(reshard.virtual_mesh(d_from))
+    )
+    assert rep["ok"]
+
+
+@needs8
+@pytest.mark.slow  # tier-1 budget; the multichip CI job runs this file unfiltered
+@pytest.mark.parametrize("engine", ["sparse", "chunk", "mixed"])
+def test_reshard_other_engines_bit_identical(tmp_path, engine):
+    rep = scenarios.run_reshard_scenario(
+        engine, 4, 8, checkpoint_dir=str(tmp_path)
+    )
+    assert rep["bit_identical"], rep["mismatches"]
+    assert rep["reconcile"]["ok"] and rep["ok"]
+
+
+@needs8
+@pytest.mark.slow  # tier-1 budget; the multichip CI job runs this file unfiltered
+def test_preempt_scenario_survives_with_machinery_fired(tmp_path):
+    rep = scenarios.run_preempt_scenario(checkpoint_dir=str(tmp_path))
+    assert rep["violations"] == []
+    assert rep["bit_identical"], rep["mismatches"]
+    mach = rep["machinery"]
+    assert mach["fired"] and mach["preempts_fired"] == 2
+    assert mach["poison_changed"] and mach["replay_identical"]
+    assert mach["gap_rounds_replayed"] > 0
+    assert rep["reconcile"]["ok"] and rep["reconcile"]["count"] == 2
+    assert rep["ok"]
+
+
+@needs8
+@pytest.mark.slow  # tier-1 budget; the multichip CI job runs this file unfiltered
+def test_soak_preempt_classifies_recoveries_as_restarts(tmp_path):
+    rep = scenarios.run_soak_preempt_scenario(
+        str(tmp_path / "series.jsonl")
+    )
+    assert rep["violations"] == []
+    e = rep["endurance"]
+    assert e["ok"] and e["detectors_armed"]["wedge"]
+    for stem in ("corro_changes_committed", "corro_changes_applied"):
+        assert set(e["resets"][stem]["kinds"]) == {"restart"}
+    assert rep["ok"]
