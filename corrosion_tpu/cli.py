@@ -141,6 +141,11 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="WAN variant: 4 regions on the synthetic "
                      "circle geography with the propagation-topology "
                      "plane enabled (the `obs epidemic` source)")
+    orc.add_argument("--adaptive", action="store_true",
+                     help="enable the adaptive-dissemination plane at "
+                     "the committed health.ADAPTIVE_GOSSIP tuning "
+                     "(geo only; the EPIDEMIC_BASELINE_ADAPTIVE.json "
+                     "source — docs/PERFORMANCE.md)")
 
     # Propagation-topology plane (corrosion_tpu/obs/epidemic.py,
     # docs/OBSERVABILITY.md "Propagation plane"): SI-model fit over the

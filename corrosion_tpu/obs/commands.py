@@ -106,7 +106,7 @@ def run(args) -> int:
         facts = health.record_demo_flight(
             args.out, nodes=args.nodes, rounds=args.rounds,
             churn=args.churn, seed=args.seed, progress=sys.stderr,
-            geo=args.geo,
+            geo=args.geo, adaptive=getattr(args, "adaptive", False),
         )
         print(json.dumps(facts))
         return 0

@@ -99,6 +99,9 @@ def wipe_nodes(data, wipe: jax.Array, cfg):
         )
     q_writer = jnp.where(wipe[:, None], jnp.int32(-1), data.q_writer)
     q_tx = jnp.where(wipe[:, None], jnp.int32(0), data.q_tx)
+    # Duplicate-receipt counters restart with the queue (zero-width when
+    # rumor death is off, so this is a no-op then).
+    q_dup = jnp.where(wipe[:, None], jnp.int32(0), data.q_dup)
     cells = data.cells
     if cfg.n_cells > 0:
         n, k = cfg.n_nodes, cfg.n_cells
@@ -110,5 +113,5 @@ def wipe_nodes(data, wipe: jax.Array, cfg):
         )
     return data._replace(
         contig=contig, seen=seen, oo=oo, oo_any=oo_any,
-        q_writer=q_writer, q_tx=q_tx, cells=cells,
+        q_writer=q_writer, q_tx=q_tx, q_dup=q_dup, cells=cells,
     )

@@ -115,6 +115,52 @@ class GossipConfig:
     # same skip contract as the chaos axes. Requires the topology's
     # region count <= telemetry.PROP_REGIONS.
     prop_observe: bool = False
+    # ---- Adaptive dissemination plane (docs/PERFORMANCE.md "Adaptive
+    # dissemination"). Three composable mechanisms against the measured
+    # 97% redundant-delivery waste, all static and defaulting OFF (the
+    # chaos axes' zero-cost-skip contract: a default config's trace is
+    # bit-identical to the pre-adaptive plane).
+    #
+    # (a) Feedback-based rumor death (Demers counter kill): every
+    # delivered copy matching one of the receiver's own pending-queue
+    # entries (same writer, same version) is necessarily a redundant
+    # receipt of a rumor the node is actively spreading — count them
+    # per (node, slot) in ``q_dup`` and retire the entry once the count
+    # reaches ``rumor_kill_k``. A killed entry leaves the queue in the
+    # SAME round's rebuild, so its capacity slot is immediately
+    # available to that round's ``rebroadcast_intake`` admissions (the
+    # intake default is fanout*2 — without same-round frees a kill
+    # would leak the slot for a round; regression-pinned in
+    # tests/test_dissemination.py). 0 = off (``q_dup`` is zero-width).
+    rumor_kill_k: int = 0
+    # (b) Push->pull phase switching (Karp et al. phases): a node whose
+    # pending queue holds ONLY old rumors (version age above this
+    # threshold vs the writer's committed head; an empty queue does not
+    # saturate — a node with nothing to spread still discovers through
+    # its far slots) is "saturated": its far-fanout source slots stop
+    # pulling random queue rows (the redundant-copy firehose) and the
+    # node instead escalates to an immediate anti-entropy pull session
+    # (digests-then-deltas through the existing sync-plane grant path —
+    # no new wire format), without waiting out its sync cohort slot.
+    # 0 = off.
+    pull_switch_age: int = 0
+    # (c) Age-targeted forwarding: rebroadcast-intake priority flips
+    # from oldest-version-first (the measured pathology: old saturated
+    # versions monopolize the fanout*2 intake slots) to youngest-first,
+    # binned on the propagation plane's rumor-age edges
+    # (AGE_FORWARD_EDGES == telemetry.RUMOR_AGE_EDGES, pinned) with the
+    # version number as the in-bin tie-break.
+    age_forward: bool = False
+    # Anti-entropy candidate scoring sketch: above _EXACT_SCORE_MAX the
+    # scorer falls back from the exact per-writer deficit to a digest;
+    # >0 replaces the scalar total-progress digest with a B-bucket
+    # set-reconciliation sketch (per-writer progress folded into B
+    # contiguous writer blocks, per-bucket one-sided deficits quantized
+    # through the u8/bf16 ``digest_quantize`` path and summed) — a
+    # strictly tighter lower bound on the true deficit that still costs
+    # O(B) per candidate instead of O(W). The exact path is untouched
+    # and stays the pinned reference. 0 = legacy scalar digest.
+    sync_sketch_buckets: int = 0
 
     def __post_init__(self):
         if self.window_k < 0 or self.window_k % 32 != 0:
@@ -143,6 +189,28 @@ class GossipConfig:
             raise ValueError(
                 f"kernel_backend must be one of {onehot.BACKENDS} or "
                 f"None, got {self.kernel_backend!r}"
+            )
+        if self.rumor_kill_k < 0:
+            raise ValueError(
+                f"rumor_kill_k must be >= 0 (0 = off), got "
+                f"{self.rumor_kill_k}"
+            )
+        if self.pull_switch_age < 0:
+            raise ValueError(
+                f"pull_switch_age must be >= 0 (0 = off), got "
+                f"{self.pull_switch_age}"
+            )
+        if self.sync_sketch_buckets < 0:
+            raise ValueError(
+                f"sync_sketch_buckets must be >= 0 (0 = scalar digest), "
+                f"got {self.sync_sketch_buckets}"
+            )
+        if self.age_forward and self.rebroadcast_stale:
+            raise ValueError(
+                "age_forward orders the intake by version age; under "
+                "rebroadcast_stale the intake re-admits already-held old "
+                "versions, which the age priority would immediately "
+                "starve — enable one or the other"
             )
 
     @property
@@ -282,6 +350,11 @@ class DataState(NamedTuple):
     q_ver: jax.Array  # u32[N, Q]
     q_tx: jax.Array  # i32[N, Q] transmissions left
     q_gw: jax.Array  # u32[N, Q] global writer id (Q=0 unless track_writer_ids)
+    # Duplicate-receipt counter per pending entry (Demers rumor death,
+    # cfg.rumor_kill_k; Q=0 when the mechanism is off — the q_gw
+    # zero-width idiom). Receiver-local: never part of the shard
+    # driver's queue exchange.
+    q_dup: jax.Array  # i32[N, Q or 0]
     cells: crdt.CellState  # u32[N * K] x3 per-node registers (K=0: disabled)
 
 
@@ -297,6 +370,7 @@ def init_data(cfg: GossipConfig) -> DataState:
         q_ver=jnp.zeros((n, q), jnp.uint32),
         q_tx=jnp.zeros((n, q), jnp.int32),
         q_gw=jnp.zeros((n, q if cfg.track_writer_ids else 0), jnp.uint32),
+        q_dup=jnp.zeros((n, q if cfg.rumor_kill_k > 0 else 0), jnp.int32),
         cells=crdt.make_cells(n * cfg.n_cells),
     )
 
@@ -493,6 +567,105 @@ def _digest_score(defc: jax.Array, sync_budget: int) -> jax.Array:
     """Quantize a u32 digest deficit and widen back to i32 for the packed
     need/ring score. Exact (identity) below the saturation threshold."""
     return digest_quantize(defc, sync_budget).astype(jnp.int32)
+
+
+def bucket_sketch(contig: jax.Array, buckets: int) -> jax.Array:
+    """u32[N, B] set-reconciliation sketch of per-node progress
+    (cfg.sync_sketch_buckets): the writer axis folds into ``buckets``
+    contiguous blocks (zero-padded to a multiple) and each bucket sums
+    its block's watermarks. Per-bucket one-sided differences against a
+    peer lower-bound the true per-writer deficit bucket by bucket —
+    Σ_b max(0, Σ_{w∈b} c_w − Σ_{w∈b} s_w) <= Σ_w max(0, c_w − s_w) —
+    and equal it exactly when the peer dominates per-writer, so ranking
+    among genuinely-ahead candidates is preserved (the property
+    tests/test_perf_plane.py pins). B=1 degenerates to the legacy
+    total-progress digest."""
+    n, w = contig.shape
+    wp = -(-w // buckets) * buckets
+    c = jnp.pad(contig, ((0, 0), (0, wp - w)))
+    return jnp.sum(
+        c.reshape(n, buckets, wp // buckets), axis=2, dtype=jnp.uint32
+    )
+
+
+def _sketch_score(
+    skc: jax.Array,  # u32[..., B] candidate sketches
+    sk_self: jax.Array,  # u32[..., B] own sketch (broadcastable)
+    sync_budget: int,
+) -> jax.Array:
+    """i32[...]: summed per-bucket one-sided sketch deficit, each bucket
+    quantized through the same saturating u8/bf16 path as the scalar
+    digest (a bucket deeper than the session budget saturates — the
+    session cannot drain more anyway) then widened exactly."""
+    d = skc - jnp.minimum(skc, sk_self)
+    return jnp.sum(
+        digest_quantize(d, sync_budget).astype(jnp.int32), axis=-1
+    )
+
+
+# Age-bin upper edges (in versions behind the writer's committed head)
+# for the age-targeted forwarding priority (cfg.age_forward). Mirrors
+# the propagation plane's rumor-age histogram edges so the forwarding
+# policy and the observable that motivated it share one binning —
+# pinned equal to telemetry.RUMOR_AGE_EDGES in
+# tests/test_dissemination.py (ops cannot import sim).
+AGE_FORWARD_EDGES = (1, 2, 3, 4, 5, 6, 8, 10, 12, 16, 24, 32, 48, 64)
+
+
+def _intake_priority(
+    head: jax.Array,  # u32[W] committed heads (post this round's writes)
+    w_idx: jax.Array,  # i32[N, K] writer column per message (clamped)
+    v: jax.Array,  # u32[N, K] version per message
+    cfg: GossipConfig,
+    bk: str,
+) -> jax.Array:
+    """Rebroadcast-intake keep-priority (int32-safe, higher = kept).
+
+    Default: oldest versions first (-v, the historical policy). Under
+    ``age_forward``: youngest age bin first — age = head − v binned on
+    AGE_FORWARD_EDGES rides the high bits, the version number breaks
+    ties inside a bin (young-first there too). Packing is i32-safe:
+    15 bins * 2^24 + a 24-bit version clamp < 2^31."""
+    if not cfg.age_forward:
+        return -v.astype(jnp.int32)
+    hw = onehot.table_gather_u32(head, w_idx, backend=bk)
+    age = hw - jnp.minimum(v, hw)
+    b = jnp.zeros(age.shape, jnp.int32)
+    for e in AGE_FORWARD_EDGES:
+        b = b + (age > jnp.uint32(e)).astype(jnp.int32)
+    return -(b * jnp.int32(1 << 24)) + jnp.minimum(
+        v, jnp.uint32((1 << 24) - 1)
+    ).astype(jnp.int32)
+
+
+def _queue_saturation(
+    q_writer: jax.Array,  # i32[n, Q] local pending-queue writer slots
+    q_ver: jax.Array,  # u32[n, Q]
+    head: jax.Array,  # u32[W] committed heads
+    alive_r: jax.Array,  # bool[n]
+    cfg: GossipConfig,
+    bk: str | None = None,
+) -> jax.Array:
+    """bool[n]: push->pull saturation signal (cfg.pull_switch_age).
+
+    A node saturates when its pending queue is non-empty and EVERY
+    entry is old (version age above the threshold vs the writer's
+    committed head): its pushes are all stale and its far pulls mostly
+    duplicate. An empty queue does not saturate — a node with nothing
+    to spread still discovers new rumors through its far slots. Shared
+    by the broadcast round (far-slot suppression) and the sync round
+    (pull escalation) so the two halves of the phase switch act on the
+    same signal."""
+    occ = q_writer >= 0
+    if bk is None:
+        hq = head[jnp.maximum(q_writer, 0)]
+    else:
+        hq = onehot.table_gather_u32(
+            head, jnp.maximum(q_writer, 0), backend=bk
+        )
+    age_q = hq - jnp.minimum(q_ver, hq)
+    young = occ & (age_q <= jnp.uint32(cfg.pull_switch_age))
+    return alive_r & jnp.any(occ, axis=1) & ~jnp.any(young, axis=1)
 
 
 def _merge_versions_dense(
@@ -753,6 +926,28 @@ def _broadcast_round(
             & (alive_i[src] > 0)
             & (src != nodes[:, None])
         )
+        # ---- (b) push->pull phase switching (adaptive dissemination) --
+        # Saturated receivers (only old rumors queued) drop their
+        # far-slot pulls — the redundant-copy firehose once coverage
+        # saturates — and escalate to a digest pull in this round's
+        # sync stage instead (_sync_round). Near/ring-0 slots stay on:
+        # young rumors still percolate within the region. Local-only
+        # inputs (own queue rows + replicated heads), so the sharded
+        # round needs no extra exchange.
+        if cfg.pull_switch_age > 0 and cfg.fanout_far > 0:
+            sat = _queue_saturation(
+                data.q_writer, data.q_ver, head, alive_r, cfg, bk=bk
+            )
+            link_ok = jnp.concatenate(
+                [
+                    link_ok[:, : cfg.fanout_near],
+                    link_ok[:, cfg.fanout_near :] & ~sat[:, None],
+                ],
+                axis=1,
+            )
+            n_pulls = jnp.sum(sat, dtype=jnp.uint32)
+        else:
+            n_pulls = jnp.uint32(0)
         # ---- 3. delivery (row-local sorted pass per receiver) --------------
         # Gathered message (receiver row, src f, slot q) → [N, K = F·Q] of
         # (writer, version, tx). Promotion must respect version order: sort
@@ -781,6 +976,58 @@ def _broadcast_round(
             ),
         )
         n_msgs = jnp.sum(m_ok)
+        # ---- (a) feedback rumor death: duplicate-receipt counting -----
+        # Two duplicate-feedback signals, both counted per (node, slot)
+        # against the PRE-rebuild queue layout, post-loss (a lost copy
+        # is not a receipt):
+        #
+        # 1. Receiver-side (the pull flavor of the Demers counter): a
+        #    delivered copy matching one of the receiver's OWN pending
+        #    entries (same writer, same version) is necessarily a
+        #    redundant receipt of a rumor the node is actively
+        #    spreading. [n, Q, kk] broadcast compare — Q and kk are
+        #    config-bounded (16 x fanout*16 at the defaults).
+        # 2. Sender-side (the push flavor — the dominant signal): every
+        #    delivered copy whose receiver already possessed the
+        #    version (v <= the receiver's pre-delivery watermark)
+        #    increments the SOURCE queue entry's counter. Delivered
+        #    copies ARE source queue slots in the pull/gather model
+        #    ([n, F, Q] = qf[src]), so the feedback is one row
+        #    scatter-add back onto the source rows — the same
+        #    full-shape-scatter + psum pattern as the ``pulled`` budget
+        #    burn when sharded (one extra [N, Q] reduction per round,
+        #    only when the mechanism is on).
+        if cfg.rumor_kill_k > 0:
+            hits = jnp.sum(
+                m_ok[:, None, :]
+                & (m_w[:, None, :] == data.q_writer[:, :, None])
+                & (m_v[:, None, :] == data.q_ver[:, :, None]),
+                axis=2,
+                dtype=jnp.int32,
+            )  # i32[n, Q]
+            cw = _onehot_rowgather(
+                contig_before, jnp.maximum(m_w, 0), backend=bk
+            )  # u32[n, kk] receiver's pre-delivery watermark per copy
+            red = (
+                m_ok & (m_v <= cw)
+            ).reshape(n * f, q_cap).astype(jnp.int32)
+            src_flat = src.reshape(n * f)
+            if shard is None:
+                hits = hits + (
+                    jnp.zeros((n, q_cap), jnp.int32)
+                    .at[src_flat]
+                    .add(red, mode="drop")
+                )
+            else:
+                fb = (
+                    jnp.zeros((n_total, q_cap), jnp.int32)
+                    .at[src_flat]
+                    .add(red, mode="drop")
+                )
+                fb = jax.lax.psum(fb, shard.axes)
+                hits = hits + jax.lax.dynamic_slice_in_dim(
+                    fb, shard.row_start, n, axis=0
+                )
         k_in = cfg.rebroadcast_intake or cfg.fanout * 2
 
         # One-hot delivery is O(N·K·W) dense compute: a clear win while the
@@ -988,7 +1235,9 @@ def _broadcast_round(
                 prop_fresh = fresh
             in_mask, in_payloads = routing.rebuild_bounded_queue(
                 fresh,
-                -v2.astype(jnp.int32),  # oldest versions first
+                # Oldest versions first by default; youngest age bin
+                # first under cfg.age_forward (mechanism (c)).
+                _intake_priority(head, w2, v2, cfg, bk),
                 (w2, v2, gw2) if track else (w2, v2),
                 k_in,
             )
@@ -1173,7 +1422,9 @@ def _broadcast_round(
                 in_budget = tx2 - 1
             in_mask, in_payloads = routing.rebuild_bounded_queue(
                 intake_ok,
-                -v2.astype(jnp.int32),  # oldest versions first, like the queue
+                # Oldest versions first by default (like the queue);
+                # youngest age bin first under cfg.age_forward.
+                _intake_priority(head, w2c, v2, cfg, bk),
                 (w2c, v2, in_budget, gw2) if track else (w2c, v2, in_budget),
                 k_in,
             )
@@ -1228,6 +1479,9 @@ def _broadcast_round(
         oo_new, oo_any_new = data.oo, data.oo_any
         n_degraded = jnp.uint32(0)
         n_lost = jnp.uint32(0)
+        n_pulls = jnp.uint32(0)
+        if cfg.rumor_kill_k > 0:
+            hits = jnp.zeros_like(data.q_dup)
         if cfg.prop_observe:
             prop_useful = jnp.uint32(0)
             prop_link = jnp.zeros(
@@ -1242,6 +1496,22 @@ def _broadcast_round(
         (data.q_writer >= 0) & sent_any[:, None], data.q_tx - 1,
         jnp.where(data.q_writer >= 0, data.q_tx, 0),
     )
+    old_live = (data.q_writer >= 0) & (old_tx > 0)
+    if cfg.rumor_kill_k > 0:
+        # ---- (a) rumor death: retire over-duplicated entries ----------
+        # The counter kill à la Demers: an entry whose accumulated
+        # duplicate receipts reach k leaves the rebuild THIS round —
+        # its capacity slot is immediately available to this round's
+        # intake admissions (rebuild_bounded_queue keeps the top
+        # ``capacity`` VALID candidates, so one fewer old candidate is
+        # one more intake candidate kept). ``prop_rumor_kills`` counts
+        # entries the kill retired that budgets alone would have kept.
+        q_dup2 = data.q_dup + hits
+        kill = (data.q_writer >= 0) & (q_dup2 >= cfg.rumor_kill_k)
+        n_kills = jnp.sum(kill & old_live, dtype=jnp.uint32)
+        old_live = old_live & ~kill
+    else:
+        n_kills = jnp.uint32(0)
     cand_w = jnp.concatenate([data.q_writer, new_writer, in_w], axis=1)
     cand_v = jnp.concatenate([data.q_ver, new_ver, in_v], axis=1)
     cand_tx = jnp.concatenate(
@@ -1254,7 +1524,7 @@ def _broadcast_round(
     )
     cand_ok = jnp.concatenate(
         [
-            (data.q_writer >= 0) & (old_tx > 0),
+            old_live,
             new_valid,
             in_mask,
         ],
@@ -1267,16 +1537,28 @@ def _broadcast_round(
         prio = cand_tx
     else:
         prio = -cand_v.astype(jnp.int32)
+    payloads = [cand_w, cand_v, cand_tx]
     if track:
-        cand_gw = jnp.concatenate([data.q_gw, new_gw, in_gw], axis=1)
-        keep, (q_writer, q_ver, q_tx, q_gw) = routing.rebuild_bounded_queue(
-            cand_ok, prio, (cand_w, cand_v, cand_tx, cand_gw), q_cap
+        payloads.append(jnp.concatenate([data.q_gw, new_gw, in_gw], axis=1))
+    if cfg.rumor_kill_k > 0:
+        # Surviving old entries carry their accumulated counter; new
+        # writes and intake admissions start at zero.
+        payloads.append(
+            jnp.concatenate(
+                [
+                    q_dup2,
+                    jnp.zeros((n, mw), jnp.int32),
+                    jnp.zeros(in_w.shape, jnp.int32),
+                ],
+                axis=1,
+            )
         )
-    else:
-        keep, (q_writer, q_ver, q_tx) = routing.rebuild_bounded_queue(
-            cand_ok, prio, (cand_w, cand_v, cand_tx), q_cap
-        )
-        q_gw = data.q_gw
+    keep, out = routing.rebuild_bounded_queue(
+        cand_ok, prio, tuple(payloads), q_cap
+    )
+    q_writer, q_ver, q_tx = out[0], out[1], out[2]
+    q_gw = out[3] if track else data.q_gw
+    q_dup = out[-1] if cfg.rumor_kill_k > 0 else data.q_dup
     q_writer = jnp.where(keep, q_writer, -1)
 
     applied_b = jnp.sum(
@@ -1292,11 +1574,12 @@ def _broadcast_round(
         if cfg.prop_observe:
             (
                 applied_b, n_msgs, n_merges, n_degraded, n_lost, oo_cnt,
-                prop_useful, prop_link,
+                prop_useful, prop_link, n_kills, n_pulls,
             ) = jax.lax.psum(
                 (
                     applied_b, n_msgs, n_merges, n_degraded, n_lost,
                     oo_any_new.astype(jnp.uint32), prop_useful, prop_link,
+                    n_kills, n_pulls,
                 ),
                 shard.axes,
             )
@@ -1334,6 +1617,12 @@ def _broadcast_round(
         stats["prop_dup"] = (
             n_msgs.astype(jnp.uint32) - prop_useful
         )
+        # Adaptive-dissemination counters: rumors retired by the
+        # duplicate-receipt kill (mechanism a) and nodes whose far-fanout
+        # slots flipped from push to pull this round (mechanism b). Both
+        # are exactly zero when the mechanisms are disabled.
+        stats["prop_kills"] = n_kills
+        stats["prop_pulls"] = n_pulls
     return (
         DataState(
             head=head,
@@ -1345,6 +1634,7 @@ def _broadcast_round(
             q_ver=q_ver,
             q_tx=q_tx,
             q_gw=q_gw,
+            q_dup=q_dup,
             cells=cells,
         ),
         stats,
@@ -1384,7 +1674,19 @@ def _sync_round(
     is cohort-sized — a sync_interval× cut in work and memory vs computing
     over all N rows. Without cohorts, all N rows are processed with a due
     mask (the jittered-phase model).
+
+    Under push→pull switching (cfg.pull_switch_age > 0) a SECOND session
+    runs after the scheduled one: nodes whose queues are saturated (only
+    old rumors pending — the same predicate that suppressed their
+    far-fanout pushes in _broadcast_round this round) pull
+    digests-then-deltas immediately instead of waiting out their cohort
+    slot. Nodes already due this round are excluded (phase == c IS cohort
+    membership, so the mask works for both scheduling modes) — no row
+    syncs twice, and with the mechanism off the extra session does not
+    exist (zero-cost-skip contract).
     """
+    if cfg.pull_switch_age > 0:
+        rng, k_esc = jax.random.split(rng)
     if topo.sync_cohorts is not None:
         if topo.sync_cohorts.shape[0] != cfg.sync_interval:
             raise ValueError(
@@ -1399,15 +1701,37 @@ def _sync_round(
         row_ok = (rows >= 0) & (
             alive.astype(jnp.int32)[jnp.maximum(rows, 0)] > 0
         )
-        return _sync_rows(
+        data, stats = _sync_rows(
             data, topo, alive, partition, jnp.maximum(rows, 0), row_ok,
             rng, cfg,
         )
-    nodes = jnp.arange(cfg.n_nodes)
-    due = alive & (
+    else:
+        nodes = jnp.arange(cfg.n_nodes)
+        due = alive & (
+            (round_idx + topo.sync_phase) % jnp.int32(cfg.sync_interval)
+            == 0
+        )
+        data, stats = _sync_rows(
+            data, topo, alive, partition, nodes, due, rng, cfg
+        )
+    if cfg.pull_switch_age == 0:
+        return data, stats
+    # ---- (b) pull escalation (adaptive dissemination) ------------------
+    # Saturation re-read from the post-broadcast queue so the escalated
+    # pull reflects what the node actually holds NOW; rows the scheduled
+    # session just served are excluded via the phase identity above.
+    bk = onehot.resolve_backend(cfg.kernel_backend)
+    sat = _queue_saturation(
+        data.q_writer, data.q_ver, data.head, alive, cfg, bk=bk
+    )
+    already = (
         (round_idx + topo.sync_phase) % jnp.int32(cfg.sync_interval) == 0
     )
-    return _sync_rows(data, topo, alive, partition, nodes, due, rng, cfg)
+    data, estats = _sync_rows(
+        data, topo, alive, partition, jnp.arange(cfg.n_nodes),
+        sat & ~already, k_esc, cfg,
+    )
+    return data, {k: stats[k] + estats[k] for k in stats}
 
 
 sync_round = partial(jax.jit, static_argnames=("cfg",))(_sync_round)
@@ -1470,9 +1794,20 @@ def _sync_rows(
     c_count = cfg.sync_candidates
     exact = r * cfg.n_writers * c_count <= _EXACT_SCORE_MAX
     total = None
+    sketch = None
     if not exact:
-        total = jnp.sum(data.contig, axis=1, dtype=jnp.uint32)
-        total_r = total[rows]
+        if cfg.sync_sketch_buckets > 0:
+            # Bucketed set-reconciliation sketch: B per-bucket one-sided
+            # differences instead of one scalar total — a strictly
+            # tighter deficit lower bound at B× the digest's gather
+            # width (still << the [R, C, W] exact gather). B=1 is
+            # bit-identical to the legacy total-progress digest
+            # (pinned in tests/test_perf_plane.py).
+            sketch = bucket_sketch(data.contig, cfg.sync_sketch_buckets)
+            sketch_r = sketch[rows]
+        else:
+            total = jnp.sum(data.contig, axis=1, dtype=jnp.uint32)
+            total_r = total[rows]
     if _BATCHED_SYNC:
         if exact:
             cc = data.contig[cand]  # u32[R, C, W] one tiled gather
@@ -1491,6 +1826,11 @@ def _sync_rows(
                 jnp.max(
                     jnp.where(ok_c[:, :, None], data.seen[cand], 0), axis=1
                 ),
+            )
+        elif sketch is not None:
+            skc = sketch[cand]  # u32[R, C, B] one tiled gather
+            defc = _sketch_score(
+                skc, sketch_r[:, None, :], cfg.sync_budget
             )
         else:
             tc = total[cand]  # u32[R, C]
@@ -1512,6 +1852,12 @@ def _sync_rows(
                 seen_r = jnp.maximum(
                     seen_r,
                     jnp.where(ok_c[:, c, None], data.seen[cand[:, c]], 0),
+                )
+            elif sketch is not None:
+                need_cols.append(
+                    _sketch_score(
+                        sketch[cand[:, c]], sketch_r, cfg.sync_budget
+                    )
                 )
             else:
                 tc = total[cand[:, c]]

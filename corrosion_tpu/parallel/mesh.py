@@ -169,6 +169,7 @@ def data_state_specs(d: DataState, mesh: Mesh, axis=None) -> DataState:
         q_ver=row,
         q_tx=row,
         q_gw=row,
+        q_dup=row,
         cells=jax.tree.map(lambda a: vec, d.cells),
     )
 

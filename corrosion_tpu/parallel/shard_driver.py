@@ -93,6 +93,10 @@ def _data_specs(mesh: Mesh) -> DataState:
         q_ver=P(node),
         q_tx=P(node),
         q_gw=P(node),
+        # Receiver-local duplicate counters: sharded like the queue but
+        # NEVER part of the queue exchange (senders don't need them), so
+        # the pinned xshard byte accounting is unchanged.
+        q_dup=P(node),
         cells=crdt.CellState(
             cl=P(node), col_version=P(node), value_rank=P(node)
         ),
@@ -257,7 +261,10 @@ def make_sharded_broadcast(mesh: Mesh):
             # Propagation plane: per-shard partial counts join the
             # round's coalesced psum inside the body, so the outputs
             # are replicated like every other stat.
-            stat_keys = stat_keys + ("prop_link", "prop_useful", "prop_dup")
+            stat_keys = stat_keys + (
+                "prop_link", "prop_useful", "prop_dup",
+                "prop_kills", "prop_pulls",
+            )
         stats_specs = {k: P() for k in stat_keys}
         in_specs = [dspecs, topo_specs, P(), P(), P(), P()]
         args = [data, topo, alive, partition, writes, rng]

@@ -62,6 +62,9 @@ def _scan_impl(state, vis, last_seq, alive, base_key, xs, cfg):
         # intake-accepted chunks are the useful pushes, and rumor age =
         # the round a (node, stream) pair first reassembled (streams
         # commit at round 0). Static skip when cfg.prop_observe is off.
+        # The adaptive-dissemination counters (prop_rumor_kills /
+        # prop_pull_rounds) zero-fill via prop_curves defaults: the
+        # chunk plane has no rebroadcast queue to kill from or pull to.
         prop_stats = telemetry_mod.prop_curves(
             cfg.prop_observe,
             stats["chunks_sent"].reshape(1, 1),

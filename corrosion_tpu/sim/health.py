@@ -689,6 +689,19 @@ def diff_reports(
 
 GEO_REGIONS = 4  # region count of the geo scenario family (<= PROP_REGIONS)
 
+# The committed adaptive-dissemination tuning for the geo scenario family
+# (docs/PERFORMANCE.md "Adaptive dissemination"): the three mechanisms
+# composed, measured on the 96x48 geo smoke against the push-only
+# baseline (EPIDEMIC_BASELINE.json vs EPIDEMIC_BASELINE_ADAPTIVE.json;
+# the `dissemination` entry of bench_budget.json gates the comparison in
+# CI). One dict so `obs record --adaptive`, the smoke, and the tests all
+# run the exact same knobs.
+ADAPTIVE_GOSSIP = {
+    "rumor_kill_k": 2,
+    "pull_switch_age": 2,
+    "age_forward": True,
+}
+
 
 def churned_demo_cluster(
     nodes: int = 128,
@@ -697,11 +710,17 @@ def churned_demo_cluster(
     churn: bool = True,
     seed: int = 0,
     geo: bool = False,
+    adaptive: bool = False,
 ):
     """Small dense cluster with a mid-run kill/revive wave of NON-writer
     nodes (writers stay up so sampled-write bookkeeping remains exact) —
     the one scenario builder shared by `obs record`, the CI convergence
     artifact, and the health-plane tests.
+
+    ``adaptive=True`` (geo only) additionally enables the adaptive
+    dissemination plane at the committed ``ADAPTIVE_GOSSIP`` tuning —
+    the same scenario, schedule, and RNG streams, so the push-only and
+    adaptive flights are directly comparable copy for copy.
 
     ``geo=True`` is the WAN variant of the same scenario family: the
     cluster splits into ``GEO_REGIONS`` contiguous regions on the
@@ -723,6 +742,12 @@ def churned_demo_cluster(
     from corrosion_tpu.sim.engine import Schedule
 
     n_writers = max(4, min(16, nodes // 8))
+    if adaptive and not geo:
+        raise ValueError(
+            "adaptive=True is defined for the geo scenario family only "
+            "(the flat variant's RNG stream is pinned pre-adaptive)"
+        )
+    adaptive_kw = dict(ADAPTIVE_GOSSIP) if adaptive else {}
     if geo:
         sizes = [nodes // GEO_REGIONS] * GEO_REGIONS
         sizes[-1] += nodes - sum(sizes)
@@ -736,6 +761,7 @@ def churned_demo_cluster(
         cfg, topo = _cfg(
             nodes, writers=writers, regions=sizes, region_rtt="geo",
             sync_interval=5, n_cells=0, prop_observe=True,
+            **adaptive_kw,
         )
         writer_set = set(writers)
         non_writers = np.asarray(
@@ -779,6 +805,7 @@ def record_demo_flight(
     seed: int = 0,
     progress=None,
     geo: bool = False,
+    adaptive: bool = False,
 ) -> dict:
     """Run a small dense cluster (optionally with churn) recording a
     flight JSONL — the `obs record` backend and the CI convergence
@@ -795,7 +822,8 @@ def record_demo_flight(
     from corrosion_tpu.sim.telemetry import FlightRecorder, KernelTelemetry
 
     cfg, topo, sched, kill_rounds = churned_demo_cluster(
-        nodes=nodes, rounds=rounds, churn=churn, seed=seed, geo=geo
+        nodes=nodes, rounds=rounds, churn=churn, seed=seed, geo=geo,
+        adaptive=adaptive,
     )
     tele = KernelTelemetry(
         engine="dense", progress=progress,
@@ -806,15 +834,26 @@ def record_demo_flight(
         max_chunk=max(rounds // 4, 1), telemetry=tele,
     )
     tele.recorder.close()
+    # Time-to-convergence: the first round after which outstanding need
+    # stays zero for the rest of the run (None = never converged) — the
+    # adaptive-vs-push equal-TTC gate's measured quantity.
+    need = np.asarray(curves["need"], dtype=np.float64)
+    nz = np.nonzero(need > 0)[0]
+    if need.size and float(need[-1]) == 0.0:
+        converged_round = int(nz[-1]) + 1 if nz.size else 0
+    else:
+        converged_round = None
     return {
         "flight": os.path.abspath(out),
         "nodes": nodes,
         "rounds": rounds,
         "geo": geo,
+        "adaptive": adaptive,
         "regions": GEO_REGIONS if geo else 1,
         "fanout": cfg.gossip.fanout,
         "kill_rounds": kill_rounds,
-        "need_last": float(np.asarray(curves["need"])[-1]),
+        "need_last": float(need[-1]) if need.size else None,
+        "converged_round": converged_round,
         "staleness_last": float(np.asarray(curves["staleness_sum"])[-1]),
         "mismatches_last": float(np.asarray(curves["mismatches"])[-1]),
     }

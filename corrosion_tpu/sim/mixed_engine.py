@@ -330,6 +330,8 @@ def mixed_round(
         bstats.get("prop_dup"),
         state.round - sample_round[:, None],
         newly,
+        kills=bstats.get("prop_kills"),
+        pulls=bstats.get("prop_pulls"),
     )
     stats = telemetry_mod.round_curves(
         msgs=bstats["msgs"],

@@ -313,6 +313,8 @@ def _epoch_scan_impl(
                 bstats.get("prop_dup"),
                 r - s_round[:, None],
                 newly,
+                kills=bstats.get("prop_kills"),
+                pulls=bstats.get("prop_pulls"),
             )
 
         stats = telemetry_mod.round_curves(

@@ -128,7 +128,16 @@ RUMOR_AGE_KEYS = tuple(
 PROP_CURVE_KEYS = (
     "prop_useful_msgs",
     "prop_dup_msgs",
-) + LINK_CURVE_KEYS + RUMOR_AGE_KEYS
+) + LINK_CURVE_KEYS + RUMOR_AGE_KEYS + (
+    # Adaptive-dissemination mechanism counters (exactly zero while the
+    # mechanisms are disabled): pending-queue entries retired by the
+    # duplicate-receipt kill (cfg.rumor_kill_k) and nodes whose
+    # far-fanout slots flipped push->pull this round
+    # (cfg.pull_switch_age). docs/PERFORMANCE.md "Adaptive
+    # dissemination" has the mechanism definitions.
+    "prop_rumor_kills",
+    "prop_pull_rounds",
+)
 
 # Canonical per-round curve keys. Every engine's scan body emits exactly
 # this set (superset of the former ad-hoc dicts); semantics per key are
@@ -222,7 +231,10 @@ def link_curves(link) -> dict:
     }
 
 
-def prop_curves(enabled: bool, link, useful, dup, lat_rounds, newly) -> dict:
+def prop_curves(
+    enabled: bool, link, useful, dup, lat_rounds, newly,
+    kills=None, pulls=None,
+) -> dict:
     """Per-round propagation-plane stats for a scan body, or {} when the
     plane is disabled (the static zero-cost skip: nothing traces).
 
@@ -230,7 +242,10 @@ def prop_curves(enabled: bool, link, useful, dup, lat_rounds, newly) -> dict:
     source region column), ``useful``/``dup`` the effective-fanout
     split, and ``lat_rounds``/``newly`` feed the rumor-age histogram —
     ages since commit of the pairs first delivered THIS round, on the
-    ``RUMOR_AGE_EDGES`` buckets. The analysis plane (CT010) resolves a
+    ``RUMOR_AGE_EDGES`` buckets. ``kills``/``pulls`` are the adaptive-
+    dissemination mechanism counters (None — engines without the
+    mechanisms, e.g. the chunk plane — emits zeros, matching the
+    mechanisms-off contract). The analysis plane (CT010) resolves a
     ``**prop_curves(...)`` expansion to ``PROP_CURVE_KEYS`` statically,
     so schema parity stays checkable.
     """
@@ -239,6 +254,12 @@ def prop_curves(enabled: bool, link, useful, dup, lat_rounds, newly) -> dict:
     out = {
         "prop_useful_msgs": useful.astype(jnp.uint32),
         "prop_dup_msgs": dup.astype(jnp.uint32),
+        "prop_rumor_kills": (
+            jnp.uint32(0) if kills is None else kills.astype(jnp.uint32)
+        ),
+        "prop_pull_rounds": (
+            jnp.uint32(0) if pulls is None else pulls.astype(jnp.uint32)
+        ),
     }
     out.update(link_curves(link))
     out.update(

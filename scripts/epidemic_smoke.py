@@ -15,8 +15,22 @@ repo root, diffs the fresh report against it at the CI tolerance. Exit
 0 = all green; 1 = a broken identity, a failed fit, or a baseline
 regression.
 
+Modes (docs/PERFORMANCE.md "Adaptive dissemination"):
+
+- default: legacy push-only run, gated against EPIDEMIC_BASELINE.json.
+- ``--adaptive``: same scenario with the adaptive-dissemination plane
+  on (``health.ADAPTIVE_GOSSIP``), gated against
+  EPIDEMIC_BASELINE_ADAPTIVE.json.
+- ``--compare``: BOTH runs back to back, additionally gated against
+  the ``dissemination`` entry of ``bench_budget.json`` — the adaptive
+  redundancy ceiling, the convergence requirement, and the
+  equal-or-better time-to-convergence bound. Those three are hard
+  product claims and are NEVER scaled by ``--tolerance`` (which only
+  loosens the per-metric baseline diffs).
+
 Usage: python scripts/epidemic_smoke.py [--out REPORT.json]
-       [--nodes N] [--rounds R] [--tolerance T]
+       [--nodes N] [--rounds R] [--tolerance T] [--adaptive]
+       [--compare]
 """
 
 from __future__ import annotations
@@ -33,6 +47,8 @@ import os
 import sys
 import tempfile
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _arg(flag: str, default, cast):
     for i, a in enumerate(sys.argv):
@@ -43,25 +59,28 @@ def _arg(flag: str, default, cast):
     return default
 
 
-def main() -> int:
+def _run(nodes: int, rounds: int, adaptive: bool):
+    """One fixed-seed geo/churn recording -> (facts, corro-epidemic/1)."""
     from corrosion_tpu.obs import epidemic
     from corrosion_tpu.sim import health
-
-    nodes = _arg("--nodes", 96, int)
-    rounds = _arg("--rounds", 48, int)
-    tolerance = _arg("--tolerance", 0.35, float)
-    out = _arg("--out", None, str)
 
     with tempfile.TemporaryDirectory() as tmp:
         flight = os.path.join(tmp, "epidemic_smoke.jsonl")
         facts = health.record_demo_flight(
             flight, nodes=nodes, rounds=rounds, churn=True, seed=0,
-            progress=sys.stderr, geo=True,
+            progress=sys.stderr, geo=True, adaptive=adaptive,
         )
         rep = epidemic.report_from_flight(
             flight, fanout=facts["fanout"], nodes=nodes,
             geo_regions=facts["regions"],
         )
+    return facts, rep
+
+
+def _check_one(facts, rep, tolerance: float, baseline_name: str):
+    """The per-run identity + fit + baseline-diff failures."""
+    from corrosion_tpu.obs import epidemic
+
     failures: list[str] = []
     if not rep["checks_ok"]:
         failures += [f"accounting: {p}" for p in rep["check_problems"]]
@@ -75,27 +94,143 @@ def main() -> int:
                 f"spread exponent {beta:.4f} outside (0, 1.1*theory="
                 f"{1.1 * theory:.4f}] — theory is an upper bound"
             )
-    baseline = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "EPIDEMIC_BASELINE.json",
-    )
+    baseline = os.path.join(REPO, baseline_name)
     diff = None
     if os.path.exists(baseline):
         base = epidemic.load_report(baseline)
         diff = epidemic.diff_reports(base, rep, tolerance=tolerance)
-        failures += [f"baseline: {r}" for r in diff["regressions"]]
+        failures += [
+            f"baseline({baseline_name}): {r}" for r in diff["regressions"]
+        ]
+    return failures, diff
 
-    report = {
-        "ok": not failures,
-        "failures": failures,
-        "facts": facts,
-        "report": rep,
-        "baseline_diff": diff,
+
+def _dissemination_gate(push_facts, push_rep, ada_facts, ada_rep):
+    """The bench_budget.json ``dissemination`` gate: the adaptive
+    plane's product claims. None of these bounds are tolerance-scaled
+    — a dup-share ceiling or a TTC regression is a real regression at
+    any jitter level (the same never-scaled rule the accounting and
+    fit checks follow)."""
+    failures: list[str] = []
+    path = os.path.join(REPO, "bench_budget.json")
+    with open(path) as f:
+        budget = json.load(f).get("dissemination")
+    if budget is None:
+        return ["bench_budget.json has no 'dissemination' entry"], None
+
+    dup_max = float(budget["dup_share_max"])
+    ttc_slack = int(budget.get("ttc_slack_rounds", 0))
+    dup = ada_rep["redundancy_ratio"]
+    if dup > dup_max:
+        failures.append(
+            f"adaptive redundancy_ratio {dup:.4f} > dup_share_max "
+            f"{dup_max:.2f} (never tolerance-scaled)"
+        )
+    push_ttc = push_facts.get("converged_round")
+    ada_ttc = ada_facts.get("converged_round")
+    if budget.get("require_converged"):
+        if push_ttc is None:
+            failures.append("push run did not converge (need_last != 0)")
+        if ada_ttc is None:
+            failures.append(
+                "adaptive run did not converge (need_last != 0)"
+            )
+        for name, facts in (("push", push_facts), ("adaptive", ada_facts)):
+            if facts.get("mismatches_last", 0):
+                failures.append(
+                    f"{name} run ended with "
+                    f"{facts['mismatches_last']} cell mismatches"
+                )
+    if push_ttc is not None and ada_ttc is not None:
+        if ada_ttc > push_ttc + ttc_slack:
+            failures.append(
+                f"adaptive time-to-convergence {ada_ttc} > push "
+                f"{push_ttc} + slack {ttc_slack} (never "
+                f"tolerance-scaled)"
+            )
+    summary = {
+        "dup_share": {"push": push_rep["redundancy_ratio"],
+                      "adaptive": dup, "max": dup_max},
+        "converged_round": {"push": push_ttc, "adaptive": ada_ttc,
+                            "slack_rounds": ttc_slack},
+        "msgs_total": {"push": push_rep["msgs_total"],
+                       "adaptive": ada_rep["msgs_total"]},
+        "spread_exponent": {"push": push_rep["spread_exponent"],
+                            "adaptive": ada_rep["spread_exponent"]},
+        "effective_fanout": {"push": push_rep["effective_fanout"],
+                             "adaptive": ada_rep["effective_fanout"]},
     }
+    return failures, summary
+
+
+def main() -> int:
+    from corrosion_tpu.obs import epidemic
+
+    nodes = _arg("--nodes", 96, int)
+    rounds = _arg("--rounds", 48, int)
+    tolerance = _arg("--tolerance", 0.35, float)
+    out = _arg("--out", None, str)
+    adaptive = "--adaptive" in sys.argv
+    compare = "--compare" in sys.argv
+
+    if compare:
+        push_facts, push_rep = _run(nodes, rounds, adaptive=False)
+        ada_facts, ada_rep = _run(nodes, rounds, adaptive=True)
+        failures, push_diff = _check_one(
+            push_facts, push_rep, tolerance, "EPIDEMIC_BASELINE.json"
+        )
+        ada_failures, ada_diff = _check_one(
+            ada_facts, ada_rep, tolerance,
+            "EPIDEMIC_BASELINE_ADAPTIVE.json",
+        )
+        failures += [f"adaptive: {m}" for m in ada_failures]
+        gate_failures, summary = _dissemination_gate(
+            push_facts, push_rep, ada_facts, ada_rep
+        )
+        failures += gate_failures
+        report = {
+            "ok": not failures,
+            "failures": failures,
+            "dissemination": summary,
+            "push": {"facts": push_facts, "report": push_rep,
+                     "baseline_diff": push_diff},
+            "adaptive": {"facts": ada_facts, "report": ada_rep,
+                         "baseline_diff": ada_diff},
+        }
+        rendered = [epidemic.render_report(push_rep),
+                    epidemic.render_report(ada_rep)]
+        if summary:
+            rendered.append(
+                "dissemination gate: dup {push:.4f} -> {adaptive:.4f} "
+                "(max {max:.2f})".format(**summary["dup_share"])
+                + ", ttc {push} -> {adaptive} (+{slack_rounds})".format(
+                    **summary["converged_round"]
+                )
+                + ", msgs {push} -> {adaptive}".format(
+                    **summary["msgs_total"]
+                )
+            )
+        body = "\n".join(rendered)
+    else:
+        facts, rep = _run(nodes, rounds, adaptive=adaptive)
+        failures, diff = _check_one(
+            facts, rep, tolerance,
+            "EPIDEMIC_BASELINE_ADAPTIVE.json" if adaptive
+            else "EPIDEMIC_BASELINE.json",
+        )
+        report = {
+            "ok": not failures,
+            "failures": failures,
+            "facts": facts,
+            "report": rep,
+            "baseline_diff": diff,
+        }
+        body = epidemic.render_report(rep)
+
     if out:
         with open(out, "w") as f:
             json.dump(report, f, indent=2)
-    print(epidemic.render_report(rep))
+    print(body)
     for fmsg in failures:
         print(f"epidemic_smoke: FAIL {fmsg}", file=sys.stderr)
     print(f"epidemic_smoke: {'OK' if not failures else 'FAILED'}",

@@ -1,0 +1,552 @@
+"""Adaptive dissemination plane tests (docs/PERFORMANCE.md "Adaptive
+dissemination").
+
+Pins the three config-gated mechanisms against the measured 97%
+redundant-delivery waste (ISSUE 20 / ROADMAP item 2):
+
+- (a) feedback-based rumor death (``rumor_kill_k``): the Demers counter
+  kill's two feedback signals — receiver-side (a delivered copy matches
+  the node's own pending entry) and sender-side (a redundant delivery
+  scatters a hit back to the SOURCE's queue slot) — at deterministic
+  two-node scale, plus the same-round slot-free regression: a kill must
+  free its ``rebroadcast_intake`` slot in the SAME round's rebuild, not
+  leak it for a round.
+- (b) push->pull phase switching (``pull_switch_age``): saturated nodes
+  stop pulling on their far slots and escalate through the sync plane;
+  the mechanism stays inert (zero ``prop_pull_rounds``) while no node
+  saturates.
+- (c) age-targeted forwarding (``age_forward``): intake priority by the
+  rumor-age bins — pinned to share the propagation plane's binning
+  (AGE_FORWARD_EDGES == telemetry.RUMOR_AGE_EDGES).
+
+Plus the plane-wide contracts: rumor-mass conservation (useful + dup ==
+msgs, age-hist mass == vis_count, link mass == msgs) under each
+mechanism alone and composed, under churn + injected loss; the
+mechanism counters are exactly zero when disabled; a neutral-threshold
+kill config is bit-identical to the off config (the machinery is
+observation-only until the threshold); sparse and mixed engines thread
+the counters with the same identities; and the measured geo win itself
+(adaptive dup share well below push at preserved convergence).
+"""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.obs import epidemic
+from corrosion_tpu.ops import gossip
+from corrosion_tpu.sim import health, simulate
+from corrosion_tpu.sim import telemetry as T
+
+ADAPTIVE = dict(health.ADAPTIVE_GOSSIP)
+
+MECHS = {
+    "kill": {"rumor_kill_k": 2},
+    "pull": {"pull_switch_age": 2},
+    "age": {"age_forward": True},
+    "composed": ADAPTIVE,
+    "composed_sketch": {**ADAPTIVE, "sync_sketch_buckets": 8},
+}
+
+
+def _geo_run(nodes=64, rounds=32, seed=0, gossip_kw=None, **sched_kw):
+    cfg, topo, sched, _ = health.churned_demo_cluster(
+        nodes=nodes, rounds=rounds, samples=32, churn=True, seed=seed,
+        geo=True,
+    )
+    if gossip_kw:
+        cfg = replace(cfg, gossip=replace(cfg.gossip, **gossip_kw))
+    for k, v in sched_kw.items():
+        setattr(sched, k, v)
+    final, curves = simulate(cfg, topo, sched, seed=seed)
+    return cfg, final, curves
+
+
+def _mass(curves, keys):
+    return sum(np.asarray(curves[k], np.float64) for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# Conservation + counters under each mechanism, composed, churn + loss
+
+
+@pytest.mark.parametrize("mech", sorted(MECHS), ids=sorted(MECHS))
+def test_conservation_under_mechanism_with_churn_and_loss(mech):
+    """The propagation plane's conservation identities are invariant
+    under every adaptive mechanism alone and composed, with the churn
+    wave AND injected per-region + probe loss in the same schedule —
+    killing or suppressing rumors changes how many copies flow, never
+    the accounting that partitions them."""
+    rng = np.random.default_rng(3)
+    rounds = 32
+    loss = (rng.random((rounds, health.GEO_REGIONS)) * 0.35).astype(
+        np.float32
+    )
+    probe = (rng.random(rounds) * 0.25).astype(np.float32)
+    _, _, curves = _geo_run(
+        rounds=rounds, seed=3, gossip_kw=MECHS[mech], loss=loss,
+        probe_loss=probe,
+    )
+    np.testing.assert_array_equal(
+        _mass(curves, T.LINK_CURVE_KEYS), curves["msgs"]
+    )
+    np.testing.assert_array_equal(
+        _mass(curves, T.RUMOR_AGE_KEYS), curves["vis_count"]
+    )
+    np.testing.assert_array_equal(
+        curves["prop_useful_msgs"] + curves["prop_dup_msgs"],
+        curves["msgs"],
+    )
+    ok, problems = epidemic.conservation_checks(curves)
+    assert ok, problems
+    assert curves["chaos_lost_msgs"].sum() > 0  # the loss really fired
+    # Mechanism counters fire iff their mechanism is on.
+    kills = float(np.asarray(curves["prop_rumor_kills"]).sum())
+    pulls = float(np.asarray(curves["prop_pull_rounds"]).sum())
+    if MECHS[mech].get("rumor_kill_k"):
+        assert kills > 0, "kill mechanism armed but never fired"
+    else:
+        assert kills == 0
+    if not MECHS[mech].get("pull_switch_age"):
+        assert pulls == 0
+    elif not MECHS[mech].get("rumor_kill_k"):
+        # Pure pull: saturation must fire. Composed runs may
+        # legitimately never saturate — the kill retires entries
+        # before they age past the switch threshold.
+        assert pulls > 0, "pull switch armed but never fired"
+
+
+def test_counters_zero_when_disabled():
+    """Satellite pin: the new PROP_CURVE_KEYS counters exist on every
+    run and are exactly zero under a default (non-adaptive) config."""
+    _, _, curves = _geo_run()
+    assert "prop_rumor_kills" in curves and "prop_pull_rounds" in curves
+    assert float(np.asarray(curves["prop_rumor_kills"]).sum()) == 0
+    assert float(np.asarray(curves["prop_pull_rounds"]).sum()) == 0
+
+
+# ---------------------------------------------------------------------------
+# The measured win, at test scale
+
+
+def test_adaptive_cuts_redundancy_at_preserved_convergence():
+    """The tentpole's claim at in-suite scale: on the geo churn
+    scenario the committed ADAPTIVE_GOSSIP tuning removes a large
+    share of redundant copies and still converges (need drains to
+    zero). The full-size CI gate (96x48, dup <= 0.80, equal-or-better
+    TTC) lives in scripts/epidemic_smoke.py --compare against the
+    bench_budget.json ``dissemination`` entry."""
+    _, _, push = _geo_run(seed=1)
+    _, _, ada = _geo_run(seed=1, gossip_kw=ADAPTIVE)
+
+    def _dup_share(curves):
+        msgs = float(np.asarray(curves["msgs"], np.float64).sum())
+        dup = float(np.asarray(curves["prop_dup_msgs"], np.float64).sum())
+        return dup / msgs
+
+    assert float(np.asarray(push["need"])[-1]) == 0
+    assert float(np.asarray(ada["need"])[-1]) == 0
+    assert float(np.asarray(ada["mismatches"])[-1]) == 0
+    push_dup, ada_dup = _dup_share(push), _dup_share(ada)
+    assert ada_dup < push_dup - 0.10, (push_dup, ada_dup)
+    # Fewer copies overall, not just a better ratio.
+    assert (
+        float(np.asarray(ada["msgs"], np.float64).sum())
+        < 0.5 * float(np.asarray(push["msgs"], np.float64).sum())
+    )
+
+
+def test_adaptive_halves_exchange_capacity():
+    """The wire-bytes half of the tentpole (docs/PERFORMANCE.md
+    "Adaptive dissemination"): the shard driver's queue exchange is
+    capacity-shaped (``traffic_model``: block = n_local * queue *
+    entry bytes), and the rumor kill keeps adaptive peak queue
+    occupancy ~2/node where push needs >8 — so the adaptive geo config
+    runs ``queue=8`` with EVERY round curve bit-identical to queue=16
+    (the halved capacity never binds), which halves the D=8 exchange
+    bytes by the model's exact arithmetic (measured==model is pinned
+    per round by epidemic.xshard_model_check / test_shard_driver)."""
+    _, _, push = _geo_run(seed=0)
+    _, _, a16 = _geo_run(seed=0, gossip_kw=ADAPTIVE)
+    _, _, a8 = _geo_run(seed=0, gossip_kw={**ADAPTIVE, "queue": 8})
+    nodes = 64
+    push_peak = float(np.asarray(push["queue_backlog"]).max()) / nodes
+    ada_peak = float(np.asarray(a16["queue_backlog"]).max()) / nodes
+    assert push_peak > 8, push_peak  # push can't drop to queue=8 freely
+    assert ada_peak <= 2, ada_peak
+    for k in a16:
+        np.testing.assert_array_equal(
+            np.asarray(a16[k]), np.asarray(a8[k]), err_msg=k
+        )
+    # The D=8 exchange-byte halving is exact model arithmetic on the
+    # (dcn, ici) wan mesh shape — device-free: traffic_model is pure.
+    from unittest import mock
+
+    from corrosion_tpu import parallel
+
+    mesh = mock.Mock()
+    mesh.axis_names = ("dcn", "ici")
+    mesh.shape = {"dcn": 2, "ici": 4}
+    g16 = gossip.GossipConfig(n_nodes=96, n_writers=12, queue=16)
+    g8 = replace(g16, queue=8)
+    tm16 = parallel.traffic_model(g16, mesh)
+    tm8 = parallel.traffic_model(g8, mesh)
+    for k in ("xshard_bytes_ici", "xshard_bytes_dcn"):
+        assert tm8[k] == tm16[k] / 2, k
+        assert tm8[k] > 0
+
+
+# ---------------------------------------------------------------------------
+# Deterministic two-node mechanics of the Demers counter kill
+
+
+def _mk2(**kw):
+    """Two nodes, both writers, one-slot queues, near-only fanout wide
+    enough that a cross pull happens on the pinned seed."""
+    cfg = gossip.GossipConfig(
+        n_nodes=2, n_writers=2, queue=1, max_writes_per_round=1,
+        fanout_near=2, fanout_far=0, queue_priority="version",
+        window_k=0, n_cells=0, prop_observe=True, **kw,
+    )
+    topo = gossip.make_topology([2], [0, 1])
+    return cfg, topo
+
+
+def _seed_queues(data, q_writer, q_ver, q_tx, contig, q_dup=None):
+    kw = dict(
+        head=jnp.asarray([1, 1], jnp.uint32),
+        contig=jnp.asarray(contig, jnp.uint32),
+        seen=jnp.asarray(contig, jnp.uint32),
+        q_writer=jnp.asarray(q_writer, jnp.int32),
+        q_ver=jnp.asarray(q_ver, jnp.uint32),
+        q_tx=jnp.asarray(q_tx, jnp.int32),
+    )
+    if q_dup is not None:
+        kw["q_dup"] = jnp.asarray(q_dup, jnp.int32)
+    return data._replace(**kw)
+
+
+def _one_round(cfg, topo, data, seed):
+    alive = jnp.ones(2, bool)
+    part = jnp.zeros((1, 1), bool)
+    w = jnp.zeros(2, jnp.uint32)
+    return gossip.broadcast_round(
+        data, topo, alive, part, w, jax.random.PRNGKey(seed), cfg
+    )
+
+
+def _cross_pull_seed(cfg, topo, data):
+    """First seed on which both nodes deliver to each other (the
+    receiver-centric sampling may draw self, which is skipped)."""
+    for seed in range(32):
+        _, stats = _one_round(cfg, topo, data, seed)
+        if int(stats["msgs"]) >= 2:
+            return seed
+    raise AssertionError("no cross-pull seed in 32 tries")
+
+
+def test_sender_and_receiver_kill_feedback():
+    """Both Demers feedback signals, isolated: both nodes hold the SAME
+    (writer 0, v1) rumor both already possess. Any delivered copy is
+    (i) redundant at the receiver — a sender-side hit scattered back to
+    the source's slot — and (ii) a match of the receiver's own pending
+    entry — a receiver-side hit. At k=1 one exchanged round retires the
+    rumor from both queues."""
+    cfg, topo = _mk2(rumor_kill_k=1)
+    data = _seed_queues(
+        gossip.init_data(cfg),
+        q_writer=[[0], [0]], q_ver=[[1], [1]], q_tx=[[6], [6]],
+        contig=[[1, 1], [1, 1]], q_dup=[[0], [0]],
+    )
+    seed = _cross_pull_seed(cfg, topo, data)
+    out, stats = _one_round(cfg, topo, data, seed)
+    assert int(stats["msgs"]) >= 2
+    assert int(stats["prop_dup"]) == int(stats["msgs"])  # all redundant
+    assert int(stats["prop_kills"]) == 2
+    np.testing.assert_array_equal(np.asarray(out.q_writer), [[-1], [-1]])
+
+
+def test_kill_threshold_not_reached_keeps_entry():
+    """One duplicate receipt below k leaves the entry alive with its
+    counter advanced — the kill is a threshold, not a latch."""
+    cfg, topo = _mk2(rumor_kill_k=8)
+    data = _seed_queues(
+        gossip.init_data(cfg),
+        q_writer=[[0], [0]], q_ver=[[1], [1]], q_tx=[[6], [6]],
+        contig=[[1, 1], [1, 1]], q_dup=[[0], [0]],
+    )
+    seed = _cross_pull_seed(cfg, topo, data)
+    out, stats = _one_round(cfg, topo, data, seed)
+    assert int(stats["prop_kills"]) == 0
+    np.testing.assert_array_equal(np.asarray(out.q_writer), [[0], [0]])
+    assert int(np.asarray(out.q_dup).sum()) >= 2  # hits accumulated
+
+
+def test_kill_frees_intake_slot_same_round():
+    """The satellite regression (``rebroadcast_intake`` interaction):
+    node 1's one-slot queue holds a saturated rumor at the kill
+    threshold while node 0 delivers a version node 1 lacks. The kill
+    must free the slot in the SAME round's rebuild so the fresh
+    rumor's intake admission lands — without the kill the old entry
+    wins the stable keep-priority tie and the fresh rumor is dropped
+    (the slot would leak a full round)."""
+    base = dict(
+        q_writer=[[0], [1]], q_ver=[[1], [1]], q_tx=[[6], [6]],
+        contig=[[1, 1], [0, 1]],
+    )
+    # With the kill: node 1's (writer 1, v1) entry dies, the freshly
+    # delivered (writer 0, v1) takes its slot this round.
+    cfg, topo = _mk2(rumor_kill_k=1)
+    data = _seed_queues(
+        gossip.init_data(cfg), q_dup=[[0], [1]], **base
+    )
+    seed = _cross_pull_seed(cfg, topo, data)
+    out, stats = _one_round(cfg, topo, data, seed)
+    assert int(stats["prop_useful"]) >= 1  # node 1 really got writer 0
+    assert int(stats["prop_kills"]) == 1
+    assert np.asarray(out.q_writer)[1].tolist() == [0]
+    assert np.asarray(out.q_ver)[1].tolist() == [1]
+    # Control (mechanism off): the old entry survives and the intake
+    # admission is dropped at capacity.
+    cfg0, topo0 = _mk2()
+    data0 = _seed_queues(gossip.init_data(cfg0), **base)
+    out0, stats0 = _one_round(cfg0, topo0, data0, seed)
+    assert int(stats0["prop_useful"]) >= 1
+    assert np.asarray(out0.q_writer)[1].tolist() == [1]
+
+
+# ---------------------------------------------------------------------------
+# Neutral thresholds: armed machinery below threshold changes nothing
+
+
+def _run_rounds(cfg, topo, data, rounds, seed=0, all_writers=False):
+    n = cfg.n_nodes
+    alive = jnp.ones(n, bool)
+    part = jnp.zeros((int(jnp.max(topo.region)) + 1,) * 2, bool)
+    key = jax.random.PRNGKey(seed)
+    stats = []
+    for r in range(rounds):
+        key, k1, k2 = jax.random.split(key, 3)
+        if all_writers and r < 6:
+            w = jnp.ones(cfg.n_writers, jnp.uint32)
+        else:
+            w = (
+                jnp.zeros(cfg.n_writers, jnp.uint32).at[r % cfg.n_writers]
+                .set(1)
+                if r < 6 else jnp.zeros(cfg.n_writers, jnp.uint32)
+            )
+        data, b = gossip.broadcast_round(
+            data, topo, alive, part, w, k1, cfg
+        )
+        data, s = gossip.sync_round(
+            data, topo, alive, part, jnp.int32(r), k2, cfg
+        )
+        stats.append((b, s))
+    return data, stats
+
+
+def _mk24(**kw):
+    cfg = gossip.GossipConfig(
+        n_nodes=24, n_writers=8, queue=4, prop_observe=True,
+        **{"sync_interval": 4, **kw},
+    )
+    topo = gossip.make_topology([6, 6, 6, 6], list(range(8)))
+    return cfg, topo, gossip.init_data(cfg)
+
+
+def test_unreachable_kill_threshold_bit_identical_to_off():
+    """A huge ``rumor_kill_k`` arms the whole counter plane (q_dup
+    tracking, feedback scatters, the extra rebuild payload) but can
+    never fire — the protocol state must be bit-identical to the off
+    config, every round stat equal, and the counter zero. This is the
+    disabled-flag zero-cost contract tested from the inside."""
+    cfg_off, topo, data0 = _mk24()
+    ref, stats_ref = _run_rounds(cfg_off, topo, data0, 12)
+    cfg_on, _, data1 = _mk24(rumor_kill_k=1 << 20)
+    got, stats_got = _run_rounds(cfg_on, topo, data1, 12)
+    for name in ref._fields:
+        if name in ("q_dup", "cells"):
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, name)),
+            np.asarray(getattr(got, name)), err_msg=name,
+        )
+    assert np.asarray(ref.q_dup).shape[1] == 0  # zero-width when off
+    assert np.asarray(got.q_dup).shape[1] == cfg_on.queue
+    for r, ((br, sr), (bg, sg)) in enumerate(zip(stats_ref, stats_got)):
+        for k in br:
+            if k in ("prop_kills", "prop_pulls"):
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(br[k]), np.asarray(bg[k]),
+                err_msg=f"round {r} {k}",
+            )
+        assert int(bg["prop_kills"]) == 0
+    for k in ("applied_sync", "sessions"):
+        assert [int(s[k]) for _, s in stats_ref] == [
+            int(s[k]) for _, s in stats_got
+        ], k
+
+
+def test_unreachable_pull_threshold_never_fires():
+    """A ``pull_switch_age`` far above any rumor age keeps every queue
+    entry "young": no node ever saturates, no far slot is suppressed,
+    no escalation session runs (zero prop_pulls and unchanged sync
+    session counts vs off)."""
+    cfg_off, topo, data0 = _mk24()
+    _, stats_ref = _run_rounds(cfg_off, topo, data0, 12)
+    cfg_on, _, data1 = _mk24(pull_switch_age=1 << 20)
+    _, stats_got = _run_rounds(cfg_on, topo, data1, 12)
+    assert all(int(b["prop_pulls"]) == 0 for b, _ in stats_got)
+    assert [int(s["sessions"]) for _, s in stats_ref] == [
+        int(s["sessions"]) for _, s in stats_got
+    ]
+
+
+def test_pull_escalation_heals_through_sync_plane():
+    """Mechanism (b) end to end at ops level: with an aggressive
+    switch age the saturated nodes' escalation sessions run through
+    the sync grant path and the cluster still fully converges. Every
+    writer commits each of the first 6 rounds so queued rumors really
+    age past the threshold (a single commit per writer pins every
+    rumor at version-age 0 and nothing would ever saturate)."""
+    cfg, topo, data = _mk24(pull_switch_age=1, sync_interval=6)
+    data, stats = _run_rounds(cfg, topo, data, 18, all_writers=True)
+    assert sum(int(b["prop_pulls"]) for b, _ in stats) > 0
+    heads = np.asarray(data.head)
+    assert (np.asarray(data.contig) == heads[None, :]).all()
+
+
+# ---------------------------------------------------------------------------
+# Age-targeted forwarding
+
+
+def test_age_forward_edges_pinned_to_telemetry():
+    """Mechanism (c) bins ages exactly like the rumor-age histogram
+    that motivated it (ops cannot import sim, so the edge tuple is
+    duplicated and pinned here)."""
+    assert gossip.AGE_FORWARD_EDGES == T.RUMOR_AGE_EDGES
+
+
+def test_age_forward_priority_orders_young_bins_first():
+    """The packed intake priority keeps young age bins ahead of old
+    ones and breaks ties inside a bin young-version-first, within i32."""
+    head = jnp.asarray([100], jnp.uint32)
+    w = jnp.zeros((1, 4), jnp.int32)
+    v = jnp.asarray([[99, 97, 40, 3]], jnp.uint32)  # ages 1, 3, 60, 97
+    cfg = gossip.GossipConfig(
+        n_nodes=4, n_writers=1, age_forward=True,
+        rebroadcast_stale=False,
+    )
+    prio = np.asarray(
+        gossip._intake_priority(head, w, v, cfg, "native")
+    )[0]
+    assert prio[0] > prio[1] > prio[2] > prio[3]
+    assert prio.dtype == np.int32
+
+
+def test_config_validation():
+    for bad in (
+        {"rumor_kill_k": -1},
+        {"pull_switch_age": -2},
+        {"sync_sketch_buckets": -1},
+        {"age_forward": True, "rebroadcast_stale": True},
+    ):
+        with pytest.raises(ValueError):
+            gossip.GossipConfig(n_nodes=4, n_writers=2, **bad)
+
+
+# ---------------------------------------------------------------------------
+# Engine coverage beyond dense: sparse and mixed thread the counters
+
+
+def test_sparse_engine_adaptive_counters_and_conservation():
+    from corrosion_tpu import models
+    from corrosion_tpu.sim import sparse_engine
+
+    cfg, topo, sched = models.anywrite_sparse(
+        n=96, w_hot=16, n_regions=4, rounds=24, cohort=8,
+        epoch_rounds=8, k_dev=8, samples=16,
+    )
+    cfg = replace(
+        cfg, gossip=replace(cfg.gossip, prop_observe=True, **ADAPTIVE)
+    )
+    *_, curves, _info = sparse_engine.simulate_sparse(
+        cfg, topo, sched, seed=0
+    )
+    np.testing.assert_array_equal(
+        curves["prop_useful_msgs"] + curves["prop_dup_msgs"],
+        curves["msgs"],
+    )
+    np.testing.assert_array_equal(
+        _mass(curves, T.LINK_CURVE_KEYS), curves["msgs"]
+    )
+    assert float(np.asarray(curves["prop_rumor_kills"]).sum()) > 0
+
+
+def test_mixed_engine_adaptive_counters_and_conservation():
+    from corrosion_tpu.models.baselines import mixed_storm
+    from corrosion_tpu.sim import mixed_engine
+
+    cfg, ccfg, topo, sched, spec = mixed_storm(
+        n=64, streams=2, last_seq=255, rounds=24, samples=16, n_cells=0
+    )
+    cfg = replace(
+        cfg, gossip=replace(cfg.gossip, prop_observe=True, **ADAPTIVE)
+    )
+    _, curves = mixed_engine.simulate_mixed(
+        cfg, ccfg, topo, sched, spec, seed=0
+    )
+    np.testing.assert_array_equal(
+        curves["prop_useful_msgs"] + curves["prop_dup_msgs"],
+        curves["msgs"],
+    )
+    assert float(np.asarray(curves["prop_rumor_kills"]).sum()) > 0
+
+
+# ---------------------------------------------------------------------------
+# Shard-count invariance of the kill-feedback scatter + psum
+
+
+def test_kill_feedback_shard_invariant():
+    """The sender-side feedback is the one new cross-shard reduction
+    (full-shape scatter + psum, like ``pulled``): a D=2 sharded
+    adaptive run must match the unsharded run bit-for-bit on protocol
+    state and every propagation curve — and q_dup must NOT join the
+    queue gather (the pinned xshard byte model still reconciles)."""
+    from jax.sharding import Mesh
+
+    from corrosion_tpu import models, parallel
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cfg, topo, sched = models.wan_100k(
+        n=32, n_regions=4, n_writers=8, rounds=12, samples=8,
+        partition=False,
+    )
+    sched.writes[:, :] = 0
+    sched.writes[:4, :] = 1
+    sched = sched.make_samples(8)
+    cfg = replace(
+        cfg, gossip=replace(cfg.gossip, prop_observe=True, **ADAPTIVE)
+    )
+    ref_final, ref = simulate(cfg, topo, sched, seed=0)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("node",))
+    final, got = parallel.shard_driver.simulate_sharded(
+        cfg, topo, sched, mesh, seed=0
+    )
+    assert float(np.asarray(ref["prop_rumor_kills"]).sum()) > 0
+    for k in T.PROP_CURVE_KEYS:
+        np.testing.assert_array_equal(
+            np.asarray(ref[k]), np.asarray(got[k]), err_msg=k
+        )
+    for name in ("head", "contig", "seen", "q_writer", "q_ver", "q_dup"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref_final.data, name)),
+            np.asarray(getattr(final.data, name)), err_msg=name,
+        )
+    ok, problems = epidemic.xshard_model_check(got, cfg.gossip, mesh)
+    assert ok, problems

@@ -582,6 +582,177 @@ def test_digest_quant_run_level_rank_identical(mode):
 
 
 # ---------------------------------------------------------------------------
+# 2c'. Bucketed set-reconciliation sketch (cfg.sync_sketch_buckets)
+
+
+def _sketch_sync_run(buckets, cohorts=True, seed=0):
+    """The _one_sync_run scenario with the sketch scorer armed (a
+    static config field, so each bucket count is its own trace)."""
+    cfg, topo, data = mk(
+        24, regions=[6, 6, 6, 6], sync_interval=3, sync_budget=48,
+        sync_chunk=8, sync_peers=3, sync_candidates=6, n_cells=32,
+        cells_per_write=2, cohorts=cohorts, sync_sketch_buckets=buckets,
+    )
+    w = jnp.zeros(24, jnp.uint32).at[3].set(2).at[17].set(1).at[9].set(3)
+    data, stats = run_rounds(
+        cfg, topo, data, 14,
+        writes_fn=lambda r: w if r < 5 else jnp.zeros(24, jnp.uint32),
+        seed=seed,
+    )
+    return data, stats
+
+
+def test_bucket_sketch_bounds_and_dominance_property():
+    """Property (the sketch's correctness contract, extending the
+    digest rank family): on random progress tables the unquantized
+    per-bucket one-sided deficit is sandwiched between the scalar
+    total-progress digest (B=1, exactly) and the exact per-writer
+    deficit — a strictly tighter lower bound as B grows — and EQUALS
+    the exact deficit whenever the candidate dominates per writer, so
+    ranking among genuinely-ahead candidates is preserved at any B."""
+    key = jax.random.PRNGKey(7)
+    w = 37  # no bucket count divides it: the padding path is covered
+    budget = 1 << 20  # above every saturation point: no quantization
+    for _ in range(4):
+        key, k1, k2, k3 = jax.random.split(key, 4)
+        self_c = jax.random.randint(k1, (1, w), 0, 50).astype(jnp.uint32)
+        cands = jax.random.randint(k2, (9, w), 0, 50).astype(jnp.uint32)
+        exact = np.sum(
+            np.maximum(
+                np.asarray(cands, np.int64)
+                - np.asarray(self_c, np.int64),
+                0,
+            ),
+            axis=1,
+        )
+        scalar = np.maximum(
+            np.asarray(cands, np.int64).sum(axis=1)
+            - np.asarray(self_c, np.int64).sum(axis=1),
+            0,
+        )
+        for b in (1, 4, 8, 16):
+            got = np.asarray(
+                gossip._sketch_score(
+                    gossip.bucket_sketch(cands, b),
+                    gossip.bucket_sketch(self_c, b),
+                    budget,
+                ),
+                np.int64,
+            )
+            assert (got >= scalar).all(), b
+            assert (got <= exact).all(), b
+            if b == 1:
+                np.testing.assert_array_equal(got, scalar)
+        # Per-writer dominating candidates: bucket sums telescope with
+        # no cancellation, so the sketch equals the exact deficit at
+        # every bucket count.
+        dom = self_c + jax.random.randint(k3, (9, w), 0, 20).astype(
+            jnp.uint32
+        )
+        exact_dom = np.sum(
+            np.asarray(dom, np.int64) - np.asarray(self_c, np.int64),
+            axis=1,
+        )
+        for b in (1, 4, 8, 16):
+            np.testing.assert_array_equal(
+                np.asarray(
+                    gossip._sketch_score(
+                        gossip.bucket_sketch(dom, b),
+                        gossip.bucket_sketch(self_c, b),
+                        budget,
+                    ),
+                    np.int64,
+                ),
+                exact_dom,
+                err_msg=f"dominance B={b}",
+            )
+
+
+def test_bucket_sketch_quantizes_per_bucket():
+    """The sketch rides the SAME saturating u8/bf16 quantization path
+    as the scalar digest, applied per bucket: with quantization engaged
+    (budget <= saturation) the score is the sum of per-bucket clamps;
+    with a budget past the saturation point it passes through as the
+    unclamped u32 sum (the digest gate, bucket-wise)."""
+    old = gossip._DIGEST_QUANT
+    contig = jnp.asarray(
+        [[700, 0, 0, 0], [100, 100, 100, 100], [0, 0, 0, 0]], jnp.uint32
+    )
+    sk_self = gossip.bucket_sketch(contig[2:], 2)  # zeros
+    skc = gossip.bucket_sketch(contig[:2], 2)  # [[700, 0], [200, 200]]
+    try:
+        gossip._DIGEST_QUANT = "bf16"  # saturation point 256
+        got = np.asarray(gossip._sketch_score(skc, sk_self, 128))
+        np.testing.assert_array_equal(got, [256, 200 + 200])
+        got = np.asarray(gossip._sketch_score(skc, sk_self, 257))
+        np.testing.assert_array_equal(got, [700, 400])
+    finally:
+        gossip._DIGEST_QUANT = old
+
+
+def test_sketch_b1_run_level_bit_identical_to_scalar_digest():
+    """B=1 degenerates to the legacy scalar digest, run level: forced
+    into digest-scoring territory, a sync_sketch_buckets=1 run lands
+    the bit-identical post-sync state and per-round stats as the
+    legacy total-progress digest run."""
+    old_exact = gossip._EXACT_SCORE_MAX
+    gossip._EXACT_SCORE_MAX = 0  # force the digest/sketch scorer
+    try:
+        _clear_sync_caches()
+        ref, stats_r = _sketch_sync_run(0)
+        got, stats_g = _sketch_sync_run(1)
+    finally:
+        gossip._EXACT_SCORE_MAX = old_exact
+        _clear_sync_caches()
+    assert_states_equal(ref, got, msg="sketch B=1 vs scalar digest")
+    for r, ((_, sr), (_, sg)) in enumerate(zip(stats_r, stats_g)):
+        for k in ("applied_sync", "sessions", "cell_merges"):
+            assert int(sr[k]) == int(sg[k]), f"round {r} stat {k}"
+
+
+@pytest.mark.parametrize("batched", [True, False], ids=["batched", "looped"])
+def test_sketch_mode_converges(batched):
+    """Sketch-mode selection still heals the cluster (grants recompute
+    the exact deficit; the sketch only picks peers), on both the
+    batched and the looped reference scoring pipelines."""
+    old_exact = gossip._EXACT_SCORE_MAX
+    gossip._EXACT_SCORE_MAX = 0
+    try:
+        gossip._BATCHED_SYNC = batched
+        _clear_sync_caches()
+        data, stats = _sketch_sync_run(8)
+    finally:
+        gossip._BATCHED_SYNC = True
+        gossip._EXACT_SCORE_MAX = old_exact
+        _clear_sync_caches()
+    heads = np.asarray(data.head)
+    assert (np.asarray(data.contig) == heads[None, :]).all()
+
+
+def test_sketch_batched_bit_identical_to_looped():
+    """The batched [R, C, B] sketch gather == the per-candidate looped
+    reference, post-sync state and stats (max/sum over candidates
+    commute bucket-wise exactly as they do for the scalar digest)."""
+    old_exact = gossip._EXACT_SCORE_MAX
+    gossip._EXACT_SCORE_MAX = 0
+    try:
+        assert gossip._BATCHED_SYNC is True
+        _clear_sync_caches()
+        ref, stats_r = _sketch_sync_run(8)
+        gossip._BATCHED_SYNC = False
+        _clear_sync_caches()
+        got, stats_g = _sketch_sync_run(8)
+    finally:
+        gossip._BATCHED_SYNC = True
+        gossip._EXACT_SCORE_MAX = old_exact
+        _clear_sync_caches()
+    assert_states_equal(ref, got, msg="sketch batched vs looped")
+    for r, ((_, sr), (_, sg)) in enumerate(zip(stats_r, stats_g)):
+        for k in ("applied_sync", "sessions", "cell_merges"):
+            assert int(sr[k]) == int(sg[k]), f"round {r} stat {k}"
+
+
+# ---------------------------------------------------------------------------
 # 2d. window_degraded dedup in the windowless branches (ADVICE r5)
 
 
